@@ -1,0 +1,9 @@
+"""mxnet_tpu — bring-up __init__ (core only; full init staged in)."""
+from .libinfo import __version__  # noqa: F401
+from .base import MXNetError  # noqa: F401
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus  # noqa: F401
+from . import base  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import random  # noqa: F401
+from . import autograd  # noqa: F401

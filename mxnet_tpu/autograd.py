@@ -1,0 +1,262 @@
+"""Autograd — tape-based reverse mode over jax VJPs.
+
+Reference surface: ``python/mxnet/autograd.py`` (record:122, pause:146,
+train_mode:166, predict_mode:181, mark_variables:197, backward:243,
+grad:270, Function:363) implemented in C++ at ``src/imperative/``
+(Imperative::RecordOp, Imperative::Backward — imperative.cc:358).
+
+TPU-native design: instead of building an NNVM graph and running
+``nnvm::pass::Gradient`` + RunGraph (reference imperative.cc:269-340),
+each recorded op captures its ``jax.vjp`` closure at invoke time.  The
+tape is a list of (vjp_fn, input slots, output slots); ``backward()`` is
+a reverse sweep accumulating cotangents.  All vjp closures are jax-traced
+functions, so the whole backward sweep dispatches asynchronously to the
+device just like the reference's engine-pushed backward ops.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = []
+    return _STATE
+
+
+def is_recording():
+    """Reference: python/mxnet/autograd.py:88."""
+    return _st().recording
+
+
+def is_training():
+    """Reference: python/mxnet/autograd.py:98."""
+    return _st().training
+
+
+def set_recording(is_rec):
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_rec)
+    return prev
+
+
+def set_training(train_mode):
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode)
+    return prev
+
+
+class _RecordingStateScope:
+    """Reference: python/mxnet/autograd.py:108."""
+
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+
+    def __exit__(self, *args):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Returns a scope that enables recording (+train mode)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+class _TapeEntry:
+    __slots__ = ("vjp_fn", "in_keys", "out_avals")
+
+    def __init__(self, vjp_fn, in_keys, out_avals):
+        self.vjp_fn = vjp_fn
+        # routing keys snapshotted at record time (in-place rebinds later
+        # must not re-route cotangents): ("s", entry_idx, pos) for an op
+        # output, ("l", leaf NDArray) for a tracked leaf, None for constants
+        self.in_keys = in_keys
+        self.out_avals = out_avals
+
+
+def _tape():
+    return _st().tape
+
+
+def _input_key(x):
+    slot = getattr(x, "_ag_slot", None)
+    if slot is not None:
+        return ("s",) + tuple(slot)
+    if getattr(x, "_ag_leaf", False) and getattr(x, "_grad", None) is not None:
+        return ("l", x)
+    return None
+
+
+def record_entry(vjp_fn, inputs, outputs, out_avals):
+    in_keys = [_input_key(x) for x in inputs]
+    entry = _TapeEntry(vjp_fn, in_keys, list(out_avals))
+    tape = _tape()
+    idx = len(tape)
+    tape.append(entry)
+    for pos, o in enumerate(outputs):
+        o._ag_slot = (idx, pos)
+    return entry
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: python/mxnet/autograd.py:197 (MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._ag_leaf = True
+        var._grad = grad if req != "null" else None
+        var._grad_req = req
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse sweep (reference: python/mxnet/autograd.py:243,
+    Imperative::Backward imperative.cc:358)."""
+    import jax.numpy as jnp
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    tape = _tape()
+
+    # cotangent stores: op-output slots and leaves, keyed by routing keys
+    ct = {}
+    leaf_cts = {}  # id -> [NDArray, accumulated ct]
+
+    def _route(key, g):
+        if key is None:
+            return
+        if key[0] == "l":
+            leaf = key[1]
+            slot_l = leaf_cts.get(id(leaf))
+            if slot_l is None:
+                leaf_cts[id(leaf)] = [leaf, g]
+            else:
+                slot_l[1] = slot_l[1] + g
+        else:
+            skey = (key[1], key[2])
+            prev = ct.get(skey)
+            ct[skey] = g if prev is None else prev + g
+
+    for i, h in enumerate(heads):
+        key = _input_key(h)
+        if key is None:
+            raise MXNetError("head array is not connected to the recorded graph")
+        g = (head_grads[i]._data if head_grads is not None and head_grads[i] is not None
+             else jnp.ones_like(h._data))
+        _route(key, g)
+
+    from jax.dtypes import float0
+
+    for idx in range(len(tape) - 1, -1, -1):
+        entry = tape[idx]
+        out_cts = []
+        touched = False
+        for pos, aval in enumerate(entry.out_avals):
+            g = ct.pop((idx, pos), None)
+            if g is None:
+                g = jnp.zeros(aval.shape, aval.dtype)
+            else:
+                touched = True
+            out_cts.append(g)
+        if not touched:
+            continue
+        arg = tuple(out_cts) if len(out_cts) > 1 else out_cts[0]
+        in_cts = entry.vjp_fn(arg)
+        for key, g in zip(entry.in_keys, in_cts):
+            if g is None or (hasattr(g, "dtype") and g.dtype == float0):
+                continue
+            _route(key, g)
+    for leaf, g in leaf_cts.values():
+        if leaf._grad_req == "add":
+            leaf._grad._data = leaf._grad._data + g
+        else:
+            leaf._grad._data = g.astype(leaf._grad._data.dtype)
+    if not retain_graph:
+        tape.clear()
+
+
+def get_symbol(x):  # pragma: no cover - graph export of recorded tape
+    raise MXNetError("autograd.get_symbol is not supported; use symbolic API")
+
+
+class Function:
+    """Custom differentiable function (reference: python/mxnet/autograd.py:363).
+
+    Subclass and override ``forward``/``backward``; gradients from
+    ``backward`` flow into the tape like any vjp."""
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *out_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording():
+            func = self
+
+            def vjp_fn(out_cts):
+                if not isinstance(out_cts, tuple):
+                    out_cts = (out_cts,)
+                with pause():
+                    in_grads = func.backward(*[_wrap(g) for g in out_cts])
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return [g._data if g is not None else None for g in in_grads]
+
+            record_entry(vjp_fn, list(inputs), outs,
+                         [o._data for o in outs])
+        return outs[0] if single else outs

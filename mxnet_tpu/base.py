@@ -1,0 +1,114 @@
+"""Base utilities: errors, dtype registry, env config.
+
+TPU-native re-design of the reference's base layer
+(`python/mxnet/base.py` + dmlc-core `GetEnv`/logging): there is no C
+handle plumbing here because the compute substrate is jax/XLA rather
+than a ctypes-wrapped libmxnet.  What survives is the *contract*:
+
+- ``MXNetError`` — the framework-wide exception type
+  (reference: ``python/mxnet/base.py:74``).
+- dtype <-> enum mapping used by NDArray serialization and op params
+  (reference: ``python/mxnet/ndarray/ndarray.py`` _DTYPE_NP_TO_MX).
+- ``getenv``/env-var config with the ``MXNET_*`` names kept compatible
+  (reference: dmlc::GetEnv usage, docs/faq/env_var.md).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import logging
+import numpy as np
+
+__all__ = [
+    "MXNetError", "getenv", "string_types", "numeric_types",
+    "_DTYPE_NP_TO_MX", "_DTYPE_MX_TO_NP", "dtype_np", "dtype_id",
+    "classproperty",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-wide error type (reference: python/mxnet/base.py:74)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# dtype enum codes preserved from the reference so saved .params files and
+# op `dtype` attrs keep their numeric meaning
+# (reference: python/mxnet/ndarray/ndarray.py:36-62 _DTYPE_NP_TO_MX).
+_DTYPE_NP_TO_MX = {
+    None: -1,
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    # TPU-native extension: bfloat16 is the workhorse dtype on the MXU.
+}
+_DTYPE_MX_TO_NP = {v: k for k, v in _DTYPE_NP_TO_MX.items()}
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes  # noqa: F401
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+    _DTYPE_NP_TO_MX[_BF16] = 12  # matches later-MXNet bfloat16 enum
+    _DTYPE_MX_TO_NP[12] = _BF16
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+
+def dtype_np(dtype):
+    """Normalize a user-supplied dtype (str/np.dtype/type) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str) and dtype == "bfloat16" and _BF16 is not None:
+        return _BF16
+    return np.dtype(dtype)
+
+
+def dtype_id(dtype):
+    """np dtype -> stable integer enum (for serialization)."""
+    d = dtype_np(dtype)
+    if d not in _DTYPE_NP_TO_MX:
+        raise MXNetError("unsupported dtype %s" % d)
+    return _DTYPE_NP_TO_MX[d]
+
+
+_TRUE = ("1", "true", "True", "yes", "on")
+
+
+def getenv(name, default=None, typ=None):
+    """dmlc::GetEnv equivalent; MXNET_* names kept for compatibility."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool or isinstance(default, bool):
+        return val in _TRUE
+    if typ is int or isinstance(default, int):
+        return int(val)
+    if typ is float or isinstance(default, float):
+        return float(val)
+    return val
+
+
+class classproperty:  # noqa: N801
+    def __init__(self, f):
+        self.f = f
+
+    def __get__(self, obj, owner):
+        return self.f(owner)
+
+
+def _get_logger():
+    logger = logging.getLogger("mxnet_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(asctime)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+logger = _get_logger()

@@ -1,0 +1,302 @@
+"""Executor — compiled forward/backward for a bound Symbol.
+
+Reference: ``src/executor/graph_executor.cc`` (GraphExecutor::Init:512,
+Forward:81, Backward:94, the Gradient pass at :298, PlanMemory at :903,
+op bulking at :1336) + ``python/mxnet/executor.py``.
+
+TPU-native redesign (SURVEY.md §2.6 TPU mapping): the entire executor
+pipeline — gradient graph construction, shape/type inference, memory
+planning, op fusion/bulking, cached segment ops — collapses into
+``jax.jit`` over ONE pure function lowered from the Symbol DAG:
+
+- ``Forward``  = jitted graph function (one XLA program, fully fused).
+- ``Backward`` = the same function under ``jax.vjp``; for training binds
+  the forward AND backward run as a single fused XLA program per step
+  (grad computed alongside forward — the idiomatic `value_and_grad`
+  form), so Forward+Backward costs one device dispatch, matching the
+  reference's bulked segments but compiler-scheduled.
+- PlanMemory/inplace (`MXNET_EXEC_ENABLE_INPLACE`) = XLA buffer
+  assignment + donation.  Aux states (BN moving stats) thread through
+  functionally and are written back after each step.
+- RNG: the executor owns a key chain; each forward folds a fresh key
+  into the graph (dropout etc.), reproducible under mx.random.seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, dtype_np
+from .context import Context, current_context
+from .ndarray.ndarray import NDArray, zeros as nd_zeros, _wrap
+from .symbol.symbol import build_graph_fn, _infer_graph
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    """A bound, compiled computation (reference: python/mxnet/executor.py:45)."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+        self._symbol = symbol
+        self._ctx = Context(ctx) if ctx is not None else current_context()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self.arg_names, grad_req))
+        self._grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        # only args that have a grad buffer get gradients
+        self._diff_idx = [i for i, n in enumerate(self.arg_names)
+                          if self._grad_req[n] != "null" and grad_dict.get(n) is not None]
+        self._outputs = None
+        self._cached_grads = None
+        self._monitor_callback = None
+        # seeded off the global mx.random chain so runs reproduce under
+        # mx.random.seed(n) (see random.py docstring)
+        from . import random as _mxrandom
+        self._rng_key = _mxrandom.next_key()
+        self._last_key = self._rng_key
+
+        fn_train = build_graph_fn(symbol, self.arg_names, self.aux_names, True)
+        fn_eval = build_graph_fn(symbol, self.arg_names, self.aux_names, False)
+        diff_idx = tuple(self._diff_idx)
+
+        def fwd_eval(args, aux, key):
+            return fn_eval(args, aux, key)
+
+        def fwd_train(args, aux, key):
+            return fn_train(args, aux, key)
+
+        def fb(args, aux, key, seeds):
+            diff = [args[i] for i in diff_idx]
+
+            def f(diff_args):
+                full = list(args)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff_args[j]
+                outs, new_aux = fn_train(full, aux, key)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = jax.vjp(f, diff, has_aux=True)
+            (grads,) = vjp_fn(tuple(seeds))
+            return list(outs), list(grads), new_aux
+
+        self._jit_fwd_eval = jax.jit(fwd_eval)
+        self._jit_fwd_train = jax.jit(fwd_train)
+        self._jit_fb = jax.jit(fb)
+
+    # -- binding constructors ----------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        known = {k: tuple(v) for k, v in shape_kwargs.items()
+                 if not isinstance(v, str)}
+        shapes, _, aux_shapes = _infer_graph(symbol, known, {})
+        type_dict = type_dict or {}
+        arg_dict, grad_dict, aux_dict = {}, {}, {}
+        for n in arg_names:
+            shp = shapes.get(n)
+            if shp is None:
+                raise MXNetError("simple_bind could not infer shape of %r" % n)
+            dt = dtype_np(type_dict.get(n, np.float32))
+            if (shared_exec is not None and n in shared_exec.arg_dict
+                    and shared_exec.arg_dict[n].shape == tuple(shp)):
+                arg_dict[n] = shared_exec.arg_dict[n]
+            else:
+                arg_dict[n] = nd_zeros(shp, ctx=ctx, dtype=dt)
+        req = grad_req if isinstance(grad_req, dict) else {n: grad_req for n in arg_names}
+        for n in arg_names:
+            if req.get(n, "null") != "null":
+                grad_dict[n] = nd_zeros(arg_dict[n].shape, ctx=ctx,
+                                        dtype=arg_dict[n].dtype)
+        for n in aux_names:
+            shp = aux_shapes.get(n) or shapes.get(n)
+            if shp is None:
+                raise MXNetError("simple_bind could not infer aux shape of %r" % n)
+            if (shared_exec is not None and n in shared_exec.aux_dict
+                    and shared_exec.aux_dict[n].shape == tuple(shp)):
+                aux_dict[n] = shared_exec.aux_dict[n]
+            else:
+                aux_dict[n] = nd_zeros(shp, ctx=ctx)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
+              shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, dict):
+            arg_dict = dict(args)
+        else:
+            arg_dict = dict(zip(arg_names, args))
+        missing = [n for n in arg_names if n not in arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, dict):
+            grad_dict = dict(args_grad)
+        else:
+            grad_dict = dict(zip(arg_names, args_grad))
+        if aux_states is None:
+            aux_dict = {}
+        elif isinstance(aux_states, dict):
+            aux_dict = dict(aux_states)
+        else:
+            aux_dict = dict(zip(aux_names, aux_states))
+        for n in aux_names:
+            if n not in aux_dict:
+                known = {m: arg_dict[m].shape for m in arg_names}
+                _, _, aux_shapes = _infer_graph(symbol, known, {})
+                aux_dict = {**{a: nd_zeros(aux_shapes[a], ctx=ctx)
+                               for a in aux_names if a in aux_shapes}, **aux_dict}
+                break
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+
+    # -- execution ----------------------------------------------------------
+    @property
+    def outputs(self):
+        if self._outputs is None:
+            raise MXNetError("run forward() first")
+        return self._outputs
+
+    def _next_key(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        self._last_key = sub
+        return sub
+
+    def _args(self):
+        return [self.arg_dict[n]._data for n in self.arg_names]
+
+    def _aux(self):
+        return [self.aux_dict[n]._data for n in self.aux_names]
+
+    def forward(self, is_train=False, **kwargs):
+        """Reference: executor.py:113 -> GraphExecutor::Forward."""
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown forward argument %r" % k)
+            tgt = self.arg_dict[k]
+            if isinstance(v, NDArray):
+                tgt._data = v._data.astype(tgt.dtype) if v.dtype != tgt.dtype else v._data
+            else:
+                tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)
+        args, aux, key = self._args(), self._aux(), self._next_key()
+        if is_train and self._diff_idx:
+            seeds = self._default_seeds(args, aux, key)
+            outs, grads, new_aux = self._jit_fb(args, aux, key, seeds)
+            self._cached_grads = grads
+        else:
+            outs, new_aux = (self._jit_fwd_train(args, aux, key) if is_train
+                             else self._jit_fwd_eval(args, aux, key))
+            self._cached_grads = None
+        for n, a in zip(self.aux_names, new_aux):
+            self.aux_dict[n]._data = a
+        self._outputs = [_wrap(o) for o in outs]
+        if self._monitor_callback is not None:
+            for name, o in zip(self.output_names, self._outputs):
+                self._monitor_callback(name, o)
+        return self._outputs
+
+    def _default_seeds(self, args, aux, key):
+        sig = tuple(a.shape for a in args)
+        cache = getattr(self, "_seed_cache", None)
+        if cache is None or cache[0] != sig:
+            outs_shape = jax.eval_shape(self._jit_fwd_train, args, aux, key)[0]
+            self._seed_cache = (sig, [jnp.ones(o.shape, o.dtype) for o in outs_shape])
+        return self._seed_cache[1]
+
+    def backward(self, out_grads=None, is_train=True):
+        """Reference: executor.py:154 -> GraphExecutor::Backward.
+
+        With no out_grads, gradients were already computed fused with
+        forward(is_train=True) — this just commits them to the grad
+        arrays (kWriteTo/kAddTo semantics)."""
+        if not self._diff_idx:
+            return
+        if out_grads is not None:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            seeds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads]
+            # reuse the key of the preceding forward so stochastic ops
+            # (dropout) see the same mask the user observed
+            args, aux, key = self._args(), self._aux(), self._last_key
+            _, grads, _ = self._jit_fb(args, aux, key, seeds)
+        else:
+            if self._cached_grads is None:
+                raise MXNetError(
+                    "backward() without out_grads requires forward(is_train=True)")
+            grads = self._cached_grads
+        for j, i in enumerate(self._diff_idx):
+            n = self.arg_names[i]
+            g = self.grad_dict.get(n)
+            if g is None:
+                continue
+            if self._grad_req[n] == "add":
+                g._data = g._data + grads[j]
+            else:
+                g._data = grads[j].astype(g.dtype)
+
+    # -- reference API surface ----------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """Reference: executor.py copy_params_from."""
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError("unknown parameter %r" % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = v._data.astype(self.aux_dict[k].dtype)
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new data shapes, sharing parameter arrays
+        (reference: MXExecutorReshape — bucketing/variable batch).  On TPU
+        this is a new jit cache entry; XLA recompiles per shape."""
+        new_shapes = {k: tuple(v) for k, v in kwargs.items()}
+        shapes, _, aux_shapes = _infer_graph(self._symbol, dict(new_shapes), {})
+        arg_dict, grad_dict = {}, {}
+        for n in self.arg_names:
+            if n in new_shapes or shapes.get(n) != self.arg_dict[n].shape:
+                arg_dict[n] = nd_zeros(shapes[n], ctx=self._ctx,
+                                       dtype=self.arg_dict[n].dtype)
+            else:
+                arg_dict[n] = self.arg_dict[n]
+            if self._grad_req[n] != "null":
+                grad_dict[n] = nd_zeros(arg_dict[n].shape, ctx=self._ctx,
+                                        dtype=arg_dict[n].dtype)
+        return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
+                        dict(self.aux_dict), self._grad_req)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Reference: graph_executor.cc:121 monitor tap (output-level)."""
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        return self._symbol.debug_str()

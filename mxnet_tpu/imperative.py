@@ -1,0 +1,122 @@
+"""Imperative op invocation — eager execution with optional recording.
+
+Reference: ``src/imperative/imperative.cc`` (Imperative::Invoke:86,
+InvokeOp:37, RecordOp) + the dispatch helpers in
+``src/imperative/imperative_utils.h:342-420`` (PushFCompute etc.).
+
+TPU-native: "pushing to the engine" is jax's own async dispatch — every
+jnp/lax call returns immediately with a future-backed ``jax.Array``, so
+the reference's threaded dependency engine (src/engine/) is subsumed by
+the XLA runtime.  What remains here is:
+- attr coercion + context placement,
+- train-mode/RNG injection (reserved ``__is_train__``/``__rng__`` attrs),
+- autograd recording via ``jax.vjp`` at invoke time,
+- write-back of ``mutate_aux`` outputs (BatchNorm moving stats,
+  optimizer states) and of ``out=`` targets — the functional replacement
+  for the reference's in-place mutation.
+"""
+from __future__ import annotations
+
+import jax
+
+from . import autograd
+from . import random as _random
+from .base import MXNetError
+from .ops.registry import get_op, coerce_attrs, OpDef
+
+_INT_KINDS = ("i", "u", "b")
+
+
+def _call_args(op, attrs):
+    kw = dict(op.attr_defaults)
+    kw.update(attrs)
+    if op.needs_is_train:
+        kw["__is_train__"] = autograd.is_training()
+    if op.needs_rng:
+        kw["__rng__"] = _random.next_key()
+    return kw
+
+
+def invoke(op, nd_inputs, attrs=None, out=None):
+    """Invoke a registered op on NDArrays; returns NDArray or list."""
+    from .ndarray.ndarray import NDArray, _wrap
+
+    if not isinstance(op, OpDef):
+        op = get_op(op)
+    attrs = coerce_attrs(attrs or {})
+    kw = _call_args(op, attrs)
+    datas = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
+
+    recording = autograd.is_recording() and any(
+        isinstance(x, NDArray)
+        and (getattr(x, "_ag_leaf", False) or getattr(x, "_ag_slot", None) is not None)
+        for x in nd_inputs)
+
+    if recording:
+        fn = lambda *xs: op.fn(*xs, **kw)  # noqa: E731
+        outputs, vjp_fn = jax.vjp(fn, *datas)
+    else:
+        outputs = op.fn(*datas, **kw)
+        vjp_fn = None
+
+    single = not isinstance(outputs, tuple)
+    outs = [outputs] if single else list(outputs)
+
+    # write mutate_aux results back into the trailing aux inputs
+    n_aux = len(op.mutate_aux)
+    if n_aux:
+        aux_inputs = nd_inputs[-n_aux:]
+        for tgt, new in zip(aux_inputs, outs[-n_aux:]):
+            if isinstance(tgt, NDArray):
+                tgt._data = new
+        outs = outs[:-n_aux]
+
+    nd_outs = [_wrap(o) for o in outs]
+
+    if recording:
+        in_nds = [x for x in nd_inputs if isinstance(x, NDArray)]
+        # vjp_fn covers all positional inputs; tape stores all of them
+        def tape_vjp(out_cts, _vjp=vjp_fn, _single=single, _naux=n_aux,
+                     _avals=[o for o in ([outputs] if single else list(outputs))]):
+            if not isinstance(out_cts, tuple):
+                out_cts = (out_cts,)
+            # re-append zero cotangents for aux outputs stripped above
+            if _naux:
+                import jax.numpy as jnp
+                full = list(out_cts) + [jnp.zeros_like(a) for a in _avals[-_naux:]]
+                out_cts = tuple(full)
+            arg = out_cts if len(out_cts) > 1 else out_cts[0]
+            return _vjp(arg)
+
+        autograd.record_entry(
+            tape_vjp, list(nd_inputs), nd_outs, [o._data for o in nd_outs])
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for tgt, src in zip(targets, nd_outs):
+            tgt._data = src._data.astype(tgt._data.dtype) if tgt._data.dtype != src._data.dtype else src._data
+            if recording:
+                tgt._ag_slot = getattr(src, "_ag_slot", None)
+        return out
+    if single or len(nd_outs) == 1:
+        return nd_outs[0]
+    return nd_outs
+
+
+def invoke_fn(fn, nd_inputs, record_grad=True):
+    """Invoke an anonymous pure jax function with autograd recording —
+    used for NDArray sugar (slicing, fancy indexing) that has no named op."""
+    from .ndarray.ndarray import NDArray, _wrap
+
+    datas = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
+    recording = record_grad and autograd.is_recording() and any(
+        isinstance(x, NDArray)
+        and (getattr(x, "_ag_leaf", False) or getattr(x, "_ag_slot", None) is not None)
+        for x in nd_inputs)
+    if recording:
+        out, vjp_fn = jax.vjp(fn, *datas)
+        nd_out = _wrap(out)
+        autograd.record_entry(
+            lambda g, _v=vjp_fn: _v(g), list(nd_inputs), [nd_out], [out])
+        return nd_out
+    return _wrap(fn(*datas))

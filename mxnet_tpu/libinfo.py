@@ -1,0 +1,2 @@
+"""Version info (reference: python/mxnet/libinfo.py:76)."""
+__version__ = "1.2.0.tpu0"

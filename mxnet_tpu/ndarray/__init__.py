@@ -1,0 +1,16 @@
+"""NDArray package (reference: python/mxnet/ndarray/__init__.py)."""
+from .ndarray import (  # noqa: F401
+    NDArray, array, zeros, ones, full, arange, empty, concat, concatenate,
+    save, load, waitall, moveaxis, onehot_encode, imdecode,
+)
+from . import ndarray  # noqa: F401
+from . import random  # noqa: F401
+from . import linalg  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import CSRNDArray, RowSparseNDArray, sparse_array  # noqa: F401
+from .utils import zeros as _zeros_util  # noqa: F401
+
+# populate mx.nd.<op> functions from the registry
+from . import register as _register
+
+_register.populate(__name__)

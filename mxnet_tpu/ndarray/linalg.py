@@ -1,0 +1,38 @@
+"""mx.nd.linalg namespace (reference: python/mxnet/ndarray/linalg.py)."""
+from __future__ import annotations
+
+from ..imperative import invoke
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kwargs):
+    return invoke("_linalg_gemm2", [A, B],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b,
+                   "alpha": alpha})
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, **kwargs):
+    return invoke("_linalg_gemm", [A, B, C],
+                  {"transpose_a": transpose_a, "transpose_b": transpose_b,
+                   "alpha": alpha, "beta": beta})
+
+
+def potrf(A, **kwargs):
+    return invoke("_linalg_potrf", [A])
+
+
+def trsm(A, B, transpose=False, rightside=False, alpha=1.0, **kwargs):
+    return invoke("_linalg_trsm", [A, B],
+                  {"transpose": transpose, "rightside": rightside, "alpha": alpha})
+
+
+def trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kwargs):
+    return invoke("_linalg_trmm", [A, B],
+                  {"transpose": transpose, "rightside": rightside, "alpha": alpha})
+
+
+def syrk(A, transpose=False, alpha=1.0, **kwargs):
+    return invoke("_linalg_syrk", [A], {"transpose": transpose, "alpha": alpha})
+
+
+def sumlogdiag(A, **kwargs):
+    return invoke("_linalg_sumlogdiag", [A])

@@ -1,0 +1,651 @@
+"""NDArray — the user-facing tensor.
+
+Reference: ``include/mxnet/ndarray.h:82`` + ``python/mxnet/ndarray/ndarray.py``
+(the 181-method Python class).  TPU-native redesign:
+
+- The buffer is a ``jax.Array``.  jax arrays are immutable, so the
+  reference's shared mutable Chunk becomes a *rebindable reference*:
+  in-place APIs (``x += y``, ``x[:] = v``, optimizer updates) compute a
+  new functional value and rebind ``self._data``.  Aliasing views
+  (reference zero-copy Reshape/Slice) are therefore value-snapshots —
+  the documented divergence from the reference's mutable-view semantics.
+- Asynchrony comes from jax's dispatch: every op returns immediately;
+  ``wait_to_read`` = ``block_until_ready`` (reference
+  NDArray::WaitToRead, engine WaitForVar).  ``asnumpy`` blocks and
+  copies to host (reference ndarray.py asnumpy -> SyncCopyToCPU).
+- Autograd state (``attach_grad``) hangs directly off the array,
+  mirroring the reference's ``entry_`` autograd link (ndarray.h:98).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError, dtype_np, dtype_id, _DTYPE_MX_TO_NP, numeric_types
+from ..context import Context, current_context
+from ..imperative import invoke, invoke_fn
+from ..ops.registry import get_op
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
+           "concat", "concatenate", "save", "load", "waitall", "_wrap",
+           "imdecode", "moveaxis", "onehot_encode"]
+
+
+def _dev_ctx(jarr):
+    try:
+        dev = next(iter(jarr.devices()))
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+class NDArray:
+    """Multi-dimensional array on a device, with async semantics."""
+
+    __slots__ = ("_data", "_grad", "_grad_req", "_ag_leaf", "_ag_slot",
+                 "__weakref__")
+    # make numpy defer to our reflected ops
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            data = jax.device_put(data, Context(ctx).jax_device)
+        self._data = data
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_leaf = False
+        self._ag_slot = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return _dev_ctx(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def handle(self):
+        """Reference exposes the C handle; here the jax.Array IS the handle."""
+        return self._data
+
+    # -- sync / host transfer ----------------------------------------------
+    def wait_to_read(self):
+        """Reference: NDArray::WaitToRead (include/mxnet/ndarray.h:305)."""
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        """Blocking copy to host (reference: ndarray.py asnumpy)."""
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            np.asarray(self._data), "x".join(map(str, self.shape)), self.context)
+
+    # jax/dlpack interop (replaces reference TBlob/DLPack, tensor_blob.h:66)
+    def __dlpack__(self, **kw):
+        return self._data.__dlpack__(**kw)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- dtype/device movement ---------------------------------------------
+    def astype(self, dtype, copy=True):
+        return invoke_fn(lambda x: x.astype(dtype_np(dtype)), [self])
+
+    def as_in_context(self, context):
+        """Reference: ndarray.py as_in_context (engine CopyFromTo)."""
+        ctx = Context(context)
+        if ctx == self.context:
+            return self
+        out = NDArray(jax.device_put(self._data, ctx.jax_device))
+        return out
+
+    def copyto(self, other):
+        """Reference: CopyFromTo (src/ndarray/ndarray.cc:1162)."""
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data.astype(other.dtype),
+                                         next(iter(other._data.devices())))
+            return other
+        ctx = Context(other)
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    def copy(self):
+        return NDArray(jnp.array(self._data))
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Reference: ndarray.py attach_grad -> MXAutogradMarkVariables."""
+        self._grad = NDArray(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        self._ag_leaf = True
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- shape ops ----------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return invoke("Reshape", [self], {"shape": shape,
+                                          "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", [self, other])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return invoke("transpose", [self], {"axes": axes} if axes else {})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("SwapAxis", [self], {"dim1": dim1, "dim2": dim2})
+
+    def flatten(self):
+        return invoke("Flatten", [self])
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", [self], {"axis": axis} if axis is not None else {})
+
+    def flip(self, axis):
+        return invoke("reverse", [self], {"axis": axis})
+
+    def tile(self, reps):
+        return invoke("tile", [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def pad(self, mode, pad_width, constant_value=0.0):
+        return invoke("Pad", [self], {"mode": mode, "pad_width": pad_width,
+                                      "constant_value": constant_value})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("SliceChannel", [self],
+                      {"num_outputs": num_outputs, "axis": axis,
+                       "squeeze_axis": squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", [self], {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke("pick", [self, index], {"axis": axis, "keepdims": keepdims})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return invoke("one_hot", [self], {"depth": depth, "on_value": on_value,
+                                          "off_value": off_value, "dtype": dtype})
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return invoke("abs", [self])
+
+    def sign(self):
+        return invoke("sign", [self])
+
+    def sqrt(self):
+        return invoke("sqrt", [self])
+
+    def square(self):
+        return invoke("square", [self])
+
+    def exp(self):
+        return invoke("exp", [self])
+
+    def log(self):
+        return invoke("log", [self])
+
+    def sigmoid(self):
+        return invoke("sigmoid", [self])
+
+    def tanh(self):
+        return invoke("tanh", [self])
+
+    def relu(self):
+        return invoke("relu", [self])
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", [self], {"axis": axis})
+
+    # -- reductions ---------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                       "is_ascend": is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", [self, other],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    # -- sparse compat ------------------------------------------------------
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse
+        return sparse.cast_storage(self, stype)
+
+    def as_nd_ndarray(self):
+        return self
+
+    # -- arithmetic ---------------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            args = [other, self] if reverse else [self, other]
+            return invoke(op, args)
+        if isinstance(other, numeric_types):
+            return invoke(scalar_op, [self], {"scalar": float(other)})
+        if isinstance(other, np.ndarray):
+            o = NDArray(other)
+            args = [o, self] if reverse else [self, o]
+            return invoke(op, args)
+        return NotImplemented
+
+    def __add__(self, other):
+        return self._binop(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        if isinstance(other, numeric_types):
+            return invoke("_rminus_scalar", [self], {"scalar": float(other)})
+        return self._binop(other, "elemwise_sub", None, reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        if isinstance(other, numeric_types):
+            return invoke("_rdiv_scalar", [self], {"scalar": float(other)})
+        return self._binop(other, "elemwise_div", None, reverse=True)
+
+    def __mod__(self, other):
+        return self._binop(other, "_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        if isinstance(other, numeric_types):
+            return invoke("_rmod_scalar", [self], {"scalar": float(other)})
+        return self._binop(other, "_mod", None, reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        if isinstance(other, numeric_types):
+            return invoke("_rpower_scalar", [self], {"scalar": float(other)})
+        return NotImplemented
+
+    def __neg__(self):
+        return invoke("negative", [self])
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binop(other, "_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binop(other, "_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return self._binop(other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    # in-place: rebind to new functional value
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        self._data = res._data
+        self._ag_slot = res._ag_slot
+        return self
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        self._data = res._data
+        self._ag_slot = res._ag_slot
+        return self
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        self._data = res._data
+        self._ag_slot = res._ag_slot
+        return self
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        self._data = res._data
+        self._ag_slot = res._ag_slot
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def _conv_index(self, key):
+        if isinstance(key, NDArray):
+            return key._data.astype(jnp.int32)
+        if isinstance(key, tuple):
+            return tuple(self._conv_index(k) for k in key)
+        if isinstance(key, (list, np.ndarray)):
+            return jnp.asarray(key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._conv_index(key)
+        return invoke_fn(lambda x: x[key], [self])
+
+    def __setitem__(self, key, value):
+        key = self._conv_index(key)
+        if isinstance(value, NDArray):
+            v = value._data
+        else:
+            v = value
+        if isinstance(key, slice) and key == slice(None) and not isinstance(v, (int, float)):
+            v = jnp.asarray(v)
+            self._data = jnp.broadcast_to(v.astype(self._data.dtype), self.shape)
+            if isinstance(value, NDArray):
+                self._ag_slot = value._ag_slot
+        else:
+            self._data = self._data.at[key].set(
+                v if not hasattr(v, "astype") else v.astype(self._data.dtype))
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+
+def _wrap(jarr):
+    return NDArray(jarr)
+
+
+# ---------------------------------------------------------------------------
+# creation functions (reference: python/mxnet/ndarray/utils.py + ndarray.py)
+# ---------------------------------------------------------------------------
+def _ctx_device(ctx):
+    return Context(ctx).jax_device if ctx is not None else None
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        data = source_array._data
+        if dtype is not None:
+            data = data.astype(dtype_np(dtype))
+        return NDArray(data, ctx=ctx)
+    a = np.asarray(source_array, dtype=dtype_np(dtype) if dtype is not None
+                   else None)
+    if a.dtype == np.float64 and dtype is None:
+        a = a.astype(np.float32)  # MXNet default dtype
+    return NDArray(jnp.asarray(a), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    return NDArray(jnp.zeros(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    return NDArray(jnp.ones(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None):
+    return NDArray(jnp.full(shape, val, dtype_np(dtype)), ctx=ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def moveaxis(tensor, source, destination):
+    return invoke_fn(lambda x: jnp.moveaxis(x, source, destination), [tensor])
+
+
+def concat(*data, dim=1):
+    return invoke("Concat", list(data), {"dim": dim})
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke("Concat", list(arrays), {"dim": axis})
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = invoke("one_hot", [indices], {"depth": depth})
+    out._data = res._data
+    return out
+
+
+def imdecode(buf, **kwargs):  # pragma: no cover - needs cv2
+    import cv2
+    img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), cv2.IMREAD_COLOR)
+    return array(img[:, :, ::-1])
+
+
+def waitall():
+    """Reference: MXNDArrayWaitAll / Engine::WaitForAll."""
+    try:
+        (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# serialization — NDArray V2 container (reference: src/ndarray/ndarray.cc:1552)
+# Binary layout (little-endian), faithful to the reference's dmlc::Stream
+# writes: magic 0xF993fac9 (uint64), reserved uint64, then the two vectors
+# (data blobs, names) each prefixed with uint64 count.
+# ---------------------------------------------------------------------------
+_NDARRAY_V2_MAGIC = 0xF993FAC9
+_NDARRAY_V1_MAGIC = 0xF993FAC8
+
+
+def _write_ndarray(f, arr):
+    a = arr.asnumpy()
+    f.write(struct.pack("<Q", _NDARRAY_V2_MAGIC))
+    # stype (-1 dense), shape ndim + dims (uint32 each), context (int32 x2),
+    # dtype id (int32), data bytes
+    f.write(struct.pack("<i", -1))
+    f.write(struct.pack("<I", a.ndim))
+    for d in a.shape:
+        f.write(struct.pack("<q", d))
+    f.write(struct.pack("<ii", 1, 0))  # ctx: cpu(0)
+    f.write(struct.pack("<i", dtype_id(a.dtype)))
+    f.write(a.tobytes())
+
+
+def _read_ndarray(f):
+    magic = struct.unpack("<Q", f.read(8))[0]
+    if magic != _NDARRAY_V2_MAGIC:
+        raise MXNetError("invalid NDArray file format (magic %x)" % magic)
+    struct.unpack("<i", f.read(4))  # stype
+    ndim = struct.unpack("<I", f.read(4))[0]
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    struct.unpack("<ii", f.read(8))
+    tid = struct.unpack("<i", f.read(4))[0]
+    dt = _DTYPE_MX_TO_NP[tid]
+    n = int(np.prod(shape)) if shape else 1
+    a = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(shape)
+    return array(a)
+
+
+def save(fname, data):
+    """Save dict/list of NDArrays (reference: mx.nd.save, c_api.cc:261)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    names, arrays = [], []
+    if isinstance(data, dict):
+        for k, v in data.items():
+            names.append(k)
+            arrays.append(v)
+    else:
+        arrays = list(data)
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<Q", 0x112))  # container magic (kMXAPINDArrayListMagic)
+        f.write(struct.pack("<Q", 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for nme in names:
+            b = nme.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname):
+    """Load NDArrays (reference: mx.nd.load, c_api.cc:279)."""
+    with open(fname, "rb") as f:
+        magic = struct.unpack("<Q", f.read(8))[0]
+        if magic != 0x112:
+            raise MXNetError("invalid NDArray container (magic %x)" % magic)
+        struct.unpack("<Q", f.read(8))
+        n = struct.unpack("<Q", f.read(8))[0]
+        arrays = [_read_ndarray(f) for _ in range(n)]
+        m = struct.unpack("<Q", f.read(8))[0]
+        names = []
+        for _ in range(m):
+            ln = struct.unpack("<Q", f.read(8))[0]
+            names.append(f.read(ln).decode())
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

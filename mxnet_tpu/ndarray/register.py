@@ -1,0 +1,37 @@
+"""Generate the ``mx.nd.*`` function namespace from the op registry.
+
+Reference: ``python/mxnet/ndarray/register.py`` + ``_ctypes/ndarray.py``
+generate Python functions at import time from
+``MXSymbolListAtomicSymbolCreators``.  Here the registry is native
+Python, so "codegen" is a closure per OpDef.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..imperative import invoke
+from ..ops.registry import _OP_REGISTRY
+
+
+def _make_op_func(name, opdef):
+    def op_func(*args, out=None, name=None, **kwargs):
+        from .ndarray import NDArray
+        nd_inputs = [a for a in args if isinstance(a, NDArray)]
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
+        nd_inputs += [v for v in kwargs.values() if isinstance(v, NDArray)]
+        return invoke(opdef, nd_inputs, attrs, out=out)
+
+    op_func.__name__ = name
+    op_func.__doc__ = opdef.doc
+    return op_func
+
+
+def populate(module_name):
+    """Install one function per registered op name into `module_name`."""
+    mod = sys.modules[module_name]
+    for name, opdef in _OP_REGISTRY.items():
+        pyname = name
+        if not pyname.isidentifier():
+            continue
+        if not hasattr(mod, pyname):
+            setattr(mod, pyname, _make_op_func(pyname, opdef))

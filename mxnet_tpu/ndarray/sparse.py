@@ -1,0 +1,175 @@
+"""Sparse NDArray — row_sparse and csr storage types.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` (CSRNDArray,
+RowSparseNDArray) over C++ storage types kRowSparseStorage/kCSRStorage
+(include/mxnet/ndarray.h:61-65).
+
+TPU-native reality (SURVEY.md §7 hard parts): XLA has no native sparse
+tensors.  The semantic surface is preserved — indices/data accessors,
+cast_storage, retain, sparse creation — with computation lowering to
+dense XLA gather/scatter/segment ops.  This keeps every reference script
+running; the perf divergence is documented rather than hidden.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from .ndarray import NDArray, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_stype", "_indices", "_indptr")
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+    def tostype(self, stype):
+        if stype == self._stype:
+            return self
+        if stype == "default":
+            return NDArray(self._data)
+        return cast_storage(NDArray(self._data), stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: subset of rows are non-zero (reference sparse.py:778)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices=None, shape=None, ctx=None):
+        if indices is None:  # dense data given
+            dense = jnp.asarray(data)
+            idx = jnp.nonzero(jnp.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+        else:
+            values = jnp.asarray(data)
+            idx = jnp.asarray(indices, dtype=jnp.int64)
+            dense = jnp.zeros(shape, values.dtype).at[idx].set(values)
+        super().__init__(dense, ctx=ctx)
+        self._stype = "row_sparse"
+        self._indices = idx
+        self._indptr = None
+
+    @property
+    def indices(self):
+        return NDArray(self._indices.astype(jnp.int64))
+
+    @property
+    def data(self):
+        return NDArray(jnp.take(self._data, self._indices.astype(jnp.int32), axis=0))
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: compressed sparse row matrix (reference sparse.py:532)."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indptr=None, indices=None, shape=None, ctx=None):
+        if indptr is None:
+            dense = jnp.asarray(data)
+            np_d = np.asarray(dense)
+            nz = np_d != 0
+            indptr_np = np.concatenate([[0], np.cumsum(nz.sum(axis=1))])
+            indices_np = np.concatenate([np.nonzero(nz[i])[0] for i in range(np_d.shape[0])]) \
+                if np_d.shape[0] else np.array([], np.int64)
+            self._indptr = jnp.asarray(indptr_np, dtype=jnp.int64)
+            self._indices = jnp.asarray(indices_np, dtype=jnp.int64)
+        else:
+            d = np.asarray(data)
+            ip = np.asarray(indptr, dtype=np.int64)
+            ix = np.asarray(indices, dtype=np.int64)
+            dense_np = np.zeros(shape, d.dtype)
+            for r in range(shape[0]):
+                cols = ix[ip[r]:ip[r + 1]]
+                dense_np[r, cols] = d[ip[r]:ip[r + 1]]
+            dense = jnp.asarray(dense_np)
+            self._indptr = jnp.asarray(ip)
+            self._indices = jnp.asarray(ix)
+        super().__init__(dense, ctx=ctx)
+        self._stype = "csr"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr)
+
+    @property
+    def data(self):
+        np_d = self.asnumpy()
+        ip = np.asarray(self._indptr)
+        ix = np.asarray(self._indices)
+        vals = np.concatenate([np_d[r, ix[ip[r]:ip[r + 1]]] for r in range(np_d.shape[0])]) \
+            if np_d.shape[0] else np.array([], np_d.dtype)
+        return _dense_array(vals)
+
+
+def cast_storage(arr, stype):
+    """Reference: src/operator/tensor/cast_storage-inl.h."""
+    if stype == "default":
+        return NDArray(arr._data)
+    if stype == "row_sparse":
+        return RowSparseNDArray(arr._data)
+    if stype == "csr":
+        if arr.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        return CSRNDArray(arr._data)
+    raise MXNetError("unknown stype %s" % stype)
+
+
+def retain(arr, indices):
+    """Reference: sparse_retain op — keep only given rows."""
+    from .ndarray import NDArray as ND
+    idx = indices._data if isinstance(indices, ND) else jnp.asarray(indices)
+    mask = jnp.zeros(arr.shape[0], bool).at[idx.astype(jnp.int32)].set(True)
+    dense = jnp.where(mask.reshape((-1,) + (1,) * (arr.ndim - 1)), arr._data, 0)
+    return RowSparseNDArray(dense)
+
+
+def zeros(stype, shape, ctx=None, dtype=None, **kwargs):
+    dense = jnp.zeros(shape, dtype_np(dtype))
+    if stype == "row_sparse":
+        return RowSparseNDArray(dense)
+    if stype == "csr":
+        return CSRNDArray(dense)
+    return NDArray(dense)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    a = np.asarray(source_array if not isinstance(source_array, NDArray)
+                   else source_array.asnumpy(), dtype=dtype_np(dtype) if dtype else None)
+    return csr_matrix(a) if False else RowSparseNDArray(jnp.asarray(a))
+
+
+sparse_array = array
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Reference: sparse.py csr_matrix."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indptr=indptr, indices=indices, shape=shape, ctx=ctx)
+    a = np.asarray(arg1 if not isinstance(arg1, NDArray) else arg1.asnumpy())
+    return CSRNDArray(jnp.asarray(a), ctx=ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices=indices, shape=shape, ctx=ctx)
+    a = np.asarray(arg1 if not isinstance(arg1, NDArray) else arg1.asnumpy())
+    return RowSparseNDArray(jnp.asarray(a), ctx=ctx)

@@ -1,0 +1,15 @@
+"""mx.nd utils (reference: python/mxnet/ndarray/utils.py)."""
+from __future__ import annotations
+
+from .ndarray import NDArray, zeros as _dense_zeros, array as _dense_array
+from . import sparse as _sparse
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    if stype is None or stype == "default":
+        return _dense_zeros(shape, ctx=ctx, dtype=dtype)
+    return _sparse.zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    return _dense_array(source_array, ctx=ctx, dtype=dtype)

@@ -1,0 +1,19 @@
+"""Operator library — jax/XLA/Pallas implementations.
+
+TPU-native replacement for ``src/operator/`` (96.5 kLoC of mshadow/CUDA
+kernels): each op is one pure jax function in the registry; XLA performs
+the fusion/scheduling the reference hand-rolled, and Pallas kernels
+(``pallas_kernels.py``) cover hot paths where XLA fusion is not enough.
+"""
+from . import registry
+from .registry import register, get_op, has_op, list_ops, coerce_attrs
+
+# importing the modules populates the registry
+from . import elemwise      # noqa: F401
+from . import reduce        # noqa: F401
+from . import matrix        # noqa: F401
+from . import nn            # noqa: F401
+from . import loss          # noqa: F401
+from . import init_ops      # noqa: F401
+from . import random_ops    # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,189 @@
+"""Elementwise operators.
+
+TPU-native collapse of the reference's mshadow scalar-functor zoo
+(``src/operator/mshadow_op.h``, 820 LoC of DEFINE_SIMPLE_UNARY/BINARY
+functors) and the elemwise registration files
+(``src/operator/tensor/elemwise_unary_op_basic.cc``,
+``elemwise_binary_op_basic.cc``, ``elemwise_binary_broadcast_op_*.cc``,
+``elemwise_binary_scalar_op_*.cc``): every functor becomes one jnp/lax
+expression; XLA fuses chains of them into single kernels so there is no
+need for the reference's ``Kernel<OP,xpu>::Launch`` elementwise launcher
+(``src/operator/mxnet_op.h``).
+
+Naming keeps the reference's registered op names (including the
+``_plus_scalar``-style scalar variants and ``broadcast_*`` variants used
+in symbol JSON) so saved symbols deserialize onto this registry.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# -- unary math zoo ---------------------------------------------------------
+def _unary(name, f, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(x, **attrs):  # noqa: ANN001
+        return f(x)
+    _op.__name__ = name
+    return _op
+
+
+_unary("abs", jnp.abs, aliases=("_abs",))
+_unary("sign", jnp.sign)
+_unary("rint", jnp.rint)
+_unary("round", jnp.round)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.fix)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lax.rsqrt)
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("sigmoid", lambda x: jax_sigmoid(x))
+_unary("softsign", lambda x: x / (1 + jnp.abs(x)))
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("negative", jnp.negative)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("gamma", lambda x: jnp.exp(lax.lgamma(x)))
+_unary("gammaln", lax.lgamma)
+_unary("erf", lax.erf)
+_unary("erfinv", lax.erf_inv)
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("softrelu", lambda x: jnp.logaddexp(x, 0.0))
+_unary("_copy", lambda x: x, aliases=("identity",))
+_unary("make_loss_grad_blocked", lambda x: lax.stop_gradient(x))
+
+
+def jax_sigmoid(x):
+    return lax.logistic(x)
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(x, **attrs):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc BlockGrad."""
+    return lax.stop_gradient(x)
+
+
+@register("Cast", aliases=("cast",))
+def _cast(x, dtype="float32", **attrs):
+    from ..base import dtype_np
+    return x.astype(dtype_np(dtype))
+
+
+@register("clip")
+def _clip(x, a_min=None, a_max=None, **attrs):
+    return jnp.clip(x, a_min, a_max)
+
+
+# -- binary (elemwise + broadcast share one impl; XLA broadcasts natively) --
+def _binary(name, f, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(lhs, rhs, **attrs):
+        return f(lhs, rhs)
+    _op.__name__ = name
+    return _op
+
+
+_binary("elemwise_add", jnp.add, aliases=("_add", "_plus", "_Plus", "broadcast_add", "broadcast_plus"))
+_binary("elemwise_sub", jnp.subtract, aliases=("_sub", "_minus", "_Minus", "broadcast_sub", "broadcast_minus"))
+_binary("elemwise_mul", jnp.multiply, aliases=("_mul", "_Mul", "broadcast_mul"))
+_binary("elemwise_div", jnp.divide, aliases=("_div", "_Div", "broadcast_div"))
+_binary("_mod", jnp.mod, aliases=("broadcast_mod",))
+_binary("_power", jnp.power, aliases=("_Power", "broadcast_power", "pow"))
+_binary("_maximum", jnp.maximum, aliases=("broadcast_maximum",))
+_binary("_minimum", jnp.minimum, aliases=("broadcast_minimum",))
+_binary("_hypot", jnp.hypot, aliases=("broadcast_hypot",))
+
+
+def _cmp(name, f, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(lhs, rhs, **attrs):
+        return f(lhs, rhs).astype(jnp.result_type(lhs))
+    _op.__name__ = name
+    return _op
+
+
+_cmp("_equal", jnp.equal, aliases=("broadcast_equal",))
+_cmp("_not_equal", jnp.not_equal, aliases=("broadcast_not_equal",))
+_cmp("_greater", jnp.greater, aliases=("broadcast_greater",))
+_cmp("_greater_equal", jnp.greater_equal, aliases=("broadcast_greater_equal",))
+_cmp("_lesser", jnp.less, aliases=("broadcast_lesser",))
+_cmp("_lesser_equal", jnp.less_equal, aliases=("broadcast_lesser_equal",))
+_cmp("_logical_and", jnp.logical_and, aliases=("broadcast_logical_and",))
+_cmp("_logical_or", jnp.logical_or, aliases=("broadcast_logical_or",))
+_cmp("_logical_xor", jnp.logical_xor, aliases=("broadcast_logical_xor",))
+
+
+# -- scalar variants (reference: elemwise_binary_scalar_op_*.cc) ------------
+def _scalar_op(name, f, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(x, scalar=0.0, **attrs):
+        return f(x, jnp.asarray(scalar, dtype=x.dtype))
+    _op.__name__ = name
+    return _op
+
+
+_scalar_op("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", jnp.subtract, aliases=("_MinusScalar",))
+_scalar_op("_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",))
+_scalar_op("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", jnp.divide, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x))
+_scalar_op("_power_scalar", jnp.power, aliases=("_PowerScalar",))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x), aliases=("_RPowerScalar",))
+_scalar_op("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_scalar_op("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+_scalar_op("_logical_and_scalar", lambda x, s: jnp.logical_and(x, s).astype(x.dtype))
+_scalar_op("_logical_or_scalar", lambda x, s: jnp.logical_or(x, s).astype(x.dtype))
+_scalar_op("_logical_xor_scalar", lambda x, s: jnp.logical_xor(x, s).astype(x.dtype))
+
+
+@register("smooth_l1")
+def _smooth_l1(x, scalar=1.0, **attrs):
+    """Reference: src/operator/tensor/elemwise_binary_scalar_op_extended.cc."""
+    s2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * x * x, absx - 0.5 / s2)
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum", "elemwise_sum"))
+def _add_n(*args, num_args=None, **attrs):
+    """Reference: src/ndarray/ndarray_function ElementwiseSum."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out

@@ -1,0 +1,56 @@
+"""Creation/init operators (reference: src/operator/tensor/init_op.h)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, normalize_tuple
+from ..base import dtype_np
+
+
+@register("_zeros", aliases=("zeros_like_shape",))
+def _zeros(shape=(), dtype="float32", ctx=None, **attrs):
+    return jnp.zeros(normalize_tuple(shape) if shape != () else (), dtype_np(dtype))
+
+
+@register("_ones")
+def _ones(shape=(), dtype="float32", ctx=None, **attrs):
+    return jnp.ones(normalize_tuple(shape) if shape != () else (), dtype_np(dtype))
+
+
+@register("_full")
+def _full(shape=(), value=0.0, dtype="float32", ctx=None, **attrs):
+    return jnp.full(normalize_tuple(shape), value, dtype_np(dtype))
+
+
+@register("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            dtype="float32", ctx=None, **attrs):
+    out = jnp.arange(start, stop, step, dtype=dtype_np(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_eye")
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None, **attrs):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=dtype_np(dtype))
+
+
+@register("zeros_like")
+def _zeros_like(x, **attrs):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def _ones_like(x, **attrs):
+    return jnp.ones_like(x)
+
+
+@register("shape_array")
+def _shape_array(x, **attrs):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def _size_array(x, **attrs):
+    return jnp.asarray([x.size], dtype=jnp.int64)

@@ -1,0 +1,227 @@
+"""Output/loss operators.
+
+Reference: ``src/operator/softmax_output-inl.h`` (SoftmaxOutput),
+``regression_output-inl.h`` (Linear/Logistic/MAE), ``make_loss`` /
+``MakeLoss`` (src/operator/make_loss-inl.h), ``svm_output-inl.h``.
+
+MXNet loss-layer semantics: the *forward* output is a prediction (e.g.
+softmax probabilities) but the *backward* ignores incoming head
+gradients and emits the loss gradient directly (the reference wires this
+through each op's explicit Backward).  We reproduce that with
+``jax.custom_vjp``: the executor seeds head gradients with ones, and the
+custom vjp discards the seed and returns the MXNet-defined gradient —
+so ``jax.grad`` of a bound symbol reproduces Executor.backward() exactly.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _softmax_fwd(data, multi_output):
+    return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         norm_batch, norm_valid, multi_output):
+    return _softmax_fwd(data, multi_output)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        norm_batch, norm_valid, multi_output):
+    out = _softmax_fwd(data, multi_output)
+    return out, (out, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, norm_batch,
+                        norm_valid, multi_output, res, g):
+    # reference backward (softmax_output-inl.h): grad = softmax - one_hot(label)
+    out, label = res
+    axis = 1 if multi_output else out.ndim - 1
+    lbl = label.astype(jnp.int32)
+    out_l = jnp.moveaxis(out, 1, -1) if multi_output else out
+    onehot = (lbl[..., None] == jnp.arange(out.shape[axis])).astype(out.dtype)
+    grad = out_l - onehot
+    valid = None
+    if use_ignore:
+        mask = (lbl != int(ignore_label)).astype(out.dtype)
+        grad = grad * mask[..., None]
+        valid = mask
+    scale = grad_scale
+    if norm_batch:
+        scale = scale / label.shape[0]
+        grad = grad * scale
+    elif norm_valid and valid is not None:
+        grad = grad * (scale / jnp.maximum(jnp.sum(valid), 1.0))
+    elif norm_valid:
+        grad = grad * (scale / float(label.size))
+    else:
+        grad = grad * scale
+    if multi_output:
+        grad = jnp.moveaxis(grad, -1, 1)
+    # incoming head gradient g is intentionally ignored (loss-layer contract)
+    return (grad.astype(out.dtype), jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0, **attrs):
+    return _softmax_output_core(
+        data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
+        normalization == "batch", normalization == "valid", bool(multi_output))
+
+
+def _make_regression(name, fwd_name, fwd, grad_fn):
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd(data)
+
+    def core_fwd(data, label, grad_scale):
+        out = fwd(data)
+        return out, (out, label)
+
+    def core_bwd(grad_scale, res, g):
+        out, label = res
+        num_out = out.size / out.shape[0]
+        grad = grad_fn(out, label) * (grad_scale / num_out)
+        return (grad.astype(out.dtype), jnp.zeros_like(label))
+
+    core.defvjp(core_fwd, core_bwd)
+
+    @register(name)
+    def _op(data, label, grad_scale=1.0, **attrs):
+        return core(data, label.reshape(data.shape), float(grad_scale))
+    _op.__name__ = fwd_name
+    return _op
+
+
+# reference: src/operator/regression_output-inl.h
+_make_regression("LinearRegressionOutput", "_linear_reg",
+                 lambda d: d, lambda o, l: o - l)
+_make_regression("MAERegressionOutput", "_mae_reg",
+                 lambda d: d, lambda o, l: jnp.sign(o - l))
+_make_regression("LogisticRegressionOutput", "_logistic_reg",
+                 lax.logistic, lambda o, l: o - l)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _make_loss_core(data, grad_scale, norm_batch):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, norm_batch):
+    return data, (data.shape, data.dtype)
+
+
+def _make_loss_bwd(grad_scale, norm_batch, res, g):
+    shape, dtype = res
+    scale = grad_scale / (shape[0] if norm_batch else 1)
+    return (jnp.full(shape, scale, dtype=dtype),)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss", aliases=("make_loss",))
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null", **attrs):
+    """Reference: src/operator/make_loss-inl.h."""
+    return _make_loss_core(data, float(grad_scale), normalization == "batch")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, reg, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg, use_linear, res, g):
+    data, label = res
+    lbl = label.astype(jnp.int32)
+    onehot = (lbl[:, None] == jnp.arange(data.shape[1])).astype(data.dtype)
+    sign = 2 * onehot - 1  # +1 at true class, -1 elsewhere
+    viol = (margin - sign * data) > 0
+    if use_linear:
+        grad = jnp.where(viol, -sign * reg, 0.0)
+    else:
+        grad = jnp.where(viol, -2 * (margin - sign * data) * sign * reg, 0.0)
+    return (grad.astype(data.dtype), jnp.zeros_like(label))
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput")
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **attrs):
+    """Reference: src/operator/svm_output-inl.h."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))
+
+
+@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss"))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", **attrs):
+    """CTC loss (reference: src/operator/contrib/ctc_loss-inl.h).
+
+    data: (T, N, C) activations (pre-softmax); label: (N, L) padded.
+    TPU-native: alpha recursion in log space via lax.scan — no warp-ctc."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    lbl = label.astype(jnp.int32)
+    L = lbl.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        pad = 0 if blank_label == "first" else -1
+        lab_len = jnp.sum(lbl != pad, axis=1).astype(jnp.int32)
+    dat_len = (data_lengths.astype(jnp.int32) if use_data_lengths and
+               data_lengths is not None else jnp.full((N,), T, jnp.int32))
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    neg_inf = jnp.asarray(-1e30, logp.dtype)
+    ext_valid = jnp.arange(S)[None, :] < (2 * lab_len + 1)[:, None]
+
+    def get_p(t_logp):
+        return jnp.take_along_axis(t_logp, ext, axis=1)
+
+    p0 = get_p(logp[0])
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(p0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, p0[:, 1], neg_inf))
+
+    same = jnp.concatenate(
+        [jnp.zeros((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+    def step(alpha, t):
+        a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(same, neg_inf, a2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        new_alpha = merged + get_p(logp[t])
+        new_alpha = jnp.where(ext_valid, new_alpha, neg_inf)
+        new_alpha = jnp.where((t < dat_len)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    endl = 2 * lab_len - 1
+    end_b = 2 * lab_len
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alpha, jnp.maximum(endl, 0)[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(alpha, end_b[:, None], axis=1)[:, 0])
+    return -ll

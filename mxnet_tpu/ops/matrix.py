@@ -1,0 +1,390 @@
+"""Shape-manipulation, indexing and linear-algebra operators.
+
+Reference: ``src/operator/tensor/matrix_op-inl.h`` (2,074 LoC: reshape/
+transpose/slice/tile/repeat/pad/flip...), ``indexing_op.h`` (Embedding,
+take, gather_nd, scatter_nd, one_hot), ``dot-inl.h`` (dot/batch_dot),
+``la_op.h`` (linalg).  TPU-native: dot/batch_dot become
+``lax.dot_general`` which maps 1:1 onto the MXU; gather/scatter become
+XLA gather/scatter HLOs; everything else is metadata-only reshaping that
+XLA folds away.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, normalize_tuple
+from ..base import MXNetError
+
+
+# -- dot / batch_dot (MXU path) --------------------------------------------
+@register("dot")
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, **attrs):
+    """Reference: src/operator/tensor/dot-inl.h.  On TPU: one MXU matmul."""
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    # MXNet dot contracts last axis of a with first axis of b (for ndim>2 too)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **attrs):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats, **attrs):
+    """Column-wise Khatri-Rao product (reference: src/operator/contrib/krprod.h)."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# -- reshape family ---------------------------------------------------------
+def _infer_reshape(src_shape, target):
+    """MXNet reshape spec with 0/-1/-2/-3/-4 codes
+    (reference: matrix_op-inl.h ReshapeParam/InferReshapeShape)."""
+    out, src_idx = [], 0
+    i = 0
+    target = list(target)
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src_shape[src_idx]); src_idx += 1
+        elif t == -1:
+            out.append(-1); src_idx += 1
+        elif t == -2:
+            out.extend(src_shape[src_idx:]); src_idx = len(src_shape)
+        elif t == -3:
+            out.append(src_shape[src_idx] * src_shape[src_idx + 1]); src_idx += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            cur = src_shape[src_idx]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); src_idx += 1; i += 2
+        else:
+            out.append(t); src_idx += 1
+        i += 1
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(x, shape=None, reverse=False, **attrs):
+    shape = normalize_tuple(shape)
+    if reverse:
+        tgt = _infer_reshape(x.shape[::-1], list(shape)[::-1])[::-1]
+    else:
+        tgt = _infer_reshape(x.shape, shape)
+    return jnp.reshape(x, tgt)
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(x, **attrs):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(x, axes=None, **attrs):
+    if axes is None or axes == ():
+        return jnp.transpose(x)
+    return jnp.transpose(x, normalize_tuple(axes))
+
+
+@register("expand_dims")
+def _expand_dims(x, axis=0, **attrs):
+    return jnp.expand_dims(x, axis)
+
+
+@register("squeeze")
+def _squeeze(x, axis=None, **attrs):
+    return jnp.squeeze(x, axis=axis if axis is None else normalize_tuple(axis))
+
+
+@register("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(x, dim1=0, dim2=0, **attrs):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register("depth_to_space")
+def _depth_to_space(x, block_size=1, **attrs):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(x, block_size=1, **attrs):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+# -- slicing ----------------------------------------------------------------
+@register("slice", aliases=("crop",))
+def _slice(x, begin=None, end=None, step=None, **attrs):
+    begin = normalize_tuple(begin) if begin is not None else ()
+    end_t = tuple(normalize_tuple(end)) if end is not None else ()
+    idx = []
+    for i in range(x.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end_t[i] if i < len(end_t) else None
+        s = None
+        if step is not None:
+            st = normalize_tuple(step)
+            s = st[i] if i < len(st) and st[i] != 0 else None
+        idx.append(slice(b, e, s))
+    return x[tuple(idx)]
+
+
+@register("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None, **attrs):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(x, like, axes=(), **attrs):
+    axes = normalize_tuple(axes) if axes else tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a % x.ndim] = slice(0, like.shape[a % x.ndim])
+    return x[tuple(idx)]
+
+
+@register("Concat", aliases=("concat",))
+def _concat(*args, dim=1, num_args=None, **attrs):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0, num_args=None, **attrs):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_nout(attrs):
+    return int(attrs.get("num_outputs", attrs.get("num_output", 1)))
+
+
+@register("SliceChannel", aliases=("split",), num_outputs=_split_nout)
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **attrs):
+    """Reference: src/operator/slice_channel-inl.h."""
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("tile")
+def _tile(x, reps=(), **attrs):
+    return jnp.tile(x, normalize_tuple(reps))
+
+
+@register("repeat")
+def _repeat(x, repeats=1, axis=None, **attrs):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(x, axis=(), **attrs):
+    return jnp.flip(x, axis=normalize_tuple(axis))
+
+
+@register("Pad", aliases=("pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **attrs):
+    """Reference: src/operator/pad-inl.h (pad_width in flattened pairs)."""
+    pw = normalize_tuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=constant_value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+# -- indexing ---------------------------------------------------------------
+@register("Embedding")
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+               sparse_grad=False, **attrs):
+    """Reference: src/operator/tensor/indexing_op.h EmbeddingOp.
+    On TPU this is one XLA gather riding HBM bandwidth."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("take")
+def _take(a, indices, axis=0, mode="clip", **attrs):
+    jmode = "clip" if mode in ("clip", "raise") else "wrap"
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
+
+
+@register("batch_take")
+def _batch_take(a, indices, **attrs):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1).squeeze(1)
+
+
+@register("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip", **attrs):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis is not None else -1)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot")
+def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **attrs):
+    from ..base import dtype_np
+    i = indices.astype(jnp.int32)
+    oh = (i[..., None] == jnp.arange(depth, dtype=jnp.int32))
+    return jnp.where(oh, on_value, off_value).astype(dtype_np(dtype))
+
+
+@register("gather_nd")
+def _gather_nd(data, indices, **attrs):
+    """Reference: indexing_op.h GatherND — indices shape (M, ...)."""
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, shape=None, **attrs):
+    shape = normalize_tuple(shape)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, rhs, shape=None, **attrs):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("where")
+def _where(condition, x, y, **attrs):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+# -- sequence ops (reference: src/operator/sequence_{mask,last,reverse}-inl.h)
+def _seq_len_mask(sequence_length, maxlen):
+    return jnp.arange(maxlen)[:, None] < sequence_length[None, :].astype(jnp.int32)
+
+
+@register("SequenceMask")
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                   value=0.0, axis=0, **attrs):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    if axis == 1:
+        data_t = jnp.swapaxes(data, 0, 1)
+    else:
+        data_t = data
+    mask = _seq_len_mask(sequence_length, data_t.shape[0])
+    mask = mask.reshape(mask.shape + (1,) * (data_t.ndim - 2))
+    out = jnp.where(mask, data_t, jnp.asarray(value, dtype=data.dtype))
+    return jnp.swapaxes(out, 0, 1) if axis == 1 else out
+
+
+@register("SequenceLast")
+def _sequence_last(data, sequence_length=None, use_sequence_length=False,
+                   axis=0, **attrs):
+    if axis == 1:
+        data = jnp.swapaxes(data, 0, 1)
+    if not use_sequence_length or sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (batch,)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse")
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                      axis=0, **attrs):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    maxlen = data.shape[0]
+    t = jnp.arange(maxlen)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(t < L, L - 1 - t, t)  # reverse first L steps, keep rest
+    src = src.reshape((maxlen, -1) + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)
+
+
+# -- linalg subset (reference: src/operator/tensor/la_op.h) -----------------
+@register("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **attrs):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False,
+                 alpha=1.0, beta=1.0, **attrs):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(A, **attrs):
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **attrs):
+    from jax.scipy.linalg import solve_triangular
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    lower_eff = (not lower) if transpose else lower
+    if rightside:
+        # X A = alpha B  <=>  A^T X^T = alpha B^T
+        xt = solve_triangular(jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+                              lower=not lower_eff)
+        return jnp.swapaxes(xt, -1, -2)
+    return solve_triangular(a, alpha * B, lower=lower_eff)
+
+
+@register("_linalg_trmm", aliases=("linalg_trmm",))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **attrs):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    a = jnp.swapaxes(tri, -1, -2) if transpose else tri
+    return alpha * (jnp.matmul(B, a) if rightside else jnp.matmul(a, B))
+
+
+@register("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(A, transpose=False, alpha=1.0, **attrs):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(A, **attrs):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("L2Normalization")
+def _l2_normalization(x, eps=1e-10, mode="instance", **attrs):
+    """Reference: src/operator/l2_normalization-inl.h."""
+    if mode == "instance":
+        ax = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    elif mode == "spatial":
+        ax = tuple(range(2, x.ndim))
+    else:
+        raise MXNetError("bad L2Normalization mode %s" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+    return x / norm

@@ -1,0 +1,169 @@
+"""Random sampling operators.
+
+Reference: ``src/operator/random/sample_op.h`` (uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial),
+``multisample_op.h`` (per-row distribution params), ``sample_multinomial_op``,
+``shuffle_op``; parallel RNG in ``src/common/random_generator.h``.
+
+TPU-native: jax's counter-based threefry RNG replaces the per-device
+RNG resource (ResourceRequest::kParallelRandom).  Every random op takes
+an explicit ``__rng__`` key injected by the runtime (global seeded state
+in eager mode, functionally threaded under jit) — deterministic,
+reproducible, and parallel-safe by construction.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, normalize_tuple
+from ..base import dtype_np
+
+
+def _shape(shape):
+    if shape is None or shape == ():
+        return ()
+    return normalize_tuple(shape)
+
+
+@register("_random_uniform", aliases=("uniform", "random_uniform"), needs_rng=True)
+def _uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
+             __rng__=None, **attrs):
+    return jax.random.uniform(__rng__, _shape(shape), dtype_np(dtype), low, high)
+
+
+@register("_random_normal", aliases=("normal", "random_normal"), needs_rng=True)
+def _normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
+            __rng__=None, **attrs):
+    return loc + scale * jax.random.normal(__rng__, _shape(shape), dtype_np(dtype))
+
+
+@register("_random_gamma", aliases=("random_gamma",), needs_rng=True)
+def _gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
+           __rng__=None, **attrs):
+    return beta * jax.random.gamma(__rng__, alpha, _shape(shape), dtype_np(dtype))
+
+
+@register("_random_exponential", aliases=("random_exponential",), needs_rng=True)
+def _exponential(lam=1.0, shape=(), dtype="float32", ctx=None, __rng__=None, **attrs):
+    return jax.random.exponential(__rng__, _shape(shape), dtype_np(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+def _poisson(lam=1.0, shape=(), dtype="float32", ctx=None, __rng__=None, **attrs):
+    return jax.random.poisson(__rng__, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          needs_rng=True)
+def _neg_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None,
+                  __rng__=None, **attrs):
+    k1, k2 = jax.random.split(__rng__)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",), needs_rng=True)
+def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None,
+                      __rng__=None, **attrs):
+    k1, k2 = jax.random.split(__rng__)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",), needs_rng=True)
+def _randint(low=0, high=1, shape=(), dtype="int32", ctx=None, __rng__=None, **attrs):
+    return jax.random.randint(__rng__, _shape(shape), low, high, dtype_np(dtype))
+
+
+# -- per-element-parameter sampling (reference: multisample_op.h) -----------
+@register("_sample_uniform", needs_rng=True)
+def _sample_uniform(low, high, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(__rng__, out_shape, dtype_np(dtype))
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register("_sample_normal", needs_rng=True)
+def _sample_normal(mu, sigma, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    z = jax.random.normal(__rng__, mu.shape + s, dtype_np(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(sigma.shape + (1,) * len(s))
+
+
+@register("_sample_gamma", needs_rng=True)
+def _sample_gamma(alpha, beta, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(__rng__, jnp.broadcast_to(a, alpha.shape + s)).astype(dtype_np(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register("_sample_exponential", needs_rng=True)
+def _sample_exponential(lam, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    e = jax.random.exponential(__rng__, lam.shape + s, dtype_np(dtype))
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("_sample_poisson", needs_rng=True)
+def _sample_poisson(lam, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    lam_b = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)), lam.shape + s)
+    return jax.random.poisson(__rng__, lam_b).astype(dtype_np(dtype))
+
+
+@register("_sample_negative_binomial", needs_rng=True)
+def _sample_negative_binomial(k, p, shape=(), dtype="float32", __rng__=None, **attrs):
+    s = _shape(shape)
+    k1, k2 = jax.random.split(__rng__)
+    kb = jnp.broadcast_to(k.reshape(k.shape + (1,) * len(s)), k.shape + s)
+    pb = jnp.broadcast_to(p.reshape(p.shape + (1,) * len(s)), p.shape + s)
+    lam = jax.random.gamma(k1, kb) * (1 - pb) / pb
+    return jax.random.poisson(k2, lam).astype(dtype_np(dtype))
+
+
+@register("_sample_generalized_negative_binomial", needs_rng=True)
+def _sample_gen_negative_binomial(mu, alpha, shape=(), dtype="float32",
+                                  __rng__=None, **attrs):
+    s = _shape(shape)
+    k1, k2 = jax.random.split(__rng__)
+    mub = jnp.broadcast_to(mu.reshape(mu.shape + (1,) * len(s)), mu.shape + s)
+    ab = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(s)), alpha.shape + s)
+    r = 1.0 / ab
+    p = r / (r + mub)
+    lam = jax.random.gamma(k1, r) * (1 - p) / p
+    return jax.random.poisson(k2, lam).astype(dtype_np(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",),
+          needs_rng=True, num_outputs=lambda attrs: 2 if attrs.get("get_prob") else 1)
+def _multinomial(data, shape=(), get_prob=False, dtype="int32", __rng__=None, **attrs):
+    """Reference: src/operator/random/sample_multinomial_op.h.
+    data: (..., K) probabilities (not logits)."""
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    flat = logits.reshape(-1, data.shape[-1])
+    samples = jax.random.categorical(__rng__, flat[:, None, :].repeat(max(n, 1), 1), axis=-1)
+    out = samples.reshape(data.shape[:-1] + (s if s else ()))
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(flat, axis=-1)[:, None, :].repeat(max(n, 1), 1),
+            samples[..., None], axis=-1)[..., 0]
+        return out, lp.reshape(out.shape).astype(jnp.float32)
+    return out
+
+
+@register("_shuffle", aliases=("shuffle",), needs_rng=True)
+def _shuffle(data, __rng__=None, **attrs):
+    return jax.random.permutation(__rng__, data, axis=0)

@@ -1,0 +1,140 @@
+"""Reduction and ordering operators.
+
+Reference: ``src/operator/tensor/broadcast_reduce_op.h`` (sum/mean/prod/
+max/min/norm with axis/keepdims/exclude), ``ordering_op*.cc`` (topk, sort,
+argsort, argmax, argmin).  TPU-native: all reductions are single XLA HLO
+reduce ops; topk/sort use ``lax.top_k``/``lax.sort`` which lower to the
+TPU sort unit — no cub/thrust equivalent needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, normalize_tuple
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+        return None if not exclude else ()
+    if isinstance(axis, int):
+        axis = (axis,)
+    ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(a for a in range(ndim) if a not in ax)
+    return ax
+
+
+def _reduce(name, f, aliases=()):
+    @register(name, aliases=aliases)
+    def _op(x, axis=None, keepdims=False, exclude=False, **attrs):
+        ax = _norm_axis(axis, x.ndim, exclude)
+        return f(x, axis=ax, keepdims=bool(keepdims))
+    _op.__name__ = name
+    return _op
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm")
+def _norm(x, ord=2, axis=None, keepdims=False, **attrs):
+    ax = _norm_axis(axis, x.ndim)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(keepdims))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims)))
+
+
+@register("argmax")
+def _argmax(x, axis=None, keepdims=False, **attrs):
+    out = jnp.argmax(x, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)  # reference returns real_t indices
+
+
+@register("argmin")
+def _argmin(x, axis=None, keepdims=False, **attrs):
+    out = jnp.argmin(x, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel")
+def _argmax_channel(x, **attrs):
+    """Reference: broadcast_reduce_op_index.cc argmax_channel."""
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+def _topk_nout(attrs):
+    ret_typ = attrs.get("ret_typ", "indices")
+    return 2 if ret_typ == "both" else 1
+
+
+@register("topk", num_outputs=_topk_nout)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **attrs):
+    """Reference: src/operator/tensor/ordering_op-inl.h TopK."""
+    axis = x.ndim - 1 if axis is None else axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(xm, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        mask = jnp.zeros(xm.shape, dtype=x.dtype)
+        mask = jnp.put_along_axis(
+            jnp.moveaxis(mask, axis, -1),
+            jnp.moveaxis(idx.astype(jnp.int32), axis, -1), 1.0, axis=-1,
+            inplace=False)
+        return jnp.moveaxis(mask, -1, axis)
+    return idx
+
+
+@register("sort")
+def _sort(x, axis=-1, is_ascend=True, **attrs):
+    out = jnp.sort(x, axis=axis if axis is not None else None)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register("argsort")
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32", **attrs):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+@register("broadcast_to")
+def _broadcast_to(x, shape=None, **attrs):
+    shape = normalize_tuple(shape)
+    # reference semantics: 0 in target shape keeps the source dim
+    tgt = tuple(s if s != 0 else x.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=(), size=(), **attrs):
+    axis = normalize_tuple(axis)
+    size = normalize_tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register("broadcast_like")
+def _broadcast_like(x, like, **attrs):
+    return jnp.broadcast_to(x, like.shape)

@@ -1,0 +1,131 @@
+"""Operator registry — the TPU-native replacement for the NNVM op registry.
+
+Reference contract being re-designed (not ported):
+- ``nnvm::Op`` global registry with typed attributes, consumed via
+  ``Op::GetAttr<FCompute>(...)`` (reference: src/imperative/imperative.cc:47,
+  include/mxnet/op_attr_types.h:107-257).
+- dmlc::Parameter attr structs that power Python kwargs/docstrings.
+
+TPU-native design: every operator is ONE pure jax function
+``fn(*arrays, **attrs) -> array | tuple``.  That single function plays all
+the reference's per-op roles at once:
+
+- ``FCompute``      -> the function body (jnp/lax/pallas), jit-compilable.
+- ``FInferShape``/``FInferType`` -> ``jax.eval_shape`` abstract evaluation.
+- ``FGradient``     -> ``jax.vjp`` (custom grads via ``jax.custom_vjp``
+                       inside the impl where MXNet semantics differ,
+                       e.g. SoftmaxOutput ignoring head gradients).
+- ``FStatefulCompute`` -> explicit state threading: stateful ops take and
+                       return state arrays (aux states, RNG keys) —
+                       no hidden mutation, so everything stays traceable.
+
+Context-dependent behaviour (train vs predict mode, RNG) is injected by
+the caller through reserved attrs ``__is_train__`` and ``__rng__`` —
+declared by the op via ``needs_is_train`` / ``needs_rng`` flags.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "coerce_attrs"]
+
+_OP_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """Metadata + implementation for one operator."""
+
+    def __init__(self, name, fn, *, num_outputs=1, aliases=(),
+                 needs_is_train=False, needs_rng=False,
+                 mutate_aux=(), attr_defaults=None, doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs  # int or callable(attrs)->int
+        self.aliases = tuple(aliases)
+        self.needs_is_train = needs_is_train
+        self.needs_rng = needs_rng
+        # names of inputs that are auxiliary state (returned updated as
+        # trailing outputs), e.g. BatchNorm moving_mean/moving_var
+        self.mutate_aux = tuple(mutate_aux)
+        self.attr_defaults = dict(attr_defaults or {})
+        self.doc = doc or (fn.__doc__ or "")
+
+    def n_outputs(self, attrs):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs)
+        return self.num_outputs
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+
+def register(name, *, num_outputs=1, aliases=(), needs_is_train=False,
+             needs_rng=False, mutate_aux=(), attr_defaults=None):
+    """Decorator: register a pure jax function as an operator."""
+
+    def _wrap(fn):
+        op = OpDef(name, fn, num_outputs=num_outputs, aliases=aliases,
+                   needs_is_train=needs_is_train, needs_rng=needs_rng,
+                   mutate_aux=mutate_aux, attr_defaults=attr_defaults)
+        for n in (name,) + tuple(aliases):
+            if n in _OP_REGISTRY:
+                raise MXNetError("duplicate op registration: %s" % n)
+            _OP_REGISTRY[n] = op
+        return fn
+
+    return _wrap
+
+
+def get_op(name):
+    op = _OP_REGISTRY.get(name)
+    if op is None:
+        raise MXNetError("operator %r is not registered" % name)
+    return op
+
+
+def has_op(name):
+    return name in _OP_REGISTRY
+
+
+def list_ops():
+    """All canonical op names (aliases excluded)."""
+    return sorted({op.name for op in _OP_REGISTRY.values()})
+
+
+# ---------------------------------------------------------------------------
+# attr coercion: symbol JSON and user kwargs carry attrs as strings
+# ("(2,2)", "True", "1e-3"); normalize to python values so op fns can use
+# them directly.  Mirrors dmlc::Parameter string parsing behaviourally.
+# ---------------------------------------------------------------------------
+_BOOL = {"true": True, "false": False, "True": True, "False": False}
+
+
+def _coerce(v):
+    if not isinstance(v, str):
+        return v
+    if v in _BOOL:
+        return _BOOL[v]
+    if v == "None":
+        return None
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def coerce_attrs(attrs):
+    return {k: _coerce(v) for k, v in attrs.items()}
+
+
+def normalize_tuple(x, n=None):
+    """'(2,2)' | 2 | (2,2) -> tuple; broadcast scalars to length n."""
+    x = _coerce(x)
+    if isinstance(x, (list, tuple)):
+        t = tuple(int(i) for i in x)
+    else:
+        t = (int(x),)
+    if n is not None and len(t) == 1:
+        t = t * n
+    return t

@@ -1,0 +1,31 @@
+"""Global RNG state.
+
+Reference: ``python/mxnet/random.py`` (mx.random.seed) backed by per-device
+RNG resources (src/common/random_generator.h, ResourceManager kRandom).
+
+TPU-native: one counter-based threefry key, split per draw.  Eager random
+ops consume keys from here; jitted executors thread keys functionally
+(each Executor/CachedOp holds its own key chain seeded from this state),
+so results are reproducible under ``mx.random.seed(n)`` in both modes.
+"""
+from __future__ import annotations
+
+import jax
+
+_STATE = {"key": None, "seed": 0, "count": 0}
+
+
+def seed(seed_state=0, ctx="all"):
+    """Reference: python/mxnet/random.py:28 (mx.random.seed)."""
+    _STATE["seed"] = int(seed_state)
+    _STATE["key"] = jax.random.key(int(seed_state))
+    _STATE["count"] = 0
+
+
+def next_key():
+    """Split a fresh subkey off the global chain (runtime internal)."""
+    if _STATE["key"] is None:
+        seed(0)
+    _STATE["key"], sub = jax.random.split(_STATE["key"])
+    _STATE["count"] += 1
+    return sub

@@ -1,0 +1,105 @@
+"""Symbol package (reference: python/mxnet/symbol/__init__.py).
+
+Provides the symbolic op namespace (``mx.sym.Convolution`` etc.) generated
+from the same op registry as the ndarray namespace.
+"""
+from __future__ import annotations
+
+import sys
+
+from .symbol import (  # noqa: F401
+    Symbol, var, Variable, Group, load, load_json, _SymNode, _op_input_names,
+)
+from ..name import NameManager
+from ..attribute import AttrScope
+from ..ops.registry import _OP_REGISTRY, get_op, coerce_attrs
+from . import random  # noqa: F401  (populated below)
+
+
+def _make_symbol_call(op_name, input_syms, attrs, name=None):
+    """Create an op node, auto-creating variables for unbound param inputs
+    (reference behaviour: symbol composition auto-creates `<name>_weight`,
+    `<name>_bias`, `<name>_moving_mean`... for missing inputs)."""
+    op = get_op(op_name)
+    hint = op.name.lower().replace("_v1", "")
+    if hint.startswith("_"):
+        hint = hint[1:]
+    name = NameManager.current().get(name, hint)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    scope_attrs = AttrScope.current().get({})
+    node_attrs = dict(scope_attrs)
+    node_attrs.update(attrs)
+
+    param_names = _op_input_names(op, attrs)
+    inputs = []
+    if isinstance(input_syms, tuple):
+        pos_syms, kw_syms = input_syms
+    elif isinstance(input_syms, dict):
+        pos_syms, kw_syms = [], input_syms
+    else:
+        pos_syms, kw_syms = list(input_syms), {}
+    if param_names and param_names[0] == "*data":
+        for s in pos_syms or list(kw_syms.values()):
+            inputs.append(s._heads[0])
+    else:
+        si = 0
+        for pi, pname in enumerate(param_names):
+            sym = kw_syms.get(pname)
+            # canonical-name aliasing: the reference calls every op's
+            # first input `data`; our fns may name it x/a/lhs
+            if sym is None and pi == 0 and "data" not in param_names:
+                sym = kw_syms.get("data")
+            if sym is None and si < len(pos_syms):
+                sym = pos_syms[si]
+                si += 1
+            if sym is None:
+                sym = var("%s_%s" % (name, pname))
+            inputs.append(sym._heads[0])
+    node = _SymNode(op, name, inputs, node_attrs)
+    n_out = op.n_outputs(coerce_attrs(node_attrs)) - len(op.mutate_aux)
+    if n_out < 1:  # NB: can't use builtins.max here — `max` is an op name
+        n_out = 1
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_sym_func(op_name, opdef):
+    def sym_func(*args, name=None, attr=None, **kwargs):
+        sym_kwargs = {}
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                attrs[k] = v
+        pos = [a for a in args if isinstance(a, Symbol)]
+        return _make_symbol_call(op_name, (pos, sym_kwargs), attrs, name=name)
+
+    sym_func.__name__ = op_name
+    sym_func.__doc__ = opdef.doc
+    return sym_func
+
+
+def _populate(module_name=__name__):
+    mod = sys.modules[module_name]
+    for opn, opdef in _OP_REGISTRY.items():
+        if not opn.isidentifier():
+            continue
+        if not hasattr(mod, opn):
+            setattr(mod, opn, _make_sym_func(opn, opdef))
+
+
+_populate()
+
+
+def zeros(shape, dtype="float32", **kwargs):
+    return _make_symbol_call("_zeros", [], {"shape": shape, "dtype": dtype})
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return _make_symbol_call("_ones", [], {"shape": shape, "dtype": dtype})
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return _make_symbol_call("_arange", [], {
+        "start": start, "stop": stop, "step": step, "repeat": repeat,
+        "dtype": dtype})

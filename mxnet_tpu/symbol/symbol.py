@@ -1,0 +1,763 @@
+"""Symbol — composable symbolic graph.
+
+Reference: ``nnvm::Symbol`` + ``python/mxnet/symbol/symbol.py`` (156
+methods: compose, infer_shape, simple_bind:1284, bind:1548, save/tojson).
+
+TPU-native redesign: the graph is a lightweight Python DAG of op nodes.
+There are no NNVM passes — binding lowers the whole graph to ONE pure jax
+function which ``jax.jit`` compiles to a single XLA program (the
+reference's GraphExecutor + PlanMemory + bulking collapse into XLA buffer
+assignment and fusion; SURVEY.md §2.6 TPU mapping).  Shape/type
+inference runs by abstract evaluation (``jax.eval_shape``) over the same
+function, combined with per-op *parameter* shape hooks that reproduce
+MXNet's bidirectional weight-shape inference (FInferShape).
+"""
+from __future__ import annotations
+
+import inspect
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, dtype_np
+from ..attribute import AttrScope
+from ..name import NameManager
+from ..ops.registry import get_op, has_op, coerce_attrs, OpDef
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class _SymNode:
+    """One graph node: a variable (op=None) or an op application."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "_sig_cache")
+
+    def __init__(self, op, name, inputs, attrs):
+        self.op = op          # OpDef or None for variables
+        self.name = name
+        self.inputs = inputs  # list of (_SymNode, out_index)
+        self.attrs = attrs    # dict (strings or python values)
+        self._sig_cache = None
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        return self.op.n_outputs(coerce_attrs(self.attrs))
+
+
+def _fn_input_names(op: OpDef):
+    """Positional array-input names of an op, by introspection of its fn.
+
+    Parameters without defaults are required array inputs; a few known
+    optional-array names are included when present (bias etc.)."""
+    sig = inspect.signature(op.fn)
+    required, optional = [], []
+    _optional_arrays = {"bias", "gamma", "state_cell", "sequence_length",
+                       "data_lengths", "label_lengths"}
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,):
+            required.append("*data")
+            break
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            continue
+        if p.default is inspect.Parameter.empty:
+            required.append(p.name)
+        elif p.name in _optional_arrays:
+            optional.append(p.name)
+    return required, optional
+
+
+def _op_input_names(op: OpDef, attrs):
+    req, opt = _fn_input_names(op)
+    names = list(req)
+    a = coerce_attrs(attrs)
+    if "bias" in opt and not a.get("no_bias", False):
+        names.append("bias")
+    if op.name == "RNN" and a.get("mode") == "lstm":
+        names.append("state_cell")
+    if op.name == "LeakyReLU":
+        if a.get("act_type") != "prelu" and "gamma" in names:
+            names.remove("gamma")
+    if op.name == "SequenceMask" or op.name == "SequenceLast" or op.name == "SequenceReverse":
+        if a.get("use_sequence_length"):
+            names.append("sequence_length")
+    return names
+
+
+class Symbol:
+    """A (multi-)output handle onto the symbolic graph."""
+
+    __slots__ = ("_heads",)
+
+    def __init__(self, heads):
+        self._heads = list(heads)  # list of (_SymNode, out_idx)
+
+    # -- identity / naming --------------------------------------------------
+    @property
+    def name(self):
+        if len(self._heads) == 1:
+            return self._heads[0][0].name
+        return None
+
+    def attr(self, key):
+        node = self._heads[0][0]
+        return node.attrs.get(key)
+
+    def list_attr(self):
+        return {k: v for k, v in self._heads[0][0].attrs.items()
+                if isinstance(v, str)}
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            d = {k: str(v) for k, v in node.attrs.items()}
+            if d:
+                out[node.name] = d
+        return out
+
+    def _set_attr(self, **kwargs):
+        self._heads[0][0].attrs.update(kwargs)
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group")
+
+    def __iter__(self):
+        for i in range(len(self.list_outputs())):
+            yield self[i]
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            index = names.index(index)
+        # index into the *expanded* output list
+        flat = self._flat_outputs()
+        return Symbol([flat[index]])
+
+    def _flat_outputs(self):
+        flat = []
+        for node, idx in self._heads:
+            flat.append((node, idx))
+        return flat
+
+    def __len__(self):
+        return len(self.list_outputs())
+
+    # -- graph walking ------------------------------------------------------
+    def _topo(self):
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for (src, _) in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for node, _ in self._heads:
+            visit(node)
+        return order
+
+    def get_internals(self):
+        """Reference: symbol.py get_internals — every node output as head."""
+        heads = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def get_children(self):
+        node = self._heads[0][0]
+        if not node.inputs:
+            return None
+        return Symbol([(src, i) for (src, i) in node.inputs])
+
+    def list_arguments(self):
+        """Variables excluding aux states, topo order (reference symbol.py)."""
+        aux = set(self._aux_nodes())
+        return [n.name for n in self._topo() if n.is_variable and id(n) not in aux]
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._heads:
+            if node.is_variable:
+                outs.append(node.name)
+            else:
+                n = node.num_outputs()
+                outs.append("%s_output" % node.name if n == 1
+                            else "%s_output%d" % (node.name, idx))
+        return outs
+
+    def _aux_nodes(self):
+        """ids of variable nodes feeding mutate_aux positions."""
+        aux = set()
+        for node in self._topo():
+            if node.is_variable or not node.op.mutate_aux:
+                continue
+            names = _op_input_names(node.op, node.attrs)
+            for pname, (src, _) in zip(names, node.inputs):
+                if pname in node.op.mutate_aux and src.is_variable:
+                    aux.add(id(src))
+        return aux
+
+    def list_auxiliary_states(self):
+        aux = self._aux_nodes()
+        return [n.name for n in self._topo() if n.is_variable and id(n) in aux]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_variable]
+
+    # -- composition sugar --------------------------------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        from . import _make_symbol_call
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _make_symbol_call(op, [a, b], {})
+        return _make_symbol_call(scalar_op, [self], {"scalar": float(other)})
+
+    def __add__(self, other):
+        return self._binop(other, "elemwise_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        if isinstance(other, Symbol):
+            return other.__sub__(self)
+        return self._binop(other, None, "_rminus_scalar")
+
+    def __mul__(self, other):
+        return self._binop(other, "elemwise_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        if isinstance(other, Symbol):
+            return other.__truediv__(self)
+        return self._binop(other, None, "_rdiv_scalar")
+
+    def __pow__(self, other):
+        return self._binop(other, "_power", "_power_scalar")
+
+    def __neg__(self):
+        return self._binop(-1.0, None, "_mul_scalar")
+
+    def __eq__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binop(other, "_equal", "_equal_scalar")
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, (Symbol, int, float)):
+            return self._binop(other, "_not_equal", "_not_equal_scalar")
+        return NotImplemented
+
+    def __gt__(self, other):
+        return self._binop(other, "_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binop(other, "_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return self._binop(other, "_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binop(other, "_lesser_equal", "_lesser_equal_scalar")
+
+    __hash__ = object.__hash__
+
+    def __copy__(self):
+        return Symbol(list(self._heads))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # convenience mirrors of common ops (subset of the codegen'd namespace)
+    def reshape(self, shape, **kw):
+        from . import _make_symbol_call
+        return _make_symbol_call("Reshape", [self], {"shape": shape, **kw})
+
+    def transpose(self, axes=None):
+        from . import _make_symbol_call
+        return _make_symbol_call("transpose", [self], {"axes": axes} if axes else {})
+
+    def sum(self, axis=None, keepdims=False):
+        from . import _make_symbol_call
+        return _make_symbol_call("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        from . import _make_symbol_call
+        return _make_symbol_call("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def astype(self, dtype):
+        from . import _make_symbol_call
+        return _make_symbol_call("Cast", [self], {"dtype": dtype})
+
+    def slice_axis(self, axis, begin, end):
+        from . import _make_symbol_call
+        return _make_symbol_call("slice_axis", [self],
+                                 {"axis": axis, "begin": begin, "end": end})
+
+    # -- inference ----------------------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """Reference: symbol.py infer_shape (MXSymbolInferShape)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        known = {}
+        if args:
+            for name, shp in zip(self.list_arguments(), args):
+                if shp is not None:
+                    known[name] = tuple(shp)
+        known.update({k: tuple(v) for k, v in kwargs.items()})
+        shapes, dtypes, aux_shapes = _infer_graph(self, known, {}, partial=partial)
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        out_shapes = [shapes[_head_key(h)] for h in self._flat_outputs()]
+        aux = [aux_shapes.get(n) for n in self.list_auxiliary_states()]
+        return arg_shapes, out_shapes, aux
+
+    def infer_type(self, *args, **kwargs):
+        """Reference: symbol.py infer_type (MXSymbolInferType).
+
+        Approximation: dtype propagates from the given inputs (defaulting
+        float32, honoring per-variable __dtype__ attrs and Cast ops);
+        exact dtypes materialize at bind via jax's own type rules."""
+        known = {}
+        if args:
+            for name, dt in zip(self.list_arguments(), args):
+                if dt is not None:
+                    known[name] = dtype_np(dt)
+        known.update({k: dtype_np(v) for k, v in kwargs.items()})
+        default = None
+        for v in known.values():
+            default = v
+            break
+        if default is None:
+            default = np.dtype(np.float32)
+        arg_types = []
+        for n in self.list_arguments():
+            if n in known:
+                arg_types.append(known[n])
+            else:
+                node = next(x for x in self._topo()
+                            if x.is_variable and x.name == n)
+                if "__dtype__" in node.attrs:
+                    arg_types.append(dtype_np(node.attrs["__dtype__"]))
+                else:
+                    arg_types.append(np.dtype(np.float32))
+        out_types = []
+        for node, _ in self._flat_outputs():
+            if not node.is_variable and node.op.name == "Cast":
+                out_types.append(dtype_np(coerce_attrs(node.attrs).get(
+                    "dtype", "float32")))
+            else:
+                out_types.append(default)
+        aux_types = [np.dtype(np.float32) for _ in self.list_auxiliary_states()]
+        return arg_types, out_types, aux_types
+
+    # -- evaluation / binding ----------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Reference: symbol.py:1284 -> GraphExecutor::Init (simple-bind)."""
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
+                                     shared_exec=shared_exec)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Reference: symbol.py:1548 -> GraphExecutor::Init (legacy bind)."""
+        from ..executor import Executor
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states,
+                              shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        exe = self.bind(ctx, kwargs)
+        return exe.forward()
+
+    def __call__(self, *args, **kwargs):
+        """Compose: replace variable inputs with given symbols."""
+        s = self.__copy__()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _compose(self, *args, **kwargs):
+        name = kwargs.pop("name", None)
+        mapping = {}
+        if args:
+            free = [n for n in self._topo() if n.is_variable]
+            for node, repl in zip(free, args):
+                mapping[id(node)] = repl._heads[0]
+        for k, v in kwargs.items():
+            for node in self._topo():
+                if node.is_variable and node.name == k:
+                    mapping[id(node)] = v._heads[0]
+        if not mapping:
+            return
+        memo = {}
+
+        def rebuild(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if id(node) in mapping:
+                res = mapping[id(node)][0]
+            elif node.is_variable:
+                res = node
+            else:
+                res = _SymNode(node.op, node.name,
+                               [(rebuild(s), i) for (s, i) in node.inputs],
+                               dict(node.attrs))
+            memo[id(node)] = res
+            return res
+
+        self._heads = [(rebuild(n), i) for (n, i) in self._heads]
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        """Schema-compatible with the reference's nnvm JSON (LoadJSON pass),
+        so graphs interchange at the JSON level."""
+        nodes = self._topo()
+        nid = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable else n.op.name,
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(s)], i, 0] for (s, i) in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(nodes) if n.is_variable]
+        heads = [[nid[id(n)], i, 0] for (n, i) in self._heads]
+        return json.dumps({
+            "nodes": jnodes, "arg_nodes": arg_nodes, "heads": heads,
+            "attrs": {"mxnet_version": ["int", 10200]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def debug_str(self):
+        lines = []
+        for n in self._topo():
+            kind = "Variable" if n.is_variable else n.op.name
+            lines.append("%s %s <- %s" % (kind, n.name,
+                                          [s.name for (s, _) in n.inputs]))
+        return "\n".join(lines)
+
+
+def _head_key(head):
+    node, idx = head
+    return (id(node), idx)
+
+
+# ---------------------------------------------------------------------------
+# graph lowering + inference (the executor uses these too)
+# ---------------------------------------------------------------------------
+def build_graph_fn(symbol, arg_names, aux_names, is_train):
+    """Lower the symbol DAG to one pure function
+    fn(arg_list, aux_list, rng_key) -> (outputs, new_aux_list)."""
+    nodes = symbol._topo()
+    aux_index = {name: i for i, name in enumerate(aux_names)}
+    arg_index = {name: i for i, name in enumerate(arg_names)}
+
+    def fn(args, aux, rng_key):
+        env = {}
+        new_aux = list(aux)
+        for node_id, node in enumerate(nodes):
+            if node.is_variable:
+                if node.name in aux_index:
+                    env[(id(node), 0)] = aux[aux_index[node.name]]
+                elif node.name in arg_index:
+                    env[(id(node), 0)] = args[arg_index[node.name]]
+                else:
+                    raise MXNetError("unbound variable %s" % node.name)
+                continue
+            op = node.op
+            attrs = coerce_attrs(node.attrs)
+            attrs = {k: v for k, v in attrs.items()
+                     if k not in ("__layout__",) and not k.startswith("__")}
+            kw = dict(op.attr_defaults)
+            kw.update(attrs)
+            if op.needs_is_train:
+                kw["__is_train__"] = is_train
+            if op.needs_rng:
+                kw["__rng__"] = jax.random.fold_in(rng_key, node_id)
+            ins = [env[(id(s), i)] for (s, i) in node.inputs]
+            outs = op.fn(*ins, **kw)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            n_aux = len(op.mutate_aux)
+            if n_aux:
+                # write updated aux back (functional thread-through)
+                for (pname, new_val) in zip(op.mutate_aux, outs[-n_aux:]):
+                    names = _op_input_names(op, node.attrs)
+                    for nm, (src, _) in zip(names, node.inputs):
+                        if nm == pname and src.is_variable and src.name in aux_index:
+                            new_aux[aux_index[src.name]] = new_val
+                outs = outs[:len(outs) - n_aux]
+            for i, o in enumerate(outs):
+                env[(id(node), i)] = o
+        outputs = [env[(id(n), i)] for (n, i) in symbol._flat_outputs()]
+        return outputs, new_aux
+
+    return fn
+
+
+def _infer_graph(symbol, known_shapes, known_dtypes, partial=False):
+    """Topo-walk shape/type inference via jax.eval_shape + param hooks."""
+    nodes = symbol._topo()
+    shapes = dict(known_shapes)
+    dtypes = dict(known_dtypes)
+    env = {}  # (node_id, idx) -> ShapeDtypeStruct
+    aux_names = set(symbol.list_auxiliary_states())
+    aux_shapes = {}
+    key = jax.random.key(0)
+
+    for node_id, node in enumerate(nodes):
+        if node.is_variable:
+            shp = shapes.get(node.name)
+            if shp is None:
+                if "__shape__" in node.attrs:
+                    shp = tuple(coerce_attrs(node.attrs)["__shape__"])
+            dt = dtypes.get(node.name)
+            if dt is None and "__dtype__" in node.attrs:
+                dt = dtype_np(node.attrs["__dtype__"])
+            if shp is not None:
+                env[(id(node), 0)] = jax.ShapeDtypeStruct(
+                    shp, dt if dt is not None else np.float32)
+                shapes[node.name] = tuple(shp)
+                if node.name in aux_names:
+                    aux_shapes[node.name] = tuple(shp)
+            continue
+        op = node.op
+        attrs = coerce_attrs(node.attrs)
+        attrs = {k: v for k, v in attrs.items() if not k.startswith("__")}
+        names = _op_input_names(op, node.attrs)
+        # param-shape hook: fill in unknown variable inputs
+        ins_known = {}
+        for nm, (src, i) in zip(names, node.inputs):
+            st = env.get((id(src), i))
+            if st is not None:
+                ins_known[nm] = st.shape
+        hook = _PARAM_SHAPE_HOOKS.get(op.name)
+        if hook is not None:
+            try:
+                inferred = hook(attrs, ins_known)
+            except (KeyError, TypeError):
+                inferred = {}
+            for nm, (src, i) in zip(names, node.inputs):
+                if (id(src), i) not in env and nm in inferred and src.is_variable:
+                    shp = tuple(int(d) for d in inferred[nm])
+                    dt = dtypes.get(src.name, np.float32)
+                    env[(id(src), i)] = jax.ShapeDtypeStruct(shp, dt)
+                    shapes[src.name] = shp
+                    if src.name in aux_names:
+                        aux_shapes[src.name] = shp
+        ins = []
+        missing = False
+        for (src, i) in node.inputs:
+            st = env.get((id(src), i))
+            if st is None:
+                missing = True
+                break
+            ins.append(st)
+        if missing:
+            if partial:
+                continue
+            unk = [s.name for (s, i) in node.inputs if (id(s), i) not in env]
+            raise MXNetError(
+                "cannot infer shape for inputs %s of node %s (%s)"
+                % (unk, node.name, op.name))
+        kw = dict(op.attr_defaults)
+        kw.update(attrs)
+        if op.needs_is_train:
+            kw["__is_train__"] = False
+        if op.needs_rng:
+            kw["__rng__"] = key
+
+        out_struct = jax.eval_shape(lambda *xs: op.fn(*xs, **kw), *ins)
+        if not isinstance(out_struct, (tuple, list)):
+            out_struct = (out_struct,)
+        n_aux = len(op.mutate_aux)
+        vis = out_struct[:len(out_struct) - n_aux] if n_aux else out_struct
+        for i, st in enumerate(vis):
+            env[(id(node), i)] = st
+    out_shape_map = {}
+    for (n, i) in symbol._flat_outputs():
+        st = env.get((id(n), i))
+        out_shape_map[(id(n), i)] = tuple(st.shape) if st is not None else None
+    shapes.update(out_shape_map)
+    return shapes, dtypes, aux_shapes
+
+
+# per-op parameter-shape inference (the FInferShape weight logic)
+def _fc_shapes(attrs, known):
+    d = known["data"]
+    nh = attrs["num_hidden"]
+    flat = attrs.get("flatten", True)
+    in_dim = int(np.prod(d[1:])) if flat else d[-1]
+    out = {"weight": (nh, in_dim)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nh,)
+    return out
+
+
+def _conv_shapes(attrs, known):
+    d = known["data"]
+    k = attrs["kernel"]
+    if isinstance(k, int):
+        k = (k,)
+    nf = attrs["num_filter"]
+    ng = attrs.get("num_group", 1)
+    out = {"weight": (nf, d[1] // ng) + tuple(k)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _deconv_shapes(attrs, known):
+    d = known["data"]
+    k = attrs["kernel"]
+    if isinstance(k, int):
+        k = (k,)
+    nf = attrs["num_filter"]
+    ng = attrs.get("num_group", 1)
+    out = {"weight": (d[1], nf // ng) + tuple(k)}
+    if not attrs.get("no_bias", True):
+        out["bias"] = (nf,)
+    return out
+
+
+def _bn_shapes(attrs, known):
+    c = known["data"][attrs.get("axis", 1)]
+    return {"gamma": (c,), "beta": (c,), "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _ln_shapes(attrs, known):
+    c = known["data"][attrs.get("axis", -1)]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _in_shapes(attrs, known):
+    c = known["data"][1]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embed_shapes(attrs, known):
+    return {"weight": (attrs["input_dim"], attrs["output_dim"])}
+
+
+def _prelu_shapes(attrs, known):
+    if attrs.get("act_type") == "prelu":
+        d = known["data"]
+        return {"gamma": (d[1] if len(d) > 1 else 1,)}
+    return {}
+
+
+def _rnn_param_size(attrs, known):
+    d = known["data"]
+    I = d[2]
+    H = attrs["state_size"]
+    L = attrs["num_layers"]
+    D = 2 if attrs.get("bidirectional", False) else 1
+    mode = attrs.get("mode", "lstm")
+    G = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+    size = 0
+    for layer in range(L):
+        in_sz = I if layer == 0 else H * D
+        size += D * (G * H * in_sz + G * H * H)
+    size += L * D * 2 * G * H
+    N = d[1]
+    out = {"params": (size,), "state": (L * D, N, H)}
+    if mode == "lstm":
+        out["state_cell"] = (L * D, N, H)
+    return out
+
+
+_PARAM_SHAPE_HOOKS = {
+    "FullyConnected": _fc_shapes,
+    "Convolution": _conv_shapes,
+    "Convolution_v1": _conv_shapes,
+    "Deconvolution": _deconv_shapes,
+    "BatchNorm": _bn_shapes,
+    "BatchNorm_v1": _bn_shapes,
+    "LayerNorm": _ln_shapes,
+    "InstanceNorm": _in_shapes,
+    "Embedding": _embed_shapes,
+    "LeakyReLU": _prelu_shapes,
+    "RNN": _rnn_param_size,
+}
+
+
+# ---------------------------------------------------------------------------
+# construction helpers
+# ---------------------------------------------------------------------------
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    attrs = AttrScope.current().get(attr or {})
+    attrs = dict(attrs)
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(np.dtype(dtype_np(dtype)))
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else init.dumps()
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(_SymNode(None, name, [], attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    heads = []
+    for s in symbols:
+        heads.extend(s._flat_outputs())
+    return Symbol(heads)
+
+
+def load_json(json_str):
+    """Reconstruct a Symbol from JSON (reference: nnvm LoadJSON pass +
+    legacy_json_util.cc upgrade path)."""
+    g = json.loads(json_str)
+    nodes = []
+    for jn in g["nodes"]:
+        attrs = jn.get("attrs", jn.get("param", {})) or {}
+        if jn["op"] == "null":
+            nodes.append(_SymNode(None, jn["name"], [], dict(attrs)))
+        else:
+            op = get_op(jn["op"])
+            inputs = [(nodes[i[0]], i[1]) for i in jn["inputs"]]
+            nodes.append(_SymNode(op, jn["name"], inputs, dict(attrs)))
+    heads = [(nodes[h[0]], h[1]) for h in g["heads"]]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
