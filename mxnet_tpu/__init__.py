@@ -1,4 +1,8 @@
-"""mxnet_tpu — bring-up __init__ (core only; full init staged in)."""
+"""mxnet_tpu — a TPU-native framework with MXNet 1.2 capabilities.
+
+Structure mirrors the reference Python package (python/mxnet/__init__.py)
+while the implementation is idiomatic jax/XLA/pjit/Pallas throughout.
+"""
 from .libinfo import __version__  # noqa: F401
 from .base import MXNetError  # noqa: F401
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus  # noqa: F401
@@ -7,3 +11,13 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import random  # noqa: F401
 from . import autograd  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from .symbol import Symbol  # noqa: F401
+from . import executor  # noqa: F401
+from .executor import Executor  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import name  # noqa: F401
+from .name import NameManager, Prefix  # noqa: F401
+from . import test_utils  # noqa: F401

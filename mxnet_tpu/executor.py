@@ -35,6 +35,16 @@ from .symbol.symbol import build_graph_fn, _infer_graph
 __all__ = ["Executor"]
 
 
+# ops whose backward defines its own head gradient (label-based), so
+# backward() with no out_grads is meaningful — the reference's loss-output
+# contract (SoftmaxOutput ignores head grads, graph_executor Gradient pass)
+_LOSS_OPS = frozenset({
+    "SoftmaxOutput", "Softmax", "MakeLoss", "make_loss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput", "SVMOutput",
+})
+
+
 class Executor:
     """A bound, compiled computation (reference: python/mxnet/executor.py:45)."""
 
@@ -58,6 +68,9 @@ class Executor:
         self._outputs = None
         self._cached_grads = None
         self._monitor_callback = None
+        self._is_loss_graph = bool(symbol._flat_outputs()) and all(
+            (not n.is_variable) and n.op.name in _LOSS_OPS
+            for (n, _i) in symbol._flat_outputs())
         # seeded off the global mx.random chain so runs reproduce under
         # mx.random.seed(n) (see random.py docstring)
         from . import random as _mxrandom
@@ -113,7 +126,12 @@ class Executor:
                 arg_dict[n] = shared_exec.arg_dict[n]
             else:
                 arg_dict[n] = nd_zeros(shp, ctx=ctx, dtype=dt)
-        req = grad_req if isinstance(grad_req, dict) else {n: grad_req for n in arg_names}
+        if isinstance(grad_req, dict):
+            req = grad_req
+        elif isinstance(grad_req, (list, tuple)):
+            req = dict(zip(arg_names, grad_req))
+        else:
+            req = {n: grad_req for n in arg_names}
         for n in arg_names:
             if req.get(n, "null") != "null":
                 grad_dict[n] = nd_zeros(arg_dict[n].shape, ctx=ctx,
@@ -181,7 +199,14 @@ class Executor:
         return [self.aux_dict[n]._data for n in self.aux_names]
 
     def forward(self, is_train=False, **kwargs):
-        """Reference: executor.py:113 -> GraphExecutor::Forward."""
+        """Reference: executor.py:113 -> GraphExecutor::Forward.
+
+        For loss-headed graphs (the Module.fit hot path) a training
+        forward runs ONE fused fwd+bwd XLA program and caches gradients
+        for the no-args backward() — the reference's bulked segments,
+        compiler-scheduled.  For feature graphs (head grads unknown until
+        backward(out_grads)) it runs forward only; backward dispatches
+        the fused program once with the real seeds."""
         for k, v in kwargs.items():
             if k not in self.arg_dict:
                 raise MXNetError("unknown forward argument %r" % k)
@@ -191,7 +216,7 @@ class Executor:
             else:
                 tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)
         args, aux, key = self._args(), self._aux(), self._next_key()
-        if is_train and self._diff_idx:
+        if is_train and self._diff_idx and self._is_loss_graph:
             seeds = self._default_seeds(args, aux, key)
             outs, grads, new_aux = self._jit_fb(args, aux, key, seeds)
             self._cached_grads = grads
@@ -199,13 +224,16 @@ class Executor:
             outs, new_aux = (self._jit_fwd_train(args, aux, key) if is_train
                              else self._jit_fwd_eval(args, aux, key))
             self._cached_grads = None
+        self._commit(outs, new_aux)
+        return self._outputs
+
+    def _commit(self, outs, new_aux):
         for n, a in zip(self.aux_names, new_aux):
             self.aux_dict[n]._data = a
         self._outputs = [_wrap(o) for o in outs]
         if self._monitor_callback is not None:
             for name, o in zip(self.output_names, self._outputs):
                 self._monitor_callback(name, o)
-        return self._outputs
 
     def _default_seeds(self, args, aux, key):
         sig = tuple(a.shape for a in args)
@@ -235,7 +263,8 @@ class Executor:
         else:
             if self._cached_grads is None:
                 raise MXNetError(
-                    "backward() without out_grads requires forward(is_train=True)")
+                    "backward() without out_grads requires a loss-output "
+                    "graph and a preceding forward(is_train=True)")
             grads = self._cached_grads
         for j, i in enumerate(self._diff_idx):
             n = self.arg_names[i]

@@ -481,8 +481,18 @@ class NDArray:
             if isinstance(value, NDArray):
                 self._ag_slot = value._ag_slot
         else:
-            self._data = self._data.at[key].set(
-                v if not hasattr(v, "astype") else v.astype(self._data.dtype))
+            # route through invoke_fn so a recorded tape entry routes
+            # cotangents through the scatter (zero at overwritten slots)
+            inputs = [self] + ([value] if isinstance(value, NDArray) else [])
+
+            def _set(x, *maybe_v):
+                vv = maybe_v[0] if maybe_v else v
+                return x.at[key].set(
+                    vv if not hasattr(vv, "astype") else vv.astype(x.dtype))
+
+            res = invoke_fn(_set, inputs)
+            self._data = res._data
+            self._ag_slot = res._ag_slot
 
     def __iter__(self):
         for i in range(self.shape[0]):
@@ -506,10 +516,15 @@ def array(source_array, ctx=None, dtype=None):
         if dtype is not None:
             data = data.astype(dtype_np(dtype))
         return NDArray(data, ctx=ctx)
-    a = np.asarray(source_array, dtype=dtype_np(dtype) if dtype is not None
-                   else None)
-    if a.dtype == np.float64 and dtype is None:
-        a = a.astype(np.float32)  # MXNet default dtype
+    if dtype is not None:
+        a = np.asarray(source_array, dtype=dtype_np(dtype))
+    elif isinstance(source_array, np.ndarray):
+        a = source_array
+        if a.dtype == np.float64:
+            a = a.astype(np.float32)  # MXNet default dtype
+    else:
+        # python lists/scalars default to float32 (reference: ndarray.py array)
+        a = np.asarray(source_array, dtype=np.float32)
     return NDArray(jnp.asarray(a), ctx=ctx)
 
 

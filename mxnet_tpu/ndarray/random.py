@@ -5,8 +5,11 @@ from ..imperative import invoke
 
 
 def _sample(op_scalar, op_tensor, params, shape, dtype, ctx, out, kwargs):
-    from .ndarray import NDArray
+    from .ndarray import NDArray, array as nd_array
     if any(isinstance(p, NDArray) for p in params):
+        # mixed scalar/array params: lift scalars to 0-d arrays (broadcast)
+        params = [p if isinstance(p, NDArray) else nd_array(float(p))
+                  for p in params]
         return invoke(op_tensor, list(params),
                       dict(shape=shape, dtype=dtype, **kwargs), out=out)
     attrs = dict(shape=shape if shape is not None else (), dtype=dtype, **kwargs)

@@ -13,12 +13,39 @@ from ..imperative import invoke
 from ..ops.registry import _OP_REGISTRY
 
 
+def _array_param_order(opdef):
+    """Positional parameter names of the op fn, in declaration order, so
+    keyword-passed array inputs bind to the right slots (the reference
+    binds by the op's declared input names, c_api_ndarray.cc)."""
+    import inspect
+    names = []
+    for p in inspect.signature(opdef.fn).parameters.values():
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            return None  # variadic op: keep call order
+        if p.kind == inspect.Parameter.VAR_KEYWORD:
+            continue
+        names.append(p.name)
+    return names
+
+
 def _make_op_func(name, opdef):
+    param_order = _array_param_order(opdef)
+
     def op_func(*args, out=None, name=None, **kwargs):
         from .ndarray import NDArray
         nd_inputs = [a for a in args if isinstance(a, NDArray)]
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
-        nd_inputs += [v for v in kwargs.values() if isinstance(v, NDArray)]
+        nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+        if nd_kwargs and param_order is not None:
+            # slot named arrays by the fn's declared order after positionals
+            rest = [pn for pn in param_order[len(nd_inputs):] if pn in nd_kwargs]
+            unknown = set(nd_kwargs) - set(rest)
+            if unknown:  # aliasing: reference calls every first input `data`
+                rest = sorted(nd_kwargs, key=lambda k: param_order.index(k)
+                              if k in param_order else len(param_order))
+            nd_inputs += [nd_kwargs[pn] for pn in rest]
+        else:
+            nd_inputs += list(nd_kwargs.values())
         return invoke(opdef, nd_inputs, attrs, out=out)
 
     op_func.__name__ = name

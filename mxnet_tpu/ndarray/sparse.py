@@ -150,9 +150,11 @@ def empty(stype, shape, ctx=None, dtype=None):
 
 
 def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, (CSRNDArray, RowSparseNDArray)):
+        return source_array.__class__(source_array._data)
     a = np.asarray(source_array if not isinstance(source_array, NDArray)
                    else source_array.asnumpy(), dtype=dtype_np(dtype) if dtype else None)
-    return csr_matrix(a) if False else RowSparseNDArray(jnp.asarray(a))
+    return RowSparseNDArray(jnp.asarray(a))
 
 
 sparse_array = array

@@ -39,7 +39,7 @@ _unary("round", jnp.round)
 _unary("ceil", jnp.ceil)
 _unary("floor", jnp.floor)
 _unary("trunc", jnp.trunc)
-_unary("fix", jnp.fix)
+_unary("fix", jnp.trunc)  # fix == round toward zero
 _unary("square", jnp.square)
 _unary("sqrt", jnp.sqrt)
 _unary("rsqrt", lax.rsqrt)

@@ -16,8 +16,7 @@ from .registry import register, normalize_tuple
 
 def _norm_axis(axis, ndim, exclude=False):
     if axis is None or axis == ():
-        ax = tuple(range(ndim))
-        return None if not exclude else ()
+        return None  # full reduction regardless of exclude (MXNet semantics)
     if isinstance(axis, int):
         axis = (axis,)
     ax = tuple(a % ndim for a in axis)

@@ -692,7 +692,27 @@ def _rnn_param_size(attrs, known):
     return out
 
 
+def _softmax_output_shapes(attrs, known):
+    d = known["data"]
+    if attrs.get("multi_output", False):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+def _regression_label_shapes(attrs, known):
+    return {"label": tuple(known["data"])}
+
+
+def _svm_label_shapes(attrs, known):
+    return {"label": (known["data"][0],)}
+
+
 _PARAM_SHAPE_HOOKS = {
+    "SoftmaxOutput": _softmax_output_shapes,
+    "LinearRegressionOutput": _regression_label_shapes,
+    "LogisticRegressionOutput": _regression_label_shapes,
+    "MAERegressionOutput": _regression_label_shapes,
+    "SVMOutput": _svm_label_shapes,
     "FullyConnected": _fc_shapes,
     "Convolution": _conv_shapes,
     "Convolution_v1": _conv_shapes,

@@ -1,0 +1,170 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(x.grad, [2.0, 4.0, 6.0])
+
+
+def test_chain_grad():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * x
+    y.backward()
+    ex = np.exp([0.5, 1.0])
+    assert_almost_equal(x.grad, ex * np.array([0.5, 1.0]) + ex, rtol=1e-5)
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([2.0, 4.0]))
+    assert_almost_equal(x.grad, [6.0, 12.0])
+
+
+def test_grad_add_req():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad, [4.0, 5.0])
+    assert_almost_equal(b.grad, [1.0, 2.0])
+
+
+def test_reuse_input():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    assert_almost_equal(x.grad, [12.0])
+
+
+def test_matmul_grad():
+    w = nd.array(np.random.rand(3, 2).astype(np.float32))
+    x = nd.array(np.random.rand(4, 3).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w).sum()
+    y.backward()
+    assert_almost_equal(w.grad, x.asnumpy().T @ np.ones((4, 2), np.float32),
+                        rtol=1e-5)
+
+
+def test_recording_state():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    assert not autograd.is_recording()
+
+
+def test_no_grad_outside_record():
+    x = nd.array([1.0])
+    x.attach_grad()
+    y = x * 5  # not recorded
+    assert y._ag_slot is None
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, [4.0])  # only dz/dx through the second factor
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 1.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 7
+    y.backward()
+    assert_almost_equal(x.grad, [7.0, 7.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([3.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_softmax_ce_grad():
+    x = nd.array(np.random.uniform(-1, 1, (4, 5)).astype(np.float32))
+    x.attach_grad()
+    label = np.random.randint(0, 5, 4)
+    with autograd.record():
+        p = nd.log_softmax(x)
+        loss = -p.pick(nd.array(label, dtype="int32"), axis=1).sum()
+    loss.backward()
+    sm = np.exp(x.asnumpy()) / np.exp(x.asnumpy()).sum(1, keepdims=True)
+    expected = sm.copy()
+    expected[np.arange(4), label] -= 1.0
+    assert_almost_equal(x.grad, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_training_flag_dropout():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = mx.nd.Dropout(x, p=0.5)
+    assert abs(float(y.asnumpy().mean()) - 1.0) < 0.2
+    with autograd.predict_mode():
+        z = mx.nd.Dropout(x, p=0.5)
+    assert_almost_equal(z, x.asnumpy())
+
+
+def test_setitem_during_record():
+    # partial assignment must zero the cotangent at overwritten slots
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y[0] = 0.0
+        loss = y.sum()
+    loss.backward()
+    assert_almost_equal(x.grad, [0.0, 2.0, 2.0])

@@ -1,0 +1,221 @@
+"""NDArray tests (reference: tests/python/unittest/test_ndarray.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_array_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32  # MXNet default dtype, even from float64
+    assert same(a, np.array([[1, 2], [3, 4]], dtype=np.float32))
+    b = nd.array(np.arange(6).reshape(2, 3), dtype="int32")
+    assert b.dtype == np.int32
+
+
+def test_zeros_ones_full_arange():
+    assert same(nd.zeros((2, 3)), np.zeros((2, 3), np.float32))
+    assert same(nd.ones((4,)), np.ones(4, np.float32))
+    assert same(nd.full((2, 2), 7), np.full((2, 2), 7, np.float32))
+    assert same(nd.arange(0, 10, 2), np.arange(0, 10, 2, dtype=np.float32))
+    assert same(nd.arange(0, 3, 1, repeat=2),
+                np.repeat(np.arange(0, 3, dtype=np.float32), 2))
+
+
+def test_elementwise_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, [5, 7, 9])
+    assert_almost_equal(a - b, [-3, -3, -3])
+    assert_almost_equal(a * b, [4, 10, 18])
+    assert_almost_equal(b / a, [4, 2.5, 2])
+    assert_almost_equal(a + 1, [2, 3, 4])
+    assert_almost_equal(1 - a, [0, -1, -2])
+    assert_almost_equal(2 * a, [2, 4, 6])
+    assert_almost_equal(6 / a, [6, 3, 2])
+    assert_almost_equal(a ** 2, [1, 4, 9])
+    assert_almost_equal(2 ** a, [2, 4, 8])
+    assert_almost_equal(-a, [-1, -2, -3])
+    assert_almost_equal(a % 2, [1, 0, 1])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert_almost_equal(a, [2, 3])
+    a *= 2
+    assert_almost_equal(a, [4, 6])
+    a -= 1
+    assert_almost_equal(a, [3, 5])
+    a /= 2
+    assert_almost_equal(a, [1.5, 2.5])
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert same(a == b, [0, 1, 0])
+    assert same(a != b, [1, 0, 1])
+    assert same(a > b, [0, 0, 1])
+    assert same(a >= b, [0, 1, 1])
+    assert same(a < b, [1, 0, 0])
+    assert same(a <= b, [1, 1, 0])
+
+
+def test_reshape_transpose():
+    a = nd.arange(0, 24).reshape(2, 3, 4)
+    assert a.shape == (2, 3, 4)
+    assert a.reshape((4, 6)).shape == (4, 6)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert nd.ones((2, 1, 3)).squeeze(axis=1).shape == (2, 3)
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum(), rtol=1e-5)
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(a.mean(axis=0, keepdims=True),
+                        x.mean(axis=0, keepdims=True), rtol=1e-5)
+    assert_almost_equal(a.max(), x.max())
+    assert_almost_equal(a.min(axis=1), x.min(axis=1))
+    assert_almost_equal(a.prod(axis=0), x.prod(axis=0), rtol=1e-5)
+    assert same(a.argmax(axis=1), x.argmax(axis=1))
+    assert same(a.argmin(axis=0), x.argmin(axis=0))
+    assert_almost_equal(a.norm(), np.linalg.norm(x), rtol=1e-5)
+
+
+def test_dot():
+    x = np.random.uniform(size=(3, 4)).astype(np.float32)
+    y = np.random.uniform(size=(4, 5)).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-5)
+    assert_almost_equal(
+        nd.array(x).dot(nd.array(y.T), transpose_b=True), x @ y, rtol=1e-5)
+
+
+def test_indexing():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = nd.array(x)
+    assert same(a[1], x[1])
+    assert same(a[1:3], x[1:3])
+    assert same(a[:, 2], x[:, 2])
+    assert same(a[1, 2], x[1, 2])
+    idx = nd.array([0, 2], dtype="int32")
+    assert same(a[idx], x[[0, 2]])
+    a[0] = 0.0
+    x[0] = 0.0
+    assert same(a, x)
+    a[1:3] = 5.0
+    x[1:3] = 5.0
+    assert same(a, x)
+    b = nd.zeros((2, 2))
+    b[:] = nd.ones((2, 2))
+    assert same(b, np.ones((2, 2)))
+
+
+def test_concat_stack():
+    x = np.ones((2, 3), np.float32)
+    y = np.zeros((2, 3), np.float32)
+    assert same(nd.concat(nd.array(x), nd.array(y), dim=0),
+                np.concatenate([x, y], axis=0))
+    assert same(nd.concat(nd.array(x), nd.array(y), dim=1),
+                np.concatenate([x, y], axis=1))
+    assert same(nd.stack(nd.array(x), nd.array(y), axis=0),
+                np.stack([x, y]))
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, [1.5, 2.5])
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.bin")
+    d = {"w": nd.array(np.random.rand(3, 4).astype(np.float32)),
+         "b": nd.array(np.random.rand(4).astype(np.float32))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    assert same(loaded["w"], d["w"])
+    assert same(loaded["b"], d["b"])
+    lst = [nd.array([1.0, 2.0])]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and same(loaded[0], lst[0])
+
+
+def test_take_pick_onehot():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    a = nd.array(x)
+    assert same(a.take(nd.array([0, 2], dtype="int32")), x[[0, 2]])
+    assert same(a.pick(nd.array([0, 1, 2], dtype="int32"), axis=1),
+                x[np.arange(3), [0, 1, 2]])
+    oh = nd.array([1, 0], dtype="int32").one_hot(3)
+    assert same(oh, [[0, 1, 0], [1, 0, 0]])
+
+
+def test_wait_and_context():
+    a = nd.ones((2, 2))
+    a.wait_to_read()
+    nd.waitall()
+    assert a.context.device_type in ("cpu", "tpu", "gpu")
+    b = a.as_in_context(mx.cpu())
+    assert b.context.device_type == "cpu"
+
+
+def test_broadcast():
+    a = nd.array([[1.0], [2.0]])
+    assert same(a.broadcast_to((2, 3)), [[1, 1, 1], [2, 2, 2]])
+    b = nd.ones((2, 3))
+    assert same(a.broadcast_like(b), [[1, 1, 1], [2, 2, 2]])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    a = nd.array(x)
+    assert same(a.sort(axis=1), np.sort(x, axis=1))
+    assert same(a.argsort(axis=1), np.argsort(x, axis=1))
+    top = a.topk(axis=1, k=2, ret_typ="value")
+    assert same(top, [[3, 2], [5, 4]])
+
+
+def test_named_kwarg_binding():
+    # named inputs out of declaration order must bind to the right slots
+    a = nd.array(np.random.rand(2, 3).astype(np.float32))
+    b = nd.array(np.random.rand(3, 4).astype(np.float32))
+    out1 = mx.nd.dot(a, b)
+    out2 = mx.nd.dot(rhs=b, lhs=a)
+    assert same(out1, out2)
+    x = nd.array(np.random.rand(2, 5).astype(np.float32))
+    w = nd.array(np.random.rand(3, 5).astype(np.float32))
+    bb = nd.array(np.random.rand(3).astype(np.float32))
+    o1 = mx.nd.FullyConnected(x, w, bb, num_hidden=3)
+    o2 = mx.nd.FullyConnected(weight=w, data=x, bias=bb, num_hidden=3)
+    assert same(o1, o2)
+
+
+def test_reduce_exclude_none_axis():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    assert mx.nd.sum(x, exclude=True).shape == ()
+
+
+def test_random_mixed_params():
+    lo = nd.array([0.0, 10.0])
+    u = mx.nd.random.uniform(lo, 20.0)
+    v = u.asnumpy()
+    assert v.shape == (2,)
+    assert 0 <= v[0] <= 20 and 10 <= v[1] <= 20

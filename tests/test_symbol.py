@@ -1,0 +1,145 @@
+"""Symbol + Executor tests (reference: tests/python/unittest/test_symbol.py,
+test_executor.py, test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_symbol_compose_and_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert "data" in args
+    assert "fc1_weight" in args and "fc1_bias" in args
+    assert "fc2_weight" in args and "fc2_bias" in args
+    assert "softmax_label" in args
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(10, 20))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 20)
+    assert d["fc1_bias"] == (8,)
+    assert d["fc2_weight"] == (4, 8)
+    assert out_shapes[0] == (10, 4)
+
+
+def test_simple_bind_forward():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.current_context(), data=(2, 5))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = nd.array(
+                np.random.uniform(-0.1, 0.1, arr.shape).astype(np.float32))
+    exe.arg_dict["data"][:] = nd.ones((2, 5))
+    outs = exe.forward(is_train=False)
+    out = outs[0].asnumpy()
+    assert out.shape == (2, 4)
+    assert_almost_equal(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+
+def test_bind_backward():
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    z = x * y + x
+    xv = nd.array([1.0, 2.0])
+    yv = nd.array([3.0, 4.0])
+    gx = nd.zeros((2,))
+    gy = nd.zeros((2,))
+    exe = z.bind(mx.current_context(), args={"x": xv, "y": yv},
+                 args_grad={"x": gx, "y": gy}, grad_req="write")
+    exe.forward(is_train=True)
+    assert_almost_equal(exe.outputs[0], [4.0, 10.0])
+    exe.backward([nd.ones((2,))])
+    assert_almost_equal(gx, [4.0, 5.0])
+    assert_almost_equal(gy, [1.0, 2.0])
+
+
+def test_grad_req_add_and_null():
+    x = mx.sym.var("x")
+    z = 2 * x
+    xv = nd.array([1.0])
+    gx = nd.zeros((1,))
+    exe = z.bind(mx.current_context(), args={"x": xv}, args_grad={"x": gx},
+                 grad_req="add")
+    for _ in range(2):
+        exe.forward(is_train=True)
+        exe.backward([nd.ones((1,))])
+    assert_almost_equal(gx, [4.0])
+
+
+def test_symbol_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    loaded = mx.sym.load(fname)
+    assert loaded.list_arguments() == net.list_arguments()
+    assert loaded.list_outputs() == net.list_outputs()
+    # json round-trips through tojson too
+    loaded2 = mx.sym.load_json(net.tojson())
+    assert loaded2.list_arguments() == net.list_arguments()
+
+
+def test_symbol_group_and_slicing():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    d = a * b
+    g = mx.sym.Group([c, d])
+    assert len(g.list_outputs()) == 2
+    exe = g.bind(mx.current_context(),
+                 args={"a": nd.array([2.0]), "b": nd.array([3.0])})
+    exe.forward()
+    assert_almost_equal(exe.outputs[0], [5.0])
+    assert_almost_equal(exe.outputs[1], [6.0])
+
+
+def test_symbol_arithmetic_scalar():
+    x = mx.sym.var("x")
+    y = (x + 1) * 2 - 3
+    exe = y.bind(mx.current_context(), args={"x": nd.array([1.0, 2.0])})
+    exe.forward()
+    assert_almost_equal(exe.outputs[0], [1.0, 3.0])
+
+
+def test_executor_reshape():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.current_context(), data=(4, 6))
+    exe2 = exe.reshape(data=(8, 6))
+    assert exe2.arg_dict["data"].shape == (8, 6)
+    # params shared
+    assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+
+
+def test_aux_states_batchnorm():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn", fix_gamma=False)
+    exe = bn.simple_bind(ctx=mx.current_context(), data=(4, 3))
+    assert "bn_moving_mean" in exe.aux_dict
+    assert "bn_moving_var" in exe.aux_dict
+    exe.arg_dict["data"][:] = nd.array(
+        np.random.rand(4, 3).astype(np.float32) * 5)
+    exe.arg_dict["bn_gamma"][:] = nd.ones((3,))
+    before = exe.aux_dict["bn_moving_mean"].asnumpy().copy()
+    exe.forward(is_train=True)
+    after = exe.aux_dict["bn_moving_mean"].asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_infer_shape_partial():
+    x = mx.sym.var("x")
+    fc = mx.sym.FullyConnected(x, num_hidden=3, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial(x=(2, 5))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["fc_weight"] == (3, 5)
