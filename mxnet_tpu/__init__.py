@@ -20,4 +20,11 @@ from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401
 from . import name  # noqa: F401
 from .name import NameManager, Prefix  # noqa: F401
+from . import initializer  # noqa: F401
+from . import initializer as init  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import callback  # noqa: F401
 from . import test_utils  # noqa: F401

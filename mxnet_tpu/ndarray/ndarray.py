@@ -498,6 +498,15 @@ class NDArray:
         for i in range(self.shape[0]):
             yield self[i]
 
+    def __reduce__(self):
+        # pickling (optimizer state save, DataLoader workers): serialize
+        # via host numpy (reference: ndarray.py __reduce__/NDArrayBase)
+        return (_rebuild_ndarray, (self.asnumpy(),))
+
+
+def _rebuild_ndarray(a):
+    return NDArray(jnp.asarray(a))
+
 
 def _wrap(jarr):
     return NDArray(jarr)

@@ -1,0 +1,102 @@
+"""Initializer tests (reference: tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_default_init():
+    init = mx.init.Uniform(0.1)
+    w = nd.zeros((10, 10))
+    init("fc1_weight", w)
+    a = w.asnumpy()
+    assert (np.abs(a) <= 0.1).all() and np.abs(a).max() > 0
+    b = nd.ones((10,))
+    init("fc1_bias", b)
+    assert (b.asnumpy() == 0).all()
+    g = nd.zeros((10,))
+    init("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+
+
+def test_constant_zero_one():
+    w = nd.zeros((4,))
+    mx.init.Constant(3.5)("x_weight", w)
+    assert (w.asnumpy() == 3.5).all()
+    mx.init.One()("x_weight", w)
+    assert (w.asnumpy() == 1).all()
+    mx.init.Zero()("x_weight", w)
+    assert (w.asnumpy() == 0).all()
+
+
+def test_xavier():
+    w = nd.zeros((50, 100))
+    mx.init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3)(
+        "fc_weight", w)
+    scale = np.sqrt(3.0 / ((50 + 100) / 2.0))
+    a = w.asnumpy()
+    assert (np.abs(a) <= scale + 1e-6).all()
+    assert a.std() > scale / 4  # actually filled
+
+
+def test_msra():
+    w = nd.zeros((64, 32, 3, 3))
+    mx.init.MSRAPrelu()("conv_weight", w)
+    assert w.asnumpy().std() > 0
+
+
+def test_orthogonal():
+    w = nd.zeros((16, 16))
+    mx.init.Orthogonal(scale=1.0)("q_weight", w)
+    a = w.asnumpy()
+    eye = a @ a.T
+    assert np.allclose(eye, np.eye(16), atol=1e-4)
+
+
+def test_lstmbias():
+    b = nd.zeros((4 * 8,))
+    mx.init.LSTMBias(forget_bias=1.0)("lstm_bias", b)
+    a = b.asnumpy()
+    assert (a[8:16] == 1.0).all()
+    assert (a[:8] == 0).all() and (a[16:] == 0).all()
+
+
+def test_init_dumps_create():
+    init = mx.init.Xavier(magnitude=2)
+    s = init.dumps()
+    init2 = mx.initializer.create(s)
+    assert isinstance(init2, mx.init.Xavier)
+    assert init2.magnitude == 2
+
+
+def test_mixed():
+    # reference dispatch: bias-named params always take _init_bias (zeros)
+    init = mx.initializer.Mixed(
+        [".*bias", ".*"], [mx.init.Zero(), mx.init.Uniform(0.1)])
+    b = nd.ones((4,))
+    init("fc_bias", b)
+    assert (b.asnumpy() == 0).all()
+    w = nd.zeros((4, 4))
+    init("fc_weight", w)
+    a = w.asnumpy()
+    assert np.abs(a).max() <= 0.1 and np.abs(a).max() > 0
+
+
+def test_load_initializer():
+    params = {"arg:w": nd.array([1.0, 2.0])}
+    init = mx.initializer.Load(params, default_init=mx.init.Zero())
+    w = nd.zeros((2,))
+    init("w", w)
+    assert (w.asnumpy() == [1, 2]).all()
+    v = nd.ones((3,))
+    init("v", v)
+    assert (v.asnumpy() == 0).all()
+
+
+def test_variable_init_attr():
+    # var(init=...) drives initialization through InitDesc attrs
+    w = nd.zeros((5, 5))
+    desc = mx.initializer.InitDesc(
+        "myvar", attrs={"__init__": mx.init.One().dumps()})
+    mx.init.Uniform(0.1)(desc, w)
+    assert (w.asnumpy() == 1).all()

@@ -1,0 +1,112 @@
+"""Metric tests (reference: tests/python/unittest/test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy():
+    m = mx.metric.create("acc")
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_top_k_accuracy():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = nd.array([1, 1])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 1.0) < 1e-6  # both labels within top2
+
+
+def test_f1():
+    m = mx.metric.create("f1")
+    pred = nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7], [0.6, 0.4]])
+    label = nd.array([1, 0, 1, 1])
+    m.update([label], [pred])
+    _, f1 = m.get()
+    # tp=2 fp=0 fn=1: p=1, r=2/3, f1=0.8
+    assert abs(f1 - 0.8) < 1e-6
+
+
+def test_mae_mse_rmse():
+    label = nd.array([1.0, 2.0, 3.0])
+    pred = nd.array([1.5, 2.0, 2.0])
+    m = mx.metric.create("mae")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    m = mx.metric.create("mse")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - (0.25 + 0 + 1) / 3) < 1e-6
+    m = mx.metric.create("rmse")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.sqrt((0.25 + 0 + 1) / 3)) < 1e-6
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity(ignore_label=None)
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_cross_entropy_nll():
+    pred = nd.array([[0.25, 0.75], [0.6, 0.4]])
+    label = nd.array([1, 0])
+    m = mx.metric.create("ce")
+    m.update([label], [pred])
+    expected = -(np.log(0.75) + np.log(0.6)) / 2
+    assert abs(m.get()[1] - expected) < 1e-5
+    m = mx.metric.create("nll_loss")
+    m.update([label], [pred])
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_pearson():
+    m = mx.metric.create("pearsonr")
+    pred = nd.array([1.0, 2.0, 3.0, 4.0])
+    label = nd.array([2.0, 4.0, 6.0, 8.0])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+def test_composite():
+    m = mx.metric.create(["acc", "mae"])
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = nd.array([1, 0])
+    m.update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names and "mae" in names
+
+
+def test_custom_metric():
+    def zero_one(label, pred):
+        return (np.argmax(pred, axis=1) != label).mean()
+    m = mx.metric.np(zero_one)
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1]])
+    label = nd.array([1, 1])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_loss_metric():
+    m = mx.metric.create("loss")
+    m.update(None, [nd.array([1.0, 2.0, 3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+def test_reset_and_nan():
+    m = mx.metric.create("acc")
+    assert np.isnan(m.get()[1])
+    m.update([nd.array([0])], [nd.array([[0.9, 0.1]])])
+    assert m.get()[1] == 1.0
+    m.reset()
+    assert np.isnan(m.get()[1])

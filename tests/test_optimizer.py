@@ -1,0 +1,175 @@
+"""Optimizer tests (reference: tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+ALL_OPTS = ["sgd", "signum", "ftml", "lbsgd", "dcasgd", "nag", "sgld",
+            "adam", "adagrad", "rmsprop", "adadelta", "ftrl", "adamax",
+            "nadam"]
+
+
+def _train_quadratic(opt_name, steps=100, average_tail=0, **kwargs):
+    """Minimize ||w - target||^2 with the given optimizer."""
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    opt = mx.optimizer.create(opt_name, **kwargs)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.zeros((3,))
+    tail = []
+    # SGLD samples exp(-loss): sharpen the loss so the posterior is tight
+    gscale = 200.0 if opt_name == "sgld" else 2.0
+    for i in range(steps):
+        grad = gscale * (w - nd.array(target))
+        updater(0, grad, w)
+        if average_tail and i >= steps - average_tail:
+            tail.append(w.asnumpy())
+    if tail:
+        return np.mean(tail, axis=0), target
+    return w.asnumpy(), target
+
+
+@pytest.mark.parametrize("opt_name", ALL_OPTS)
+def test_optimizer_converges(opt_name):
+    kwargs = {}
+    if opt_name in ("sgd", "nag", "lbsgd"):
+        kwargs = {"learning_rate": 0.1, "momentum": 0.9}
+    elif opt_name == "signum":
+        kwargs = {"learning_rate": 0.01}
+    elif opt_name == "sgld":
+        kwargs = {"learning_rate": 0.001}
+    elif opt_name in ("adam", "nadam"):
+        kwargs = {"learning_rate": 0.3}
+    elif opt_name == "ftml":
+        kwargs = {"learning_rate": 0.3}
+    elif opt_name == "adagrad":
+        kwargs = {"learning_rate": 0.5}
+    elif opt_name == "rmsprop":
+        kwargs = {"learning_rate": 0.1}
+    elif opt_name == "adadelta":
+        kwargs = {"rho": 0.9, "epsilon": 1e-4}
+    elif opt_name == "ftrl":
+        kwargs = {"learning_rate": 1.0}
+    elif opt_name == "adamax":
+        kwargs = {"learning_rate": 0.3}
+    elif opt_name == "dcasgd":
+        kwargs = {"learning_rate": 0.1, "momentum": 0.9}
+    # SGLD is a sampler: average the tail iterates (posterior mean ≈ optimum)
+    tail = 100 if opt_name == "sgld" else 0
+    w, target = _train_quadratic(opt_name, steps=300, average_tail=tail,
+                                 **kwargs)
+    tol = 0.5 if opt_name in ("sgld", "signum", "adadelta") else 0.1
+    assert np.abs(w - target).max() < tol, \
+        "%s did not converge: %s vs %s" % (opt_name, w, target)
+
+
+def test_sgd_exact():
+    # one step of plain SGD: w -= lr * (rescale*grad + wd*w)
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.0, rescale_grad=1.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([1.0, 2.0])
+    updater(0, nd.array([0.5, 0.5]), w)
+    assert_almost_equal(w, [0.95, 1.95], rtol=1e-5)
+
+
+def test_sgd_momentum_exact():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.0)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([1.0])
+    g = nd.array([1.0])
+    updater(0, g, w)  # mom = -0.1; w = 0.9
+    assert_almost_equal(w, [0.9], rtol=1e-5)
+    updater(0, g, w)  # mom = 0.9*-0.1 - 0.1 = -0.19; w = 0.71
+    assert_almost_equal(w, [0.71], rtol=1e-5)
+
+
+def test_clip_gradient():
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=0.5)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([0.0])
+    updater(0, nd.array([10.0]), w)
+    assert_almost_equal(w, [-0.5], rtol=1e-5)
+
+
+def test_weight_decay():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([1.0])
+    updater(0, nd.zeros((1,)), w)
+    assert_almost_equal(w, [0.99], rtol=1e-5)
+
+
+def test_lr_mult_wd_mult():
+    opt = mx.optimizer.SGD(learning_rate=0.1,
+                           param_idx2name={0: "a_weight", 1: "b_weight"})
+    opt.set_lr_mult({"a_weight": 0.0})
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([1.0])
+    updater(0, nd.array([1.0]), w)
+    assert_almost_equal(w, [1.0])  # lr_mult 0 freezes
+    w2 = nd.array([1.0])
+    updater(1, nd.array([1.0]), w2)
+    assert_almost_equal(w2, [0.9], rtol=1e-5)
+
+
+def test_updater_states_roundtrip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([1.0])
+    updater(0, nd.array([1.0]), w)
+    blob = updater.get_states()
+    updater2 = mx.optimizer.get_updater(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    updater2.set_states(blob)
+    w2 = nd.array([0.9])
+    updater2(0, nd.array([1.0]), w2)
+    updater(0, nd.array([1.0]), w)
+    assert_almost_equal(w, w2, rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_multifactor():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[10, 20], factor=0.1)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert abs(sched(15) - 0.1) < 1e-9
+    assert abs(sched(25) - 0.01) < 1e-9
+
+
+def test_lr_scheduler_poly():
+    sched = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert sched(0) == 1.0
+    assert abs(sched(50) - 0.25) < 1e-9
+    assert sched(100) == 0.0
+
+
+def test_optimizer_with_scheduler():
+    opt = mx.optimizer.SGD(learning_rate=1.0,
+                           lr_scheduler=mx.lr_scheduler.FactorScheduler(
+                               step=2, factor=0.5))
+    updater = mx.optimizer.get_updater(opt)
+    w = nd.array([10.0])
+    for _ in range(4):
+        updater(0, nd.array([1.0]), w)
+    # lr: 1, 1, 0.5(after passing step 2)...
+    assert w.asnumpy()[0] < 8.0
+
+
+def test_multi_precision_sgd():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    updater = mx.optimizer.get_updater(opt)
+    w16 = nd.array([1.0], dtype="float16")
+    g16 = nd.array([1.0], dtype="float16")
+    updater(0, g16, w16)
+    assert w16.dtype == np.float16
+    assert_almost_equal(w16, [0.9], rtol=1e-2)
