@@ -27,4 +27,6 @@ from .optimizer import Optimizer  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import callback  # noqa: F401
+from . import io  # noqa: F401
+from . import recordio  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -1,0 +1,178 @@
+"""IO tests (reference: tests/python/unittest/test_io.py, test_recordio.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    assert (batches[0].data[0].asnumpy() == data[:5]).all()
+    assert batches[0].pad == 0
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_pad():
+    data = np.arange(28, dtype=np.float32).reshape(7, 4)
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=3,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    # padded entries wrap around to the beginning
+    assert (batches[-1].data[0].asnumpy()[1:] == data[:2]).all()
+
+
+def test_ndarrayiter_discard():
+    data = np.zeros((7, 4), np.float32)
+    it = mx.io.NDArrayIter(data, np.zeros(7), batch_size=3,
+                           last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarrayiter_shuffle():
+    data = np.arange(100, dtype=np.float32).reshape(100, 1)
+    it = mx.io.NDArrayIter(data, np.arange(100), batch_size=10, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen) == list(range(100))
+    assert not (seen == np.arange(100)).all()  # actually shuffled
+    # labels stay aligned with data
+    it.reset()
+    for b in it:
+        assert (b.data[0].asnumpy().ravel() == b.label[0].asnumpy()).all()
+
+
+def test_ndarrayiter_dict_input():
+    it = mx.io.NDArrayIter({"data": np.zeros((6, 2), np.float32)},
+                           {"softmax_label": np.zeros(6, np.float32)},
+                           batch_size=2)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (2, 2)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_resize_iter():
+    data = np.zeros((10, 2), np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = mx.io.ResizeIter(base, 5)
+    assert len(list(it)) == 5  # wraps around internally
+
+
+def test_prefetching_iter():
+    data = np.arange(20, dtype=np.float32).reshape(10, 2)
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    it = mx.io.PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert len(list(it)) == 2
+
+
+def test_csviter(tmp_path):
+    fname = str(tmp_path / "data.csv")
+    data = np.random.rand(8, 3).astype(np.float32)
+    np.savetxt(fname, data, delimiter=",")
+    it = mx.io.CSVIter(data_csv=fname, data_shape=(3,), batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert np.allclose(got, data, rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    rec = mx.recordio.MXRecordIO(fname, "w")
+    for i in range(5):
+        rec.write(b"record_%d" % i)
+    rec.close()
+    rec = mx.recordio.MXRecordIO(fname, "r")
+    for i in range(5):
+        assert rec.read() == b"record_%d" % i
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    idxname = str(tmp_path / "test.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(5):
+        rec.write_idx(i, b"record_%d" % i)
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO(idxname, fname, "r")
+    assert rec.read_idx(3) == b"record_3"
+    assert rec.read_idx(0) == b"record_0"
+    assert rec.keys == [0, 1, 2, 3, 4]
+    rec.close()
+
+
+def test_irheader_pack_unpack():
+    header = mx.recordio.IRHeader(0, 2.0, 7, 0)
+    packed = mx.recordio.pack(header, b"payload")
+    h2, payload = mx.recordio.unpack(packed)
+    assert payload == b"payload"
+    assert h2.label == 2.0
+    assert h2.id == 7
+    # multi-label
+    header = mx.recordio.IRHeader(0, [1.0, 2.0, 3.0], 9, 0)
+    packed = mx.recordio.pack(header, b"x")
+    h2, payload = mx.recordio.unpack(packed)
+    assert h2.flag == 3
+    assert list(h2.label) == [1.0, 2.0, 3.0]
+    assert payload == b"x"
+
+
+def test_mnist_iter(tmp_path):
+    # synthesize a tiny MNIST-format file pair
+    img_path = str(tmp_path / "img")
+    lab_path = str(tmp_path / "lab")
+    n = 20
+    imgs = np.random.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = np.random.randint(0, 10, n, dtype=np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lab_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=img_path, label=lab_path, batch_size=10,
+                         shuffle=False)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    assert np.allclose(batches[0].data[0].asnumpy()[0, 0],
+                       imgs[0].astype(np.float32) / 255.0)
+    assert (batches[0].label[0].asnumpy() == labels[:10]).all()
+    it2 = mx.io.MNISTIter(image=img_path, label=lab_path, batch_size=10,
+                          flat=True, shuffle=False)
+    assert next(iter(it2)).data[0].shape == (10, 784)
+
+
+def test_image_record_iter(tmp_path):
+    pytest.importorskip("PIL")
+    fname = str(tmp_path / "img.rec")
+    rec = mx.recordio.MXRecordIO(fname, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = rng.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+        packed = mx.recordio.pack_img(
+            mx.recordio.IRHeader(0, float(i % 3), i, 0), img, img_fmt=".png")
+        rec.write(packed)
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=fname, data_shape=(3, 28, 28),
+                               batch_size=3, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 3, 28, 28)
+    assert (batches[0].label[0].asnumpy() == [0, 1, 2]).all()
