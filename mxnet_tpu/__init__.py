@@ -27,6 +27,11 @@ from .optimizer import Optimizer  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import callback  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import model  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
 from . import test_utils  # noqa: F401

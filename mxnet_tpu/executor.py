@@ -294,12 +294,20 @@ class Executor:
         """Reference: executor.py copy_params_from."""
         for k, v in arg_params.items():
             if k in self.arg_dict:
+                if tuple(v.shape) != self.arg_dict[k].shape:
+                    raise MXNetError(
+                        "shape mismatch for parameter %r: %s vs executor %s"
+                        % (k, v.shape, self.arg_dict[k].shape))
                 self.arg_dict[k]._data = v._data.astype(self.arg_dict[k].dtype)
             elif not allow_extra_params:
                 raise MXNetError("unknown parameter %r" % k)
         if aux_params:
             for k, v in aux_params.items():
                 if k in self.aux_dict:
+                    if tuple(v.shape) != self.aux_dict[k].shape:
+                        raise MXNetError(
+                            "shape mismatch for aux state %r: %s vs executor %s"
+                            % (k, v.shape, self.aux_dict[k].shape))
                     self.aux_dict[k]._data = v._data.astype(self.aux_dict[k].dtype)
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux state %r" % k)

