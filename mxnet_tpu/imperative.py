@@ -105,7 +105,9 @@ def invoke(op, nd_inputs, attrs=None, out=None):
 
 def invoke_fn(fn, nd_inputs, record_grad=True):
     """Invoke an anonymous pure jax function with autograd recording —
-    used for NDArray sugar (slicing, fancy indexing) that has no named op."""
+    used for NDArray sugar (slicing, fancy indexing) and for jitted
+    HybridBlock calls (which record as ONE fused tape entry).  Handles
+    single or tuple outputs."""
     from .ndarray.ndarray import NDArray, _wrap
 
     datas = [x._data if isinstance(x, NDArray) else x for x in nd_inputs]
@@ -115,8 +117,20 @@ def invoke_fn(fn, nd_inputs, record_grad=True):
         for x in nd_inputs)
     if recording:
         out, vjp_fn = jax.vjp(fn, *datas)
-        nd_out = _wrap(out)
-        autograd.record_entry(
-            lambda g, _v=vjp_fn: _v(g), list(nd_inputs), [nd_out], [out])
-        return nd_out
-    return _wrap(fn(*datas))
+        single = not isinstance(out, tuple)
+        outs = [out] if single else list(out)
+        nd_outs = [_wrap(o) for o in outs]
+
+        def tape_vjp(out_cts, _v=vjp_fn, _single=single):
+            if _single:
+                return _v(out_cts)
+            if not isinstance(out_cts, tuple):
+                out_cts = (out_cts,)
+            return _v(tuple(out_cts))
+
+        autograd.record_entry(tape_vjp, list(nd_inputs), nd_outs, outs)
+        return nd_outs[0] if single else nd_outs
+    out = fn(*datas)
+    if isinstance(out, tuple):
+        return [_wrap(o) for o in out]
+    return _wrap(out)

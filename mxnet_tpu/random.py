@@ -23,9 +23,34 @@ def seed(seed_state=0, ctx="all"):
 
 
 def next_key():
-    """Split a fresh subkey off the global chain (runtime internal)."""
+    """Split a fresh subkey off the global chain (runtime internal).
+
+    Inside a jit trace (hybridized blocks), keys must derive from the
+    traced key argument — a concrete key would bake one fixed mask into
+    the compiled program.  ``trace_key_scope`` pushes the traced key."""
+    if _TRACE_KEYS:
+        base, counter = _TRACE_KEYS[-1]
+        _TRACE_KEYS[-1] = (base, counter + 1)
+        return jax.random.fold_in(base, counter)
     if _STATE["key"] is None:
         seed(0)
     _STATE["key"], sub = jax.random.split(_STATE["key"])
     _STATE["count"] += 1
     return sub
+
+
+_TRACE_KEYS = []
+
+
+class trace_key_scope:
+    """Route next_key() through a traced base key while active."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _TRACE_KEYS.append((self._key, 0))
+        return self
+
+    def __exit__(self, *args):
+        _TRACE_KEYS.pop()

@@ -1,0 +1,316 @@
+"""Gluon tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.init.One())
+    assert (p.data().asnumpy() == 1).all()
+    assert p.grad().shape == (3, 4)
+    p.zero_grad()
+    assert (p.grad().asnumpy() == 0).all()
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(gluon.parameter.DeferredInitializationError):
+        dense.weight.data()
+    x = nd.ones((2, 7))
+    out = dense(x)
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_dense_forward():
+    dense = nn.Dense(3, in_units=4, use_bias=True)
+    dense.initialize(mx.init.One())
+    x = nd.ones((2, 4))
+    out = dense(x)
+    assert_almost_equal(out, np.full((2, 3), 4.0), rtol=1e-5)
+
+
+def test_dense_activation_flatten():
+    dense = nn.Dense(2, activation="relu", in_units=3)
+    dense.initialize()
+    x = nd.array(np.random.randn(4, 3).astype(np.float32))
+    out = dense(x)
+    assert (out.asnumpy() >= 0).all()
+    d2 = nn.Dense(2, flatten=False, in_units=5)
+    d2.initialize()
+    out = d2(nd.ones((2, 3, 5)))
+    assert out.shape == (2, 3, 2)
+
+
+def test_sequential():
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 10)))
+    assert out.shape == (2, 4)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+    params = net.collect_params()
+    assert len(list(params.keys())) == 4
+
+
+def test_hybrid_sequential_and_hybridize():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 10).astype(np.float32))
+    out_imperative = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert_almost_equal(out_imperative, out_hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_matches():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+
+    def grads():
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        return {k: p.grad().asnumpy().copy()
+                for k, p in net.collect_params().items()}
+
+    g1 = grads()
+    net.hybridize()
+    g2 = grads()
+    for k in g1:
+        assert_almost_equal(g1[k], g2[k], rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_step():
+    net = nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(batch_size=2)
+    # grad of sum over batch 2: each weight gets 2; rescaled 1/2 -> 1
+    assert_almost_equal(net.weight.data(), np.full((1, 3), 0.9), rtol=1e-5)
+
+
+def test_gluon_training_convergence():
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    Y = X @ w_true
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(200):
+        with autograd.record():
+            out = net(nd.array(X))
+            loss = loss_fn(out, nd.array(Y)).mean()
+        loss.backward()
+        trainer.step(batch_size=200)
+    got = net.weight.data().asnumpy().T
+    assert np.abs(got - w_true).max() < 0.05
+
+
+def test_conv2d():
+    conv = nn.Conv2D(4, kernel_size=3, in_channels=2)
+    conv.initialize()
+    out = conv(nd.ones((1, 2, 8, 8)))
+    assert out.shape == (1, 4, 6, 6)
+    conv_pad = nn.Conv2D(4, kernel_size=3, padding=1, strides=2, in_channels=2)
+    conv_pad.initialize()
+    assert conv_pad(nd.ones((1, 2, 8, 8))).shape == (1, 4, 4, 4)
+    # deferred in_channels
+    conv_d = nn.Conv2D(3, kernel_size=1)
+    conv_d.initialize()
+    assert conv_d(nd.ones((1, 5, 4, 4))).shape == (1, 3, 4, 4)
+    assert conv_d.weight.shape == (3, 5, 1, 1)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(2, kernel_size=2, strides=2, in_channels=3)
+    deconv.initialize()
+    out = deconv(nd.ones((1, 3, 4, 4)))
+    assert out.shape == (1, 2, 8, 8)
+
+
+def test_pooling_layers():
+    x = nd.array(np.random.rand(1, 2, 8, 8).astype(np.float32))
+    assert nn.MaxPool2D()(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(pool_size=4)(x).shape == (1, 2, 2, 2)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (1, 2, 1, 1)
+    assert_almost_equal(nn.GlobalAvgPool2D()(x).asnumpy().ravel(),
+                        x.asnumpy().mean(axis=(2, 3)).ravel(), rtol=1e-5)
+
+
+def test_batchnorm_layer():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) * 5)
+    with autograd.record(train_mode=True):
+        out = bn(x)
+    assert abs(float(out.asnumpy().mean())) < 0.1
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record(train_mode=True):
+        bn(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_dropout_layer():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=True):
+        y = do(x)
+    assert 0.3 < (y.asnumpy() == 0).mean() < 0.7
+    y_eval = do(x)
+    assert_almost_equal(y_eval, x.asnumpy())
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([1, 2, 3]))
+    assert out.shape == (3, 4)
+
+
+def test_layernorm_flatten_lambda():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    out = ln(nd.array(np.random.rand(2, 6).astype(np.float32)))
+    assert abs(float(out.asnumpy().mean())) < 1e-5
+    fl = nn.Flatten()
+    assert fl(nd.ones((2, 3, 4))).shape == (2, 12)
+    lam = nn.Lambda(lambda x: x * 2)
+    assert_almost_equal(lam(nd.ones((2,))), [2, 2])
+    hlam = nn.HybridLambda("relu")
+    assert_almost_equal(hlam(nd.array([-1.0, 1.0])), [0, 1])
+
+
+def test_activations_layers():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    assert (nn.Activation("relu")(x).asnumpy() >= 0).all()
+    out = nn.LeakyReLU(0.1)(x)
+    assert_almost_equal(out, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                      0.1 * x.asnumpy()), rtol=1e-5)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    out = prelu(x)
+    assert_almost_equal(out, np.where(x.asnumpy() > 0, x.asnumpy(),
+                                      0.25 * x.asnumpy()), rtol=1e-5)
+    nn.ELU()(x)
+    nn.SELU()(x)
+    nn.Swish()(x)
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label_int = nd.array(np.random.randint(0, 5, 4))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_int)
+    assert l.shape == (4, 1) or l.shape == (4,)
+    p = pred.asnumpy()
+    sm = np.exp(p) / np.exp(p).sum(1, keepdims=True)
+    expected = -np.log(sm[np.arange(4), label_int.asnumpy().astype(int)])
+    assert_almost_equal(l.asnumpy().ravel(), expected, rtol=1e-4)
+
+    a = nd.array([1.0, 2.0])
+    b = nd.array([1.5, 1.0])
+    assert_almost_equal(gluon.loss.L2Loss()(a, b), [0.125, 0.5], rtol=1e-5)
+    assert_almost_equal(gluon.loss.L1Loss()(a, b), [0.5, 1.0], rtol=1e-5)
+    assert_almost_equal(gluon.loss.HuberLoss()(a, b), [0.125, 0.5], rtol=1e-5)
+    # hinge with signed labels
+    assert_almost_equal(gluon.loss.HingeLoss()(nd.array([0.5, 2.0]),
+                                               nd.array([1.0, 1.0])),
+                        [0.5, 0.0], rtol=1e-5)
+    # bce from logits
+    bce = gluon.loss.SigmoidBCELoss()(nd.array([0.0]), nd.array([1.0]))
+    assert_almost_equal(bce, [np.log(2)], rtol=1e-5)
+    kl = gluon.loss.KLDivLoss()(nd.log_softmax(nd.ones((1, 3))),
+                                nd.softmax(nd.ones((1, 3))))
+    assert abs(float(kl.asnumpy().ravel()[0])) < 1e-6
+
+
+def test_save_load_params(tmp_path):
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=6), nn.Dense(2, in_units=4))
+    net.initialize()
+    fname = str(tmp_path / "net.params")
+    net.save_params(fname)
+    net2 = nn.HybridSequential(prefix="model_")
+    with net2.name_scope():
+        net2.add(nn.Dense(4, in_units=6), nn.Dense(2, in_units=4))
+    net2.load_params(fname)
+    x = nd.ones((1, 6))
+    assert_almost_equal(net(x), net2(x), rtol=1e-6)
+
+
+def test_block_naming():
+    d1 = nn.Dense(2)
+    d2 = nn.Dense(2)
+    assert d1.prefix != d2.prefix
+    net = nn.Sequential(prefix="foo_")
+    with net.name_scope():
+        inner = nn.Dense(2)
+    assert inner.prefix.startswith("foo_")
+
+
+def test_shared_params():
+    d1 = nn.Dense(4, in_units=4)
+    d2 = nn.Dense(4, in_units=4, params=d1.params)
+    d1.initialize()
+    x = nd.ones((1, 4))
+    assert_almost_equal(d1(x), d2(x))
+
+
+def test_symbol_block():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.Activation(fc, act_type="relu")
+    sb = gluon.SymbolBlock(out, [data])
+    sb.initialize()
+    res = sb(nd.ones((2, 5)))
+    assert res.shape == (2, 3)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(9 * 4 + 16 * 3)
+    assert abs(norm - total) < 1e-4
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert new_norm < 1.01
+
+
+def test_split_and_load():
+    data = nd.arange(0, 12).reshape(6, 2)
+    parts = gluon.split_and_load(data, [mx.cpu(0), mx.cpu(0)])
+    assert len(parts) == 2
+    assert parts[0].shape == (3, 2)
+
+
+def test_hybridize_with_dropout_differs_across_calls():
+    net = nn.HybridSequential()
+    net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((50, 50))
+    with autograd.record(train_mode=True):
+        y1 = net(x).asnumpy()
+        y2 = net(x).asnumpy()
+    assert not np.allclose(y1, y2), "dropout mask must differ across calls"
