@@ -1,0 +1,87 @@
+"""Gluon DataLoader.
+
+Reference: ``python/mxnet/gluon/data/dataloader.py`` — DataLoader with
+multiprocessing workers (worker_loop:113) and shared-memory NDArray
+pickling.
+
+TPU-native: worker processes feed host numpy; device transfer happens in
+the training step (device_put inside jit dispatch), so the loader stays
+a pure host pipeline.  num_workers>0 uses a thread pool rather than
+fork-based workers — jax runtimes don't survive fork, and the decode
+work (numpy/PIL) releases the GIL.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py:87)."""
+    if isinstance(data[0], NDArray):
+        return ndarray.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return ndarray.array(data, dtype=data.dtype if data.dtype != np.float64
+                         else np.float32)
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference: dataloader.py:146)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = num_workers
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[int(idx)]
+                                         for idx in batch])
+            return
+        # threaded prefetch: decode batches ahead of consumption
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            futures = [
+                pool.submit(
+                    lambda b: self._batchify_fn(
+                        [self._dataset[int(idx)] for idx in b]), batch)
+                for batch in self._batch_sampler]
+            for fut in futures:
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,164 @@
+"""Inception v3 (reference:
+python/mxnet/gluon/model_zoo/vision/inception.py; arch per 1512.00567)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import (Activation, AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                   Flatten, GlobalAvgPool2D, HybridSequential, MaxPool2D)
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _make_basic_conv(**kwargs):
+    out = HybridSequential(prefix="")
+    out.add(Conv2D(use_bias=False, **kwargs))
+    out.add(BatchNorm(epsilon=0.001))
+    out.add(Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, *conv_settings):
+    out = HybridSequential(prefix="")
+    if use_pool == "avg":
+        out.add(AvgPool2D(pool_size=3, strides=1, padding=1))
+    elif use_pool == "max":
+        out.add(MaxPool2D(pool_size=3, strides=2))
+    setting_names = ["channels", "kernel_size", "strides", "padding"]
+    for setting in conv_settings:
+        kwargs = {}
+        for i, value in enumerate(setting):
+            if value is not None:
+                kwargs[setting_names[i]] = value
+        out.add(_make_basic_conv(**kwargs))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Parallel branches concatenated on channels."""
+
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        for i, b in enumerate(branches):
+            self.register_child(b, str(i))
+
+    def hybrid_forward(self, F, x):
+        outs = [b(x) for b in self._children.values()]
+        return F.Concat(*outs, dim=1)
+
+
+def _make_A(pool_features, prefix):
+    return _Concurrent([
+        _make_branch(None, (64, 1, None, None)),
+        _make_branch(None, (48, 1, None, None), (64, 5, None, 2)),
+        _make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                     (96, 3, None, 1)),
+        _make_branch("avg", (pool_features, 1, None, None)),
+    ], prefix=prefix)
+
+
+def _make_B(prefix):
+    return _Concurrent([
+        _make_branch(None, (384, 3, 2, None)),
+        _make_branch(None, (64, 1, None, None), (96, 3, None, 1),
+                     (96, 3, 2, None)),
+        _make_branch("max"),
+    ], prefix=prefix)
+
+
+def _make_C(channels_7x7, prefix):
+    return _Concurrent([
+        _make_branch(None, (192, 1, None, None)),
+        _make_branch(None, (channels_7x7, 1, None, None),
+                     (channels_7x7, (1, 7), None, (0, 3)),
+                     (192, (7, 1), None, (3, 0))),
+        _make_branch(None, (channels_7x7, 1, None, None),
+                     (channels_7x7, (7, 1), None, (3, 0)),
+                     (channels_7x7, (1, 7), None, (0, 3)),
+                     (channels_7x7, (7, 1), None, (3, 0)),
+                     (192, (1, 7), None, (0, 3))),
+        _make_branch("avg", (192, 1, None, None)),
+    ], prefix=prefix)
+
+
+def _make_D(prefix):
+    return _Concurrent([
+        _make_branch(None, (192, 1, None, None), (320, 3, 2, None)),
+        _make_branch(None, (192, 1, None, None),
+                     (192, (1, 7), None, (0, 3)),
+                     (192, (7, 1), None, (3, 0)),
+                     (192, 3, 2, None)),
+        _make_branch("max"),
+    ], prefix=prefix)
+
+
+def _make_E(prefix):
+    return _Concurrent([
+        _make_branch(None, (320, 1, None, None)),
+        _Split13(_make_basic_conv(channels=384, kernel_size=1)),
+        _Split13(_make_basic_conv(channels=448, kernel_size=1),
+                 _make_basic_conv(channels=384, kernel_size=3, padding=1)),
+        _make_branch("avg", (192, 1, None, None)),
+    ], prefix=prefix)
+
+
+class _Split13(HybridBlock):
+    """stem -> (1x3 branch || 3x1 branch) concat (inception E mixed split)."""
+
+    def __init__(self, *stem, **kwargs):
+        super().__init__(**kwargs)
+        self.stem = HybridSequential(prefix="")
+        for s in stem:
+            self.stem.add(s)
+        self.b13 = _make_basic_conv(channels=384, kernel_size=(1, 3),
+                                    padding=(0, 1))
+        self.b31 = _make_basic_conv(channels=384, kernel_size=(3, 1),
+                                    padding=(1, 0))
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        return F.Concat(self.b13(x), self.b31(x), dim=1)
+
+
+class Inception3(HybridBlock):
+    """Inception v3 (reference: inception.py:141)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = HybridSequential(prefix="")
+            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
+                                               strides=2))
+            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
+            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
+                                               padding=1))
+            self.features.add(MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
+            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
+            self.features.add(MaxPool2D(pool_size=3, strides=2))
+            self.features.add(_make_A(32, "A1_"))
+            self.features.add(_make_A(64, "A2_"))
+            self.features.add(_make_A(64, "A3_"))
+            self.features.add(_make_B("B_"))
+            self.features.add(_make_C(128, "C1_"))
+            self.features.add(_make_C(160, "C2_"))
+            self.features.add(_make_C(160, "C3_"))
+            self.features.add(_make_C(192, "C4_"))
+            self.features.add(_make_D("D_"))
+            self.features.add(_make_E("E1_"))
+            self.features.add(_make_E("E2_"))
+            self.features.add(AvgPool2D(pool_size=8))
+            self.features.add(Dropout(0.5))
+            self.output = Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        x = self.output(x)
+        return x
+
+
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    """Reference: inception.py inception_v3."""
+    net = Inception3(**kwargs)
+    if pretrained:
+        raise ValueError("pretrained weights unavailable (no network egress)")
+    return net

@@ -1,0 +1,153 @@
+"""Gluon data tests (reference: tests/python/unittest/test_gluon_data.py)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import data as gdata
+
+
+def test_array_dataset():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    ds = gdata.ArrayDataset(X, y)
+    assert len(ds) == 10
+    x0, y0 = ds[3]
+    assert np.allclose(x0, X[3]) and y0 == 3
+
+
+def test_simple_dataset_transform():
+    ds = gdata.SimpleDataset(list(range(5)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[2] == 4
+    tf = gdata.ArrayDataset(np.arange(4, dtype=np.float32),
+                            np.arange(4)).transform_first(lambda x: x + 1)
+    x0, y0 = tf[0]
+    assert x0 == 1 and y0 == 0
+
+
+def test_samplers():
+    seq = list(gdata.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gdata.RandomSampler(100))
+    assert sorted(rnd) == list(range(100))
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = gdata.BatchSampler(gdata.SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # 1 rolled + 7 = 8 -> 2 full + 2 left
+
+
+def test_dataloader():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, y), batch_size=4,
+                              last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.shape == (4, 3)
+    assert by.shape == (4,)
+    assert len(loader) == 3
+
+
+def test_dataloader_shuffle_threaded():
+    X = np.arange(20, dtype=np.float32).reshape(20, 1)
+    loader = gdata.DataLoader(gdata.ArrayDataset(X, np.arange(20)),
+                              batch_size=5, shuffle=True, num_workers=2)
+    seen = []
+    for bx, by in loader:
+        assert (bx.asnumpy().ravel() == by.asnumpy().ravel()).all()
+        seen.extend(by.asnumpy().ravel().tolist())
+    assert sorted(seen) == list(range(20))
+
+
+def test_record_file_dataset(tmp_path):
+    fname = str(tmp_path / "ds.rec")
+    idxname = str(tmp_path / "ds.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(4):
+        rec.write_idx(i, b"item%d" % i)
+    rec.close()
+    ds = gdata.RecordFileDataset(fname)
+    assert len(ds) == 4
+    assert ds[2] == b"item2"
+
+
+def test_mnist_dataset(tmp_path):
+    root = str(tmp_path)
+    n = 12
+    imgs = np.random.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = np.random.randint(0, 10, n, dtype=np.uint8)
+    with open(os.path.join(root, "train-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with open(os.path.join(root, "train-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    ds = gdata.vision.MNIST(root=root, train=True)
+    assert len(ds) == n
+    img, lab = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert lab == labels[0]
+    loader = gdata.DataLoader(
+        ds.transform_first(gdata.vision.transforms.ToTensor()), batch_size=6)
+    bx, by = next(iter(loader))
+    assert bx.shape == (6, 1, 28, 28)
+    assert float(bx.asnumpy().max()) <= 1.0
+
+
+def test_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = nd.array(np.random.randint(0, 255, (32, 32, 3)), dtype="uint8")
+    out = T.ToTensor()(img)
+    assert out.shape == (3, 32, 32)
+    norm = T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])(out)
+    assert norm.shape == (3, 32, 32)
+    assert T.Resize(16)(img).shape == (16, 16, 3)
+    assert T.CenterCrop(20)(img).shape == (20, 20, 3)
+    assert T.RandomResizedCrop(24)(img).shape == (24, 24, 3)
+    T.RandomFlipLeftRight()(img)
+    T.RandomFlipTopBottom()(img)
+    T.RandomBrightness(0.3)(img)
+    T.RandomContrast(0.3)(img)
+    T.RandomSaturation(0.3)(img)
+    jitter = T.ColorJitter(0.2, 0.2, 0.2)
+    assert jitter(img).shape == (32, 32, 3)
+    comp = T.Compose([T.Resize(16), T.ToTensor()])
+    assert comp(img).shape == (3, 16, 16)
+    assert T.Cast("float32")(img).dtype == np.float32
+
+
+def test_model_zoo_smoke():
+    from mxnet_tpu.gluon.model_zoo import vision as models
+    x = nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32))
+    net = models.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = models.get_model("resnet18_v2", classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = models.get_model("mobilenet0.25", classes=10)
+    net.initialize()
+    assert net(x).shape == (1, 10)
+    net = models.get_model("squeezenet1.1", classes=10)
+    net.initialize()
+    out = net(nd.array(np.random.rand(1, 3, 64, 64).astype(np.float32)))
+    assert out.shape == (1, 10)
+
+
+def test_resnet50_v1_builds():
+    from mxnet_tpu.gluon.model_zoo import vision as models
+    net = models.resnet50_v1(classes=1000)
+    net.initialize()
+    out = net(nd.array(np.random.rand(1, 3, 224, 224).astype(np.float32)))
+    assert out.shape == (1, 1000)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in net.collect_params().values())
+    assert 2.4e7 < n_params < 2.7e7, n_params  # ~25.5M params

@@ -1,0 +1,137 @@
+"""Gluon RNN tests (reference: tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_layer_shapes():
+    layer = rnn.RNN(16, num_layers=2)
+    layer.initialize()
+    x = nd.ones((5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    out, states = layer(x, layer.begin_state(3))
+    assert out.shape == (5, 3, 16)
+    assert states[0].shape == (2, 3, 16)
+
+
+def test_lstm_layer():
+    layer = rnn.LSTM(12, num_layers=1)
+    layer.initialize()
+    x = nd.ones((4, 2, 6))
+    out, states = layer(x, layer.begin_state(2))
+    assert out.shape == (4, 2, 12)
+    assert len(states) == 2
+    assert states[0].shape == (1, 2, 12)
+    assert states[1].shape == (1, 2, 12)
+
+
+def test_gru_layer_ntc_bidirectional():
+    layer = rnn.GRU(8, num_layers=1, layout="NTC", bidirectional=True)
+    layer.initialize()
+    x = nd.ones((2, 5, 4))
+    out = layer(x)
+    assert out.shape == (2, 5, 16)
+
+
+def test_lstm_gradient_flow():
+    layer = rnn.LSTM(8)
+    layer.initialize()
+    x = nd.array(np.random.rand(3, 2, 4).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_rnn_cell_step_and_unroll():
+    cell = rnn.RNNCell(6, input_size=4)
+    cell.initialize()
+    x = nd.ones((2, 4))
+    states = cell.begin_state(2)
+    out, states2 = cell(x, states)
+    assert out.shape == (2, 6)
+    outputs, states3 = cell.unroll(3, nd.ones((2, 3, 4)), layout="NTC")
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 6)
+    merged, _ = cell.unroll(3, nd.ones((2, 3, 4)), layout="NTC",
+                            merge_outputs=True)
+    assert merged.shape == (2, 3, 6)
+
+
+def test_lstm_cell():
+    cell = rnn.LSTMCell(5, input_size=3)
+    cell.initialize()
+    out, states = cell(nd.ones((2, 3)), cell.begin_state(2))
+    assert out.shape == (2, 5)
+    assert len(states) == 2
+
+
+def test_gru_cell():
+    cell = rnn.GRUCell(5, input_size=3)
+    cell.initialize()
+    out, states = cell(nd.ones((2, 3)), cell.begin_state(2))
+    assert out.shape == (2, 5)
+    assert len(states) == 1
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.LSTMCell(4, input_size=4))
+    stack.initialize()
+    outputs, states = stack.unroll(3, nd.ones((2, 3, 3)), layout="NTC")
+    assert len(outputs) == 3
+    assert outputs[-1].shape == (2, 4)
+    assert len(states) == 4
+
+
+def test_dropout_residual_zoneout_cells():
+    base = rnn.RNNCell(4, input_size=4)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    outputs, _ = res.unroll(2, nd.ones((1, 2, 4)), layout="NTC")
+    assert outputs[0].shape == (1, 4)
+
+    dc = rnn.DropoutCell(0.5)
+    out, st = dc(nd.ones((2, 3)), [])
+    assert out.shape == (2, 3)
+
+    zc = rnn.ZoneoutCell(rnn.RNNCell(4, input_size=4), zoneout_states=0.3)
+    zc.initialize()
+    out, states = zc(nd.ones((2, 4)), zc.begin_state(2))
+    assert out.shape == (2, 4)
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                               rnn.LSTMCell(4, input_size=3))
+    bi.initialize()
+    outputs, states = bi.unroll(3, nd.ones((2, 3, 3)), layout="NTC")
+    assert len(outputs) == 3
+    assert outputs[0].shape == (2, 8)
+
+
+def test_rnn_vs_cell_consistency():
+    # fused RNN layer must match manual RNNCell unroll with same params
+    T, N, I, H = 3, 2, 4, 5
+    layer = rnn.RNN(H, num_layers=1, activation="tanh")
+    layer.initialize()
+    x = nd.array(np.random.rand(T, N, I).astype(np.float32))
+    out_layer = layer(x).asnumpy()
+
+    cell = rnn.RNNCell(H, activation="tanh", input_size=I)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outputs, _ = cell.unroll(T, x, layout="TNC")
+    out_cell = np.stack([o.asnumpy() for o in outputs])
+    assert_almost_equal(out_layer, out_cell, rtol=1e-4, atol=1e-5)
