@@ -1,0 +1,65 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Baseline (BASELINE.md / docs/faq/perf.md:185): 181.53 img/s training
+ResNet-50 batch 32 on 1x P100.  The driver runs this on real TPU
+hardware; prints ONE JSON line.
+
+The whole train step (fwd + bwd + SGD-momentum update) is one jitted
+XLA program; bf16 matmul precision on the MXU is jax's TPU default.
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53
+BATCH = 32
+IMAGE = 224  # match the reference benchmark (batch 32, 224x224)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision as models
+
+    devices = jax.devices()
+    mesh = parallel.make_mesh(devices=devices)
+
+    net = models.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    net(nd.ones((1, 3, IMAGE, IMAGE)))  # materialize deferred shapes
+    trainer = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
+
+    n_dev = len(devices)
+    batch = BATCH * n_dev
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, IMAGE, IMAGE).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+
+    # warmup / compile
+    for _ in range(3):
+        loss = trainer.step(x, y)
+    loss.asnumpy()
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    loss.asnumpy()  # sync
+    dt = time.perf_counter() - t0
+
+    img_s = steps * batch / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,7 @@ from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import callback  # noqa: F401
 from . import gluon  # noqa: F401
+from . import parallel  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import model  # noqa: F401

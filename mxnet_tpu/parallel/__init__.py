@@ -1,0 +1,19 @@
+"""Parallelism subsystem — the TPU-native scale-out layer.
+
+Replaces the reference's kvstore/ps-lite/NCCL machinery (SURVEY.md §2.8,
+§5.8) with mesh shardings + compiled collectives, and adds the
+parallelism the reference lacks (§2.14): tensor/FSDP sharding, sequence
+parallelism (ring/Ulysses attention).
+"""
+from .mesh import (  # noqa: F401
+    make_mesh, current_mesh, mesh_scope, replicated, batch_sharded, P,
+    NamedSharding, Mesh,
+)
+from .optimizer import PureSGD, PureAdam, make_optimizer  # noqa: F401
+from .trainer import ParallelTrainer, pure_block_apply  # noqa: F401
+from .attention import (  # noqa: F401
+    ring_attention, ulysses_attention, local_attention,
+)
+from .distributed import (  # noqa: F401
+    init_distributed, rank, num_workers, is_initialized,
+)

@@ -1,0 +1,156 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference predates attention entirely (SURVEY.md §5.7 — its
+long-sequence story is bucketing + fused cuDNN RNN).  These are the
+first-class TPU-native long-context primitives layered on the collective
+backend, as SURVEY.md §7 requires:
+
+- ``ring_attention``: blockwise-stable attention over a sequence-sharded
+  mesh axis.  K/V blocks rotate around the ring via ``lax.ppermute``
+  (ICI neighbor exchange) while each device accumulates its queries'
+  output with running log-sum-exp — memory O(T/sp) per device,
+  overlapping compute with the permute.  (Liu et al. 2310.01889.)
+- ``ulysses_attention``: all-to-all resharding seq->heads, local full
+  attention, all-to-all back (Jacobs et al. 2309.14509).  Cheaper when
+  heads % sp == 0; ring has no head-count constraint.
+
+Both run under ``shard_map`` over the "sp" axis; causal masking uses
+global position offsets per shard.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+
+
+def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
+                    scale=None):
+    """Plain softmax attention on local blocks.
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D).  Offsets give the global
+    positions of the first query/key for causal masking across shards."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = kv_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-device body under shard_map: rotate K/V around the ring."""
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    q_offset = idx * t_local
+
+    def block(carry, kv_and_src):
+        o, m, l = carry                  # running output, max, denom
+        kk, vv, src = kv_and_src
+        kv_offset = src * t_local
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+        if causal:
+            qpos = q_offset + jnp.arange(t_local)
+            kpos = kv_offset + jnp.arange(t_local)
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        block_max = jnp.max(logits, axis=-1)                    # (b,h,q)
+        new_m = jnp.maximum(m, block_max)
+        # guard -inf rows (no valid key yet) against NaN in exp
+        new_m_safe = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        p = jnp.exp(logits - new_m_safe[..., None])
+        p = jnp.where(jnp.isneginf(logits), 0.0, p)
+        correction = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m)
+                             - new_m_safe)
+        correction = jnp.where(jnp.isneginf(m), 0.0, correction)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vv)
+        return (o_new, new_m, l_new)
+
+    o = jnp.zeros((b, h, t_local, d), q.dtype)
+    m = jnp.full((b, h, t_local), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, t_local), q.dtype)
+    carry = (o, m, l)
+
+    kk, vv = k, v
+    src = idx
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    for step in range(axis_size):
+        carry = block(carry, (kk, vv, src))
+        if step != axis_size - 1:
+            # neighbor exchange on ICI; overlaps with next block's compute
+            kk = lax.ppermute(kk, axis_name, perm)
+            vv = lax.ppermute(vv, axis_name, perm)
+            src = (src - 1) % axis_size
+    o, m, l = carry
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3))  # (b, t_local, h, d)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                   scale=None):
+    """Ring attention over a sequence-sharded axis.
+
+    Inputs (B, T, H, D) with T sharded over ``axis_name``; output has the
+    same sharding.  Used directly or as the attention core of
+    sequence-parallel transformer layers."""
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """all-to-all seq->head, full local attention, all-to-all back."""
+    sp = lax.psum(1, axis_name)
+    # (b, t/sp, h, d) -> gather seq, scatter heads -> (b, t, h/sp, d)
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = local_attention(q, k, v, causal=causal, scale=scale)
+    # back: scatter seq, gather heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                      scale=None):
+    """DeepSpeed-Ulysses style sequence parallelism; requires
+    num_heads % sp == 0."""
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return local_attention(q, k, v, causal=causal, scale=scale)
+    sp = mesh.shape[axis_name]
+    assert q.shape[2] % sp == 0, \
+        "ulysses needs heads (%d) divisible by sp (%d); use ring_attention" \
+        % (q.shape[2], sp)
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

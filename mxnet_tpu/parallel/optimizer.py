@@ -1,0 +1,112 @@
+"""Pure-functional optimizer kernels for compiled train steps.
+
+The in-place ``Optimizer.update`` API (optimizer.py) cannot live inside
+a jitted step; these adapters re-express the same fused update kernels
+(ops/optimizer_ops.py, reference src/operator/optimizer_op-inl.h) as
+pure pytree transforms: ``init(params) -> state``,
+``apply(params, grads, state, lr) -> (params, state)``.  The whole
+update fuses into the train-step XLA program — the reference's
+update-on-kvstore collapses into the compiled step.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["PureSGD", "PureAdam", "make_optimizer"]
+
+
+class PureSGD:
+    """SGD(+momentum, +wd) as a pure transform."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=None):
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return {}
+        return {"mom": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def apply(self, params, grads, state, lr=None):
+        lr = self.lr if lr is None else lr
+        clip = self.clip_gradient
+
+        def prep(g, w):
+            g = g * self.rescale_grad
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            return g + self.wd * w
+
+        if self.momentum == 0.0:
+            new_params = jax.tree_util.tree_map(
+                lambda w, g: w - lr * prep(g, w), params, grads)
+            return new_params, state
+        mom = state["mom"]
+        new_mom = jax.tree_util.tree_map(
+            lambda m, g, w: self.momentum * m - lr * prep(g, w),
+            mom, grads, params)
+        new_params = jax.tree_util.tree_map(lambda w, m: w + m, params,
+                                            new_mom)
+        return new_params, {"mom": new_mom}
+
+
+class PureAdam:
+    """Adam as a pure transform."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None):
+        self.lr = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+
+    def init(self, params):
+        z = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"mean": z,
+                "var": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def apply(self, params, grads, state, lr=None):
+        lr = self.lr if lr is None else lr
+        t = state["t"] + 1
+        b1, b2 = self.beta1, self.beta2
+        coef = jnp.sqrt(1 - b2 ** t.astype(jnp.float32)) / \
+            (1 - b1 ** t.astype(jnp.float32))
+        clip = self.clip_gradient
+
+        def prep(g, w):
+            g = g * self.rescale_grad
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            return g + self.wd * w
+
+        new_mean = jax.tree_util.tree_map(
+            lambda m, g, w: b1 * m + (1 - b1) * prep(g, w),
+            state["mean"], grads, params)
+        new_var = jax.tree_util.tree_map(
+            lambda v, g, w: b2 * v + (1 - b2) * jnp.square(prep(g, w)),
+            state["var"], grads, params)
+        new_params = jax.tree_util.tree_map(
+            lambda w, m, v: w - lr * coef * m / (jnp.sqrt(v) + self.epsilon),
+            params, new_mean, new_var)
+        return new_params, {"mean": new_mean, "var": new_var, "t": t}
+
+
+def make_optimizer(name, **kwargs):
+    name = name.lower()
+    if name == "sgd":
+        return PureSGD(**kwargs)
+    if name == "adam":
+        return PureAdam(**kwargs)
+    raise MXNetError("unknown pure optimizer %r (sgd/adam supported in the "
+                     "compiled step; others via the eager Trainer)" % name)
