@@ -36,4 +36,14 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
+from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
+from . import engine  # noqa: F401
+from . import operator  # noqa: F401
+from .operator import CustomOp, CustomOpProp  # noqa: F401
+from . import log  # noqa: F401
+from . import rtc  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -1,0 +1,35 @@
+"""Engine control API.
+
+Reference: ``python/mxnet/engine.py`` — bulk(size) scope that batches
+engine pushes (MXEngineSetBulkSize).
+
+TPU-native: the dependency engine is XLA's async dispatch; "bulking" —
+the reference's trick of fusing many small ops into one engine job
+(graph_executor.cc:1336 op segments) — corresponds to jit boundaries
+here.  The bulk scope is kept for API parity and records the requested
+size so instrumented callers can observe it; actual fusion is already
+maximal (whole-graph jit)."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_BULK_SIZE = [0]
+
+
+def set_bulk_size(size):
+    """Set sync-op bulking limit (reference: engine.py set_bulk_size)."""
+    prev = _BULK_SIZE[0]
+    _BULK_SIZE[0] = int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Bulk scope (reference: engine.py:26-60)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -14,3 +14,14 @@ from .utils import zeros as _zeros_util  # noqa: F401
 from . import register as _register
 
 _register.populate(__name__)
+
+
+def Custom(*args, op_type=None, **kwargs):
+    """Run a registered custom op (reference: src/operator/custom/custom.cc,
+    python surface mx.nd.Custom(data, op_type=...))."""
+    from ..operator import _invoke_custom
+    from .ndarray import NDArray
+    if op_type is None:
+        raise ValueError("op_type is required for Custom")
+    inputs = [a for a in args if isinstance(a, NDArray)]
+    return _invoke_custom(op_type, inputs, kwargs)

@@ -1,0 +1,141 @@
+"""Custom operators with Python callbacks.
+
+Reference: ``python/mxnet/operator.py`` — CustomOp (:426), CustomOpProp
+(:472), register (:692); C++ side ``src/operator/custom/custom.cc`` runs
+the Python callbacks on a dedicated thread.
+
+TPU-native: a registered custom op executes its Python ``forward`` /
+``backward`` eagerly on host arrays — the jax equivalent of the
+reference's callback thread is ``jax.pure_callback``, used when a custom
+op appears inside a jitted graph (hybridize/symbolic executor); eagerly
+we just call it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from . import autograd
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered_operators"]
+
+_CUSTOM_OP_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops (reference: operator.py:426)."""
+
+    def __init__(self):
+        pass
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign src to dst per req (reference: operator.py assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Op properties: shapes, types, args (reference: operator.py:472)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp class; usable as mx.nd.Custom(op_type=name)
+    (reference: operator.py:692)."""
+
+    def do_register(prop_cls):
+        _CUSTOM_OP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_CUSTOM_OP_REGISTRY)
+
+
+def _invoke_custom(op_type, inputs, kwargs):
+    """Eager execution of a registered custom op with autograd support."""
+    prop_cls = _CUSTOM_OP_REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError("custom op %r is not registered" % op_type)
+    import inspect
+    sig = inspect.signature(prop_cls.__init__)
+    accepted = {k: v for k, v in kwargs.items()
+                if k in sig.parameters or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values())}
+    prop = prop_cls(**{k: str(v) for k, v in accepted.items()})
+    in_shapes = [list(x.shape) for x in inputs]
+    ishapes, oshapes, ashapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes, [x.dtype for x in inputs])
+
+    out_data = [nd_zeros(tuple(s)) for s in oshapes]
+    aux = [nd_zeros(tuple(s)) for s in ashapes]
+    is_train = autograd.is_training()
+    with autograd.pause():
+        op.forward(is_train=is_train, req=["write"] * len(out_data),
+                   in_data=list(inputs), out_data=out_data, aux=aux)
+
+    if autograd.is_recording() and any(
+            getattr(x, "_ag_leaf", False) or getattr(x, "_ag_slot", None)
+            is not None for x in inputs):
+        def vjp_fn(out_cts, _op=op, _ins=list(inputs), _outs=out_data):
+            if not isinstance(out_cts, tuple):
+                out_cts = (out_cts,)
+            in_grads = [nd_zeros(x.shape) for x in _ins]
+            with autograd.pause():
+                _op.backward(req=["write"] * len(in_grads),
+                             out_grad=[NDArray(g) for g in out_cts],
+                             in_data=_ins, out_data=_outs,
+                             in_grad=in_grads, aux=[])
+            return [g._data for g in in_grads]
+
+        autograd.record_entry(vjp_fn, list(inputs), out_data,
+                              [o._data for o in out_data])
+    if len(out_data) == 1:
+        return out_data[0]
+    return out_data

@@ -1,0 +1,245 @@
+"""Profiler — chrome://tracing output + custom instrumentation.
+
+Reference: ``python/mxnet/profiler.py`` (set_config:28, set_state,
+dump/dumps, pause/resume, Domain/Task/Frame/Counter/Marker :151-300)
+over ``src/profiler/profiler.h`` which emits chrome-trace JSON.
+
+TPU-native: device-side op timing comes from ``jax.profiler`` (XLA's
+own tracer -> Perfetto/TensorBoard); this module keeps the reference's
+chrome-trace JSON dump API for host-side spans and custom
+instrumentation objects, and bridges start/stop to jax.profiler when a
+trace dir is configured.  Env autostart: MXNET_PROFILER_AUTOSTART.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "profiler_set_config", "set_state",
+           "profiler_set_state", "dump", "dumps", "dump_profile", "pause",
+           "resume", "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_STATE = {
+    "running": False,
+    "paused": False,
+    "filename": "profile.json",
+    "jax_trace_dir": None,
+    "jax_active": False,
+    "events": [],
+    "lock": threading.Lock(),
+    "start_time": None,
+}
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1000.0
+
+
+def set_config(**kwargs):
+    """Configure profiler (reference: profiler.py:28 set_config).
+
+    Accepts the reference kwargs (profile_symbolic, profile_imperative,
+    profile_memory, profile_api, filename, aggregate_stats...) plus
+    ``jax_trace_dir`` to also capture an XLA device trace."""
+    _STATE["filename"] = kwargs.get("filename", _STATE["filename"])
+    _STATE["jax_trace_dir"] = kwargs.get("jax_trace_dir",
+                                         _STATE["jax_trace_dir"])
+    _STATE["config"] = dict(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    """Start/stop profiling (reference: profiler.py set_state)."""
+    assert state in ("stop", "run")
+    if state == "run" and not _STATE["running"]:
+        _STATE["running"] = True
+        _STATE["start_time"] = _now_us()
+        if _STATE["jax_trace_dir"]:
+            import jax
+            jax.profiler.start_trace(_STATE["jax_trace_dir"])
+            _STATE["jax_active"] = True
+    elif state == "stop" and _STATE["running"]:
+        _STATE["running"] = False
+        if _STATE["jax_active"]:
+            import jax
+            jax.profiler.stop_trace()
+            _STATE["jax_active"] = False
+
+
+profiler_set_state = set_state
+
+
+def is_running():
+    return _STATE["running"] and not _STATE["paused"]
+
+
+def pause(profile_process="worker"):
+    """Reference: profiler.py pause."""
+    _STATE["paused"] = True
+
+
+def resume(profile_process="worker"):
+    """Reference: profiler.py resume."""
+    _STATE["paused"] = False
+
+
+def _record(name, cat, ph, ts=None, args=None, dur=None, pid=0, tid=None):
+    if not is_running():
+        return
+    ev = {"name": name, "cat": cat, "ph": ph,
+          "ts": ts if ts is not None else _now_us(), "pid": pid,
+          "tid": tid if tid is not None else threading.get_ident() % 100000}
+    if args:
+        ev["args"] = args
+    if dur is not None:
+        ev["dur"] = dur
+    with _STATE["lock"]:
+        _STATE["events"].append(ev)
+
+
+def record_span(name, start_us, end_us, cat="operator", args=None):
+    """Record a complete span (used by instrumented internals)."""
+    _record(name, cat, "X", ts=start_us, dur=end_us - start_us, args=args)
+
+
+def dumps(reset=False):
+    """Return chrome-trace JSON string (reference: profiler.py dumps)."""
+    with _STATE["lock"]:
+        events = list(_STATE["events"])
+        if reset:
+            _STATE["events"] = []
+    return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"},
+                      indent=2)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write chrome-trace JSON to the configured file (reference:
+    profiler.py dump)."""
+    with open(_STATE["filename"], "w") as f:
+        f.write(dumps())
+
+
+dump_profile = dump  # deprecated alias (reference keeps it)
+
+
+class Domain:
+    """Profiling domain (reference: profiler.py:151)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Span:
+    """start/stop span base (Task/Frame/Event share this shape)."""
+
+    _cat = "task"
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+        self._start = None
+
+    def start(self):
+        self._start = _now_us()
+
+    def stop(self):
+        if self._start is not None:
+            record_span(self.name, self._start, _now_us(), cat=self._cat,
+                        args={"domain": str(self.domain)})
+            self._start = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def __str__(self):
+        return self.name
+
+
+class Task(_Span):
+    """Reference: profiler.py Task."""
+    _cat = "task"
+
+
+class Frame(_Span):
+    """Reference: profiler.py Frame."""
+    _cat = "frame"
+
+
+class Event(_Span):
+    """Reference: profiler.py Event (no domain)."""
+    _cat = "event"
+
+    def __init__(self, name):
+        super().__init__(None, name)
+
+
+class Counter:
+    """Numeric counter series (reference: profiler.py Counter)."""
+
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self._value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self._value = value
+        _record(self.name, "counter", "C",
+                args={self.name: value, "domain": str(self.domain)})
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+    def __str__(self):
+        return self.name
+
+
+class Marker:
+    """Instant marker (reference: profiler.py Marker)."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _record(self.name, "marker", "i",
+                args={"domain": str(self.domain), "scope": scope})
+
+
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    set_state("run")

@@ -1,0 +1,70 @@
+"""Runtime compilation API.
+
+Reference: ``python/mxnet/rtc.py`` — CudaModule/CudaKernel compile CUDA C
+source at runtime via NVRTC and launch on GPU arrays.
+
+TPU-native equivalent: runtime kernels are Pallas/jax functions compiled
+by XLA.  ``PallasModule`` keeps the module/kernel API shape: pass a
+python source string defining a jax function, get a launchable kernel.
+The CUDA entry points raise with guidance (no CUDA on TPU).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.ndarray import _wrap
+
+__all__ = ["CudaModule", "CudaKernel", "PallasModule"]
+
+
+class CudaModule:  # pragma: no cover - CUDA unavailable by design
+    """Reference: rtc.py CudaModule — unsupported on TPU."""
+
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CUDA runtime compilation is not available on TPU. Use "
+            "mxnet_tpu.rtc.PallasModule with a jax/Pallas kernel source "
+            "instead.")
+
+
+class CudaKernel:  # pragma: no cover - CUDA unavailable by design
+    def __init__(self, *args, **kwargs):
+        raise MXNetError("CudaKernel is not available on TPU; see PallasModule.")
+
+
+class PallasModule:
+    """Compile python source defining jax/Pallas kernels at runtime.
+
+    >>> mod = PallasModule('''
+    ... import jax.numpy as jnp
+    ... def axpy(a, x, y):
+    ...     return a * x + y
+    ... ''', exports=["axpy"])
+    >>> kernel = mod.get_kernel("axpy")
+    >>> out = kernel(2.0, x, y)   # NDArrays in, NDArray out
+    """
+
+    def __init__(self, source, exports=()):
+        self._namespace = {}
+        exec(compile(source, "<rtc>", "exec"), self._namespace)
+        self._exports = list(exports) or [
+            k for k, v in self._namespace.items()
+            if callable(v) and not k.startswith("_")]
+
+    def get_kernel(self, name, signature=None):
+        if name not in self._exports or name not in self._namespace:
+            raise MXNetError("kernel %r not exported from module" % name)
+        fn = self._namespace[name]
+        import jax
+
+        jitted = jax.jit(fn)
+
+        def launch(*args):
+            datas = [a._data if isinstance(a, NDArray) else a for a in args]
+            out = jitted(*datas)
+            if isinstance(out, tuple):
+                return [_wrap(o) for o in out]
+            return _wrap(out)
+
+        launch.__name__ = name
+        return launch

@@ -1,0 +1,126 @@
+"""Aux subsystem tests (reference: test_profiler.py, test_viz.py,
+test_operator.py custom-op section, test_exc_handling.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / "trace.json")
+    mx.profiler.set_config(profile_all=True, filename=fname)
+    mx.profiler.set_state("run")
+    domain = mx.profiler.Domain("test")
+    task = domain.new_task("work")
+    with task:
+        nd.dot(nd.ones((32, 32)), nd.ones((32, 32))).wait_to_read()
+    counter = domain.new_counter("ctr", 5)
+    counter += 3
+    marker = domain.new_marker("here")
+    marker.mark()
+    mx.profiler.pause()
+    with domain.new_task("ignored"):
+        pass
+    mx.profiler.resume()
+    mx.profiler.set_state("stop")
+    mx.profiler.dump()
+    with open(fname) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    names = [e["name"] for e in events]
+    assert "work" in names
+    assert "ctr" in names
+    assert "here" in names
+    assert "ignored" not in names
+    # chrome trace format essentials
+    for e in events:
+        assert "ph" in e and "ts" in e and "pid" in e
+
+
+def test_monitor():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(data=[nd.ones((4, 5))],
+                                label=[nd.zeros((4,))]), is_train=False)
+    res = mon.toc()
+    assert len(res) > 0
+    names = [r[1] for r in res]
+    assert any("softmax" in n or "fc" in n for n in names)
+
+
+def test_print_summary(capsys):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    total = mx.viz.print_summary(fc2, shape={"data": (1, 10)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # fc1: 10*8+8=88, fc2: 8*2+2=18
+    assert total == 106
+
+
+def test_engine_bulk():
+    with mx.engine.bulk(16):
+        a = nd.ones((4,))
+        for _ in range(3):
+            a = a + 1
+    assert_almost_equal(a, np.full(4, 4.0))
+
+
+def test_custom_op():
+    @mx.operator.register("mysquare")
+    class MySquareProp(mx.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class MySquare(mx.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad,
+                             aux):
+                    self.assign(in_grad[0], req[0],
+                                2 * in_data[0] * out_grad[0])
+            return MySquare()
+
+    x = nd.array([1.0, 2.0, 3.0])
+    out = mx.nd.Custom(x, op_type="mysquare")
+    assert_almost_equal(out, [1, 4, 9])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="mysquare")
+    y.backward()
+    assert_almost_equal(x.grad, [2, 4, 6])
+    assert "mysquare" in mx.operator.get_all_registered_operators()
+
+
+def test_rtc_pallas_module():
+    mod = mx.rtc.PallasModule(
+        "import jax.numpy as jnp\n"
+        "def axpy(a, x, y):\n"
+        "    return a * x + y\n", exports=["axpy"])
+    kernel = mod.get_kernel("axpy")
+    out = kernel(2.0, nd.ones((3,)), nd.ones((3,)))
+    assert_almost_equal(out, [3, 3, 3])
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k(){}")
+
+
+def test_get_logger():
+    logger = mx.log.get_logger("test_mxtpu", level=mx.log.INFO)
+    logger.info("hello")
