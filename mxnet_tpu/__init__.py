@@ -36,6 +36,8 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import io  # noqa: F401
 from . import recordio  # noqa: F401
+from . import rnn  # noqa: F401
+from . import image  # noqa: F401
 from . import profiler  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401

@@ -54,3 +54,16 @@ def _shape_array(x, **attrs):
 @register("size_array")
 def _size_array(x, **attrs):
     return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+@register("_rnn_state_zeros")
+def _rnn_state_zeros(ref, shape=None, ref_batch_axis=0, **attrs):
+    """Zero initial RNN state whose batch dim comes from `ref`.
+
+    Dims equal to 0 in `shape` are replaced by the ref's batch dim,
+    making symbolic begin_state shape-inferable by forward abstract eval
+    (the reference achieves this with bidirectional InferShape,
+    src/executor/infer_graph_attr_pass.cc)."""
+    b = ref.shape[ref_batch_axis]
+    out_shape = tuple(b if d == 0 else int(d) for d in shape)
+    return jnp.zeros(out_shape, ref.dtype)

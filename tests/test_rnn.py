@@ -1,0 +1,170 @@
+"""Symbolic RNN tests (reference: tests/python/unittest/test_rnn.py,
+tests/python/train/test_bucketing.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(8, prefix="rnn_")
+    data = mx.sym.var("data")
+    inputs = [mx.sym.slice_axis(data, axis=1, begin=i, end=i + 1)
+              for i in range(3)]
+    inputs = [mx.sym.Reshape(s, shape=(-1, 4)) for s in inputs]
+    outputs, states = cell.unroll(3, inputs)
+    out = mx.sym.Group(outputs)
+    args = out.list_arguments()
+    assert "rnn_i2h_weight" in args and "rnn_h2h_weight" in args
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3, 4))
+    outs = exe.forward()
+    assert outs[0].shape == (2, 8)
+
+
+def test_lstm_gru_cell_unroll_merged():
+    for cell_cls, n_params in [(mx.rnn.LSTMCell, 4), (mx.rnn.GRUCell, 4)]:
+        cell = cell_cls(6)
+        data = mx.sym.var("data")
+        outputs, states = cell.unroll(4, data, layout="NTC",
+                                      merge_outputs=True)
+        exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 4, 3))
+        for name, arr in exe.arg_dict.items():
+            if name != "data":
+                arr[:] = nd.array(np.random.uniform(
+                    -0.1, 0.1, arr.shape).astype(np.float32))
+        outs = exe.forward()
+        assert outs[0].shape == (2, 4, 6)
+
+
+def test_fused_rnn_cell():
+    cell = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm",
+                               get_next_state=True)
+    data = mx.sym.var("data")
+    outputs, states = cell.unroll(5, data, layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(3, 5, 4))
+    outs = exe.forward()
+    assert outs[0].shape == (3, 5, 8)
+    assert len(states) == 2
+
+
+def test_fused_unfuse_match():
+    T, N, I, H = 3, 2, 4, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm", prefix="l_")
+    data = mx.sym.var("data")
+    fo, _ = fused.unroll(T, data, layout="NTC", merge_outputs=True)
+    exe_f = fo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    rng = np.random.RandomState(0)
+    pvec = rng.uniform(-0.2, 0.2,
+                       exe_f.arg_dict["l_parameters"].shape).astype(np.float32)
+    exe_f.arg_dict["l_parameters"][:] = nd.array(pvec)
+    x = rng.uniform(size=(N, T, I)).astype(np.float32)
+    exe_f.arg_dict["data"][:] = nd.array(x)
+    out_fused = exe_f.forward()[0].asnumpy()
+
+    unfused = fused.unfuse()
+    uo, _ = unfused.unroll(T, data, layout="NTC", merge_outputs=True)
+    exe_u = uo.simple_bind(ctx=mx.cpu(), data=(N, T, I))
+    # pack the unfused weights from the fused vector layout
+    G = 4
+    off = 0
+    wi = pvec[off:off + G * H * I].reshape(G * H, I); off += G * H * I
+    wh = pvec[off:off + G * H * H].reshape(G * H, H); off += G * H * H
+    bi = pvec[off:off + G * H]; off += G * H
+    bh = pvec[off:off + G * H]
+    exe_u.arg_dict["l_l0_i2h_weight"][:] = nd.array(wi)
+    exe_u.arg_dict["l_l0_h2h_weight"][:] = nd.array(wh)
+    exe_u.arg_dict["l_l0_i2h_bias"][:] = nd.array(bi)
+    exe_u.arg_dict["l_l0_h2h_bias"][:] = nd.array(bh)
+    exe_u.arg_dict["data"][:] = nd.array(x)
+    out_unfused = exe_u.forward()[0].asnumpy()
+    assert_almost_equal(out_fused, out_unfused, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_and_residual_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(4, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(4, prefix="l1_")))
+    data = mx.sym.var("data")
+    outputs, _ = stack.unroll(3, data, layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 3, 4))
+    assert exe.forward()[0].shape == (2, 3, 4)
+
+
+def test_bidirectional_cell_symbolic():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="fw_"),
+                                  mx.rnn.LSTMCell(4, prefix="bw_"))
+    data = mx.sym.var("data")
+    outputs, _ = bi.unroll(3, data, layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), data=(2, 3, 5))
+    assert exe.forward()[0].shape == (2, 3, 8)
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5, 6], [3, 4],
+                 [1, 2, 3, 4], [5, 6], [1, 2], [7, 8]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4, buckets=[3, 7],
+                                   invalid_label=0)
+    assert it.default_bucket_key == 7
+    batches = list(it)
+    assert len(batches) > 0
+    for b in batches:
+        assert b.bucket_key in (3, 7)
+        assert b.data[0].shape == (4, b.bucket_key)
+        assert b.label[0].shape == (4, b.bucket_key)
+        # label is data shifted left by one
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        assert (l[:, :-1] == d[:, 1:]).all()
+
+
+def test_bucketing_lm_training():
+    """Tiny LM: learn next-token id (reference: train/test_bucketing.py)."""
+    vocab = 10
+    rng = np.random.RandomState(0)
+    # deterministic sequences: next = (cur + 1) % vocab
+    sentences = []
+    for _ in range(64):
+        start = rng.randint(1, vocab)
+        ln = rng.choice([4, 8])
+        sentences.append([(start + i) % vocab for i in range(ln)])
+
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab, output_dim=8,
+                                 name="embed")
+        cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, 16))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+        label_r = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label_r, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    train_iter = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                           buckets=buckets, invalid_label=0)
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=train_iter.default_bucket_key,
+                                 context=mx.cpu())
+    mod.fit(train_iter, num_epoch=5,
+            eval_metric=mx.metric.Perplexity(ignore_label=None),
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    # after training, perplexity should be much lower than vocab
+    score = mod.score(train_iter, mx.metric.Perplexity(ignore_label=None))
+    assert score[0][1] < 4.0, score
+
+
+def test_rnn_checkpoint(tmp_path):
+    cell = mx.rnn.LSTMCell(4, prefix="l_")
+    data = mx.sym.var("data")
+    outputs, _ = cell.unroll(2, data, layout="NTC", merge_outputs=True)
+    prefix = str(tmp_path / "rnnmodel")
+    arg = {"l_i2h_weight": nd.ones((16, 3))}
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 1, outputs, arg, {})
+    sym2, arg2, aux2 = mx.rnn.load_rnn_checkpoint(cell, prefix, 1)
+    assert "l_i2h_weight" in arg2
