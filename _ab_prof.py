@@ -1,0 +1,40 @@
+import time
+import numpy as np
+import mxnet_tpu as mx
+import sys
+sys.path.insert(0, "/root/repo/example/image-classification")
+from symbols import resnet
+from mxnet_tpu.io import DataBatch, DataDesc
+
+B = 128
+def make(kv, fused_label):
+    sym = resnet.get_symbol(1000, 50, "3,224,224")
+    mod = mx.mod.Module(sym, context=mx.tpu(), compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data",(B,3,224,224))], label_shapes=[("softmax_label",(B,))], for_training=True)
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate":0.1,"momentum":0.9,"wd":1e-4})
+    return mod
+
+x = mx.nd.array(np.random.rand(B,3,224,224).astype(np.float32))
+y = mx.nd.array(np.random.randint(0,1000,B).astype(np.float32))
+batch = DataBatch(data=[x], label=[y], pad=0, index=None,
+                  provide_data=[DataDesc("data",(B,3,224,224),np.float32)],
+                  provide_label=[DataDesc("softmax_label",(B,),np.float32)])
+import mxnet_tpu.metric as metric
+
+def run(mod, n):
+    m = metric.create("accuracy")
+    for _ in range(n):
+        mod.forward(batch, is_train=True)
+        mod.update_metric(m,[y])
+        mod.backward(); mod.update()
+    float(mod.get_outputs()[0].asnumpy().sum())
+
+mod_f = make("tpu", True)
+print("fused installed:", mod_f._fused_exec_update)
+run(mod_f, 3)  # warm
+for trial in range(3):
+    t0=time.perf_counter(); run(mod_f, 15)
+    dt=(time.perf_counter()-t0)/15
+    print("fused  trial%d: %.1f ms/step -> %.0f img/s" % (trial, dt*1000, B/dt))

@@ -1,0 +1,31 @@
+import time, json, sys
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, parallel
+from mxnet_tpu.gluon.model_zoo import vision as models
+
+dtype = sys.argv[1] if len(sys.argv) > 1 else None
+batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+IMAGE = 224
+mesh = parallel.make_mesh(devices=jax.devices())
+net = models.resnet50_v1(classes=1000)
+net.initialize(mx.init.Xavier())
+net(nd.ones((1, 3, IMAGE, IMAGE)))
+tr = parallel.ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+    {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+    dtype=(None if dtype in (None, "f32", "fp32") else dtype))
+rng = np.random.RandomState(0)
+x = nd.array(rng.rand(batch, 3, IMAGE, IMAGE).astype(np.float32))
+y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+for _ in range(3):
+    loss = tr.step(x, y)
+loss.asnumpy()
+steps = 20
+t0 = time.perf_counter()
+for _ in range(steps):
+    loss = tr.step(x, y)
+loss.asnumpy()
+dt = time.perf_counter() - t0
+print(json.dumps({"dtype": dtype or "f32", "batch": batch,
+                  "img_s": round(steps * batch / dt, 2)}))

@@ -1,0 +1,36 @@
+import time
+import numpy as np
+import mxnet_tpu as mx
+import sys
+sys.path.insert(0, "/root/repo/example/image-classification")
+from symbols import resnet
+sym = resnet.get_symbol(1000, 50, "3,224,224")
+B = 128
+mod = mx.mod.Module(sym, context=mx.tpu(), compute_dtype="bfloat16")
+mod.bind(data_shapes=[("data",(B,3,224,224))], label_shapes=[("softmax_label",(B,))], for_training=True)
+mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
+mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                   optimizer_params={"learning_rate":0.1,"momentum":0.9,"wd":1e-4})
+from mxnet_tpu.io import DataBatch, DataDesc
+x = mx.nd.array(np.random.rand(B,3,224,224).astype(np.float32))
+y = mx.nd.array(np.random.randint(0,1000,B).astype(np.float32))
+batch = DataBatch(data=[x], label=[y], pad=0, index=None,
+                  provide_data=[DataDesc("data",(B,3,224,224),np.float32)],
+                  provide_label=[DataDesc("softmax_label",(B,),np.float32)])
+import mxnet_tpu.metric as metric
+m = metric.create("accuracy")
+for _ in range(3):
+    mod.forward(batch, is_train=True); mod.update_metric(m,[y]); mod.backward(); mod.update()
+mod.get_outputs()[0].asnumpy()
+tf=tm=tb=tu=0.0
+N=15
+t_all=time.perf_counter()
+for _ in range(N):
+    t0=time.perf_counter(); mod.forward(batch, is_train=True); tf+=time.perf_counter()-t0
+    t0=time.perf_counter(); mod.update_metric(m,[y]); tm+=time.perf_counter()-t0
+    t0=time.perf_counter(); mod.backward(); tb+=time.perf_counter()-t0
+    t0=time.perf_counter(); mod.update(); tu+=time.perf_counter()-t0
+mod.get_outputs()[0].asnumpy()
+t_all=time.perf_counter()-t_all
+print("fwd %.1f metric %.1f bwd %.1f update %.1f total %.1f ms/step"
+      % (tf/N*1000, tm/N*1000, tb/N*1000, tu/N*1000, t_all/N*1000))

@@ -1,0 +1,51 @@
+import time
+import numpy as np
+import mxnet_tpu as mx
+import sys
+sys.path.insert(0, "/root/repo/example/image-classification")
+from symbols import resnet
+
+sym = resnet.get_symbol(1000, 50, "3,224,224")
+B = 128
+mod = mx.mod.Module(sym, context=mx.tpu(), compute_dtype="bfloat16")
+mod.bind(data_shapes=[("data",(B,3,224,224))], label_shapes=[("softmax_label",(B,))], for_training=True)
+mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in", magnitude=2))
+mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                   optimizer_params={"learning_rate":0.1,"momentum":0.9,"wd":1e-4})
+from mxnet_tpu.io import DataBatch, DataDesc
+x = mx.nd.array(np.random.rand(B,3,224,224).astype(np.float32))
+y = mx.nd.array(np.random.randint(0,1000,B).astype(np.float32))
+batch = DataBatch(data=[x], label=[y], pad=0, index=None,
+                  provide_data=[DataDesc("data",(B,3,224,224),np.float32)],
+                  provide_label=[DataDesc("softmax_label",(B,),np.float32)])
+# warmup
+for _ in range(3):
+    mod.forward(batch, is_train=True); mod.backward(); mod.update()
+mod.get_outputs()[0].asnumpy()
+
+def bench(fn, n=20):
+    t0=time.perf_counter(); fn(n)
+    mod.get_outputs()[0].asnumpy()
+    return (time.perf_counter()-t0)/n*1000
+
+def full(n):
+    for _ in range(n):
+        mod.forward(batch, is_train=True); mod.backward(); mod.update()
+def fb_only(n):
+    for _ in range(n):
+        mod.forward(batch, is_train=True); mod.backward()
+def fwd_only(n):
+    for _ in range(n):
+        mod.forward(batch, is_train=True)
+
+print("fwd+bwd+update: %.1f ms/step -> %.0f img/s" % (bench(full), B/bench(full)*1000))
+print("fwd+bwd       : %.1f ms/step" % bench(fb_only))
+print("fwd(train)    : %.1f ms/step" % bench(fwd_only))
+import mxnet_tpu.metric as metric
+m = metric.create("accuracy")
+def with_metric(n):
+    for _ in range(n):
+        mod.forward(batch, is_train=True)
+        mod.update_metric(m, [y])
+        mod.backward(); mod.update()
+print("with metric   : %.1f ms/step" % bench(with_metric))

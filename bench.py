@@ -1,58 +1,53 @@
-"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+"""Benchmark: ResNet-50 training throughput through the north-star entry
+script (example/image-classification/train_imagenet.py --kv-store tpu).
 
 Baseline (BASELINE.md / docs/faq/perf.md:185): 181.53 img/s training
 ResNet-50 batch 32 on 1x P100.  The driver runs this on real TPU
 hardware; prints ONE JSON line.
 
-The whole train step (fwd + bwd + SGD-momentum update) is one jitted
-XLA program; bf16 matmul precision on the MXU is jax's TPU default.
+Methodology matches the reference's perf.md benchmark: synthetic data
+(--benchmark 1), Speedometer samples/sec readings, first reading
+discarded (contains compile time), median of the rest reported.
+The whole train step — fwd + bwd + SGD-momentum update — is ONE donated
+XLA program (executor fused step, kvstore=tpu), bf16 compute / fp32
+master params.
 """
 import json
-import time
-
-import numpy as np
+import os
+import re
+import subprocess
+import sys
 
 BASELINE_IMG_S = 181.53
-BATCH = 32
-IMAGE = 224  # match the reference benchmark (batch 32, 224x224)
+BATCH = 256
+SPEED_RE = re.compile(r"Speed:\s*([0-9.]+)\s*samples/sec")
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd, gluon, parallel
-    from mxnet_tpu.gluon.model_zoo import vision as models
-
-    devices = jax.devices()
-    mesh = parallel.make_mesh(devices=devices)
-
-    net = models.resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
-    net(nd.ones((1, 3, IMAGE, IMAGE)))  # materialize deferred shapes
-    trainer = parallel.ParallelTrainer(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh)
-
-    n_dev = len(devices)
-    batch = BATCH * n_dev
-    rng = np.random.RandomState(0)
-    x = nd.array(rng.rand(batch, 3, IMAGE, IMAGE).astype(np.float32))
-    y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
-
-    # warmup / compile
-    for _ in range(3):
-        loss = trainer.step(x, y)
-    loss.asnumpy()
-
-    steps = 10
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(x, y)
-    loss.asnumpy()  # sync
-    dt = time.perf_counter() - t0
-
-    img_s = steps * batch / dt
+    here = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(here, "example", "image-classification",
+                          "train_imagenet.py")
+    cmd = [sys.executable, script,
+           "--benchmark", "1", "--kv-store", "tpu",
+           "--network", "resnet", "--num-layers", "50",
+           "--batch-size", str(BATCH), "--dtype", "bfloat16",
+           "--num-epochs", "1", "--num-batches", "110",
+           "--disp-batches", "20"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=here)
+    text = proc.stdout + proc.stderr
+    if proc.returncode != 0:
+        sys.stderr.write(text[-4000:])
+        raise SystemExit("train_imagenet.py exited with %d" % proc.returncode)
+    speeds = [float(m.group(1)) for m in SPEED_RE.finditer(text)]
+    if not speeds:
+        sys.stderr.write(text[-4000:])
+        raise SystemExit("no Speedometer output from train_imagenet.py")
+    steady = speeds[1:] if len(speeds) > 1 else speeds
+    steady.sort()
+    img_s = steady[len(steady) // 2]
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec",
         "value": round(img_s, 2),

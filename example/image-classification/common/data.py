@@ -1,0 +1,131 @@
+"""Data-iterator plumbing for the training scripts.
+
+Reference parity: example/image-classification/common/data.py
+(add_data_args, add_data_aug_args, get_rec_iter, SyntheticDataIter for
+--benchmark).  TPU note: the benchmark iterator keeps one device-resident
+batch so the input pipeline is never the bottleneck being measured.
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataBatch, DataIter
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data", "the input images")
+    data.add_argument("--data-train", type=str, help="the training data")
+    data.add_argument("--data-val", type=str, help="the validation data")
+    data.add_argument("--rgb-mean", type=str, default="123.68,116.779,103.939",
+                      help="a tuple of size 3 for the mean rgb")
+    data.add_argument("--pad-size", type=int, default=0,
+                      help="padding the input image")
+    data.add_argument("--image-shape", type=str, default="3,224,224",
+                      help="the image shape feed into the network")
+    data.add_argument("--num-classes", type=int, default=1000,
+                      help="the number of classes")
+    data.add_argument("--num-examples", type=int, default=1281167,
+                      help="the number of training examples")
+    data.add_argument("--data-nthreads", type=int, default=4,
+                      help="number of threads for data decoding")
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="if 1, run on synthetic data (measures the "
+                           "compute path only)")
+    data.add_argument("--dtype", type=str, default="float32",
+                      help="data/compute dtype: float32 or bfloat16")
+    return data
+
+
+def add_data_aug_args(parser):
+    aug = parser.add_argument_group("Aug", "the image augmentations")
+    aug.add_argument("--random-crop", type=int, default=1,
+                     help="if or not randomly crop the image")
+    aug.add_argument("--random-mirror", type=int, default=1,
+                     help="if or not randomly flip horizontally")
+    aug.add_argument("--max-random-h", type=int, default=0)
+    aug.add_argument("--max-random-s", type=int, default=0)
+    aug.add_argument("--max-random-l", type=int, default=0)
+    aug.add_argument("--max-random-aspect-ratio", type=float, default=0)
+    aug.add_argument("--max-random-rotate-angle", type=int, default=0)
+    aug.add_argument("--max-random-shear-ratio", type=float, default=0)
+    aug.add_argument("--max-random-scale", type=float, default=1)
+    aug.add_argument("--min-random-scale", type=float, default=1)
+    return aug
+
+
+class SyntheticDataIter(DataIter):
+    """Fixed random batch, held on device — for --benchmark runs
+    (reference: common/data.py SyntheticDataIter)."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super().__init__(batch_size=data_shape[0])
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        self.dtype = dtype
+        label = np.random.randint(0, num_classes, (data_shape[0],))
+        data = np.random.uniform(-1, 1, data_shape)
+        self.data = mx.nd.array(data.astype(np.float32))
+        self.label = mx.nd.array(label.astype(np.float32))
+        self._provide_data = [mx.io.DataDesc("data", data_shape, np.float32)]
+        self._provide_label = [mx.io.DataDesc("softmax_label",
+                                              (data_shape[0],), np.float32)]
+
+    @property
+    def provide_data(self):
+        return self._provide_data
+
+    @property
+    def provide_label(self):
+        return self._provide_label
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return DataBatch(data=[self.data], label=[self.label], pad=0,
+                         index=None, provide_data=self._provide_data,
+                         provide_label=self._provide_label)
+
+    def __next__(self):
+        return self.next()
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    """Build train/val iterators from RecordIO files, or synthetic ones in
+    benchmark mode (reference: common/data.py get_rec_iter)."""
+    image_shape = tuple(int(x) for x in args.image_shape.split(","))
+    if getattr(args, "benchmark", 0):
+        data_shape = (args.batch_size,) + image_shape
+        train = SyntheticDataIter(args.num_classes, data_shape,
+                                  getattr(args, "num_batches", 100),
+                                  args.dtype)
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    rgb_mean = [float(x) for x in args.rgb_mean.split(",")]
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        label_width=1,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=bool(args.random_crop),
+        rand_mirror=bool(args.random_mirror),
+        preprocess_threads=args.data_nthreads,
+        shuffle=True,
+        num_parts=nworker, part_index=rank)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        label_width=1,
+        mean_r=rgb_mean[0], mean_g=rgb_mean[1], mean_b=rgb_mean[2],
+        rand_crop=False, rand_mirror=False,
+        preprocess_threads=args.data_nthreads,
+        num_parts=nworker, part_index=rank)
+    return train, val

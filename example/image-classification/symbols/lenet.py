@@ -1,0 +1,18 @@
+"""LeNet-5 style symbol (reference parity: symbols/lenet.py, the
+train_mnist.py default conv net)."""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=10, **kwargs):
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="tanh")
+    p1 = mx.sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    a2 = mx.sym.Activation(c2, act_type="tanh")
+    p2 = mx.sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = mx.sym.Flatten(p2)
+    f1 = mx.sym.FullyConnected(fl, num_hidden=500, name="fc1")
+    a3 = mx.sym.Activation(f1, act_type="tanh")
+    f2 = mx.sym.FullyConnected(a3, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(f2, name="softmax")

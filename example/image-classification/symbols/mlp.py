@@ -1,0 +1,13 @@
+"""3-layer MLP symbol (reference parity: symbols/mlp.py)."""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=10, **kwargs):
+    data = mx.sym.var("data")
+    data = mx.sym.Flatten(data)
+    f1 = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    a1 = mx.sym.Activation(f1, act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, num_hidden=64, name="fc2")
+    a2 = mx.sym.Activation(f2, act_type="relu")
+    f3 = mx.sym.FullyConnected(a2, num_hidden=num_classes, name="fc3")
+    return mx.sym.SoftmaxOutput(f3, name="softmax")

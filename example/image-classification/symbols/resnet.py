@@ -1,0 +1,165 @@
+"""ResNet v1/v2 symbol definitions for the Module training scripts.
+
+Reference parity: example/image-classification/symbols/resnet.py (the
+train_imagenet.py default network).  Redesigned for TPU: plain
+Convolution/BatchNorm symbols — XLA fuses the BN+ReLU epilogues into the
+conv MXU ops, so no hand-written fused blocks are needed at graph level.
+"""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottle_neck=True, bn_mom=0.9, version=1):
+    """One residual unit.
+
+    version 1: conv-bn-relu (post-activation, He 2015).
+    version 2: bn-relu-conv (pre-activation, He 2016).
+    """
+    eps = 2e-5
+    if bottle_neck:
+        mid = int(num_filter * 0.25)
+        if version == 2:
+            bn1 = mx.sym.BatchNorm(data, fix_gamma=False, eps=eps,
+                                   momentum=bn_mom, name=name + "_bn1")
+            act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+            conv1 = mx.sym.Convolution(act1, num_filter=mid, kernel=(1, 1),
+                                       stride=(1, 1), pad=(0, 0), no_bias=True,
+                                       name=name + "_conv1")
+            bn2 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=eps,
+                                   momentum=bn_mom, name=name + "_bn2")
+            act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+            conv2 = mx.sym.Convolution(act2, num_filter=mid, kernel=(3, 3),
+                                       stride=stride, pad=(1, 1), no_bias=True,
+                                       name=name + "_conv2")
+            bn3 = mx.sym.BatchNorm(conv2, fix_gamma=False, eps=eps,
+                                   momentum=bn_mom, name=name + "_bn3")
+            act3 = mx.sym.Activation(bn3, act_type="relu", name=name + "_relu3")
+            conv3 = mx.sym.Convolution(act3, num_filter=num_filter,
+                                       kernel=(1, 1), stride=(1, 1),
+                                       pad=(0, 0), no_bias=True,
+                                       name=name + "_conv3")
+            shortcut = data if dim_match else mx.sym.Convolution(
+                act1, num_filter=num_filter, kernel=(1, 1), stride=stride,
+                no_bias=True, name=name + "_sc")
+            return conv3 + shortcut
+        conv1 = mx.sym.Convolution(data, num_filter=mid, kernel=(1, 1),
+                                   stride=(1, 1), pad=(0, 0), no_bias=True,
+                                   name=name + "_conv1")
+        bn1 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=eps,
+                               momentum=bn_mom, name=name + "_bn1")
+        act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+        conv2 = mx.sym.Convolution(act1, num_filter=mid, kernel=(3, 3),
+                                   stride=stride, pad=(1, 1), no_bias=True,
+                                   name=name + "_conv2")
+        bn2 = mx.sym.BatchNorm(conv2, fix_gamma=False, eps=eps,
+                               momentum=bn_mom, name=name + "_bn2")
+        act2 = mx.sym.Activation(bn2, act_type="relu", name=name + "_relu2")
+        conv3 = mx.sym.Convolution(act2, num_filter=num_filter, kernel=(1, 1),
+                                   stride=(1, 1), pad=(0, 0), no_bias=True,
+                                   name=name + "_conv3")
+        bn3 = mx.sym.BatchNorm(conv3, fix_gamma=False, eps=eps,
+                               momentum=bn_mom, name=name + "_bn3")
+        if dim_match:
+            shortcut = data
+        else:
+            sc_conv = mx.sym.Convolution(data, num_filter=num_filter,
+                                         kernel=(1, 1), stride=stride,
+                                         no_bias=True, name=name + "_sc")
+            shortcut = mx.sym.BatchNorm(sc_conv, fix_gamma=False, eps=eps,
+                                        momentum=bn_mom, name=name + "_sc_bn")
+        return mx.sym.Activation(bn3 + shortcut, act_type="relu",
+                                 name=name + "_relu3")
+    # basic block (18/34 layers)
+    conv1 = mx.sym.Convolution(data, num_filter=num_filter, kernel=(3, 3),
+                               stride=stride, pad=(1, 1), no_bias=True,
+                               name=name + "_conv1")
+    bn1 = mx.sym.BatchNorm(conv1, fix_gamma=False, eps=eps, momentum=bn_mom,
+                           name=name + "_bn1")
+    act1 = mx.sym.Activation(bn1, act_type="relu", name=name + "_relu1")
+    conv2 = mx.sym.Convolution(act1, num_filter=num_filter, kernel=(3, 3),
+                               stride=(1, 1), pad=(1, 1), no_bias=True,
+                               name=name + "_conv2")
+    bn2 = mx.sym.BatchNorm(conv2, fix_gamma=False, eps=eps, momentum=bn_mom,
+                           name=name + "_bn2")
+    if dim_match:
+        shortcut = data
+    else:
+        sc_conv = mx.sym.Convolution(data, num_filter=num_filter,
+                                     kernel=(1, 1), stride=stride,
+                                     no_bias=True, name=name + "_sc")
+        shortcut = mx.sym.BatchNorm(sc_conv, fix_gamma=False, eps=eps,
+                                    momentum=bn_mom, name=name + "_sc_bn")
+    return mx.sym.Activation(bn2 + shortcut, act_type="relu",
+                             name=name + "_relu2")
+
+
+def resnet(units, num_stages, filter_list, num_classes, image_shape,
+           bottle_neck=True, bn_mom=0.9, version=1):
+    """Assemble a full ResNet symbol ending in SoftmaxOutput."""
+    data = mx.sym.var("data")
+    (nchannel, height, width) = image_shape
+    body = mx.sym.Convolution(data, num_filter=filter_list[0],
+                              kernel=(7, 7) if height > 32 else (3, 3),
+                              stride=(2, 2) if height > 32 else (1, 1),
+                              pad=(3, 3) if height > 32 else (1, 1),
+                              no_bias=True, name="conv0")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5, momentum=bn_mom,
+                            name="bn0")
+    body = mx.sym.Activation(body, act_type="relu", name="relu0")
+    if height > 32:
+        body = mx.sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                              pool_type="max")
+    for i in range(num_stages):
+        stride = (1, 1) if i == 0 and height > 32 else \
+            ((1, 1) if i == 0 else (2, 2))
+        body = residual_unit(body, filter_list[i + 1], stride, False,
+                             "stage%d_unit1" % (i + 1),
+                             bottle_neck=bottle_neck, bn_mom=bn_mom,
+                             version=version)
+        for j in range(units[i] - 1):
+            body = residual_unit(body, filter_list[i + 1], (1, 1), True,
+                                 "stage%d_unit%d" % (i + 1, j + 2),
+                                 bottle_neck=bottle_neck, bn_mom=bn_mom,
+                                 version=version)
+    if version == 2:
+        body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                                momentum=bn_mom, name="bn_final")
+        body = mx.sym.Activation(body, act_type="relu", name="relu_final")
+    pool = mx.sym.Pooling(body, global_pool=True, kernel=(7, 7),
+                          pool_type="avg", name="pool_final")
+    flat = mx.sym.Flatten(pool)
+    fc = mx.sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def get_symbol(num_classes, num_layers, image_shape, version=1, **kwargs):
+    """Build a ResNet of the requested depth (18/34/50/101/152/...)."""
+    image_shape = [int(x) for x in image_shape.split(",")] \
+        if isinstance(image_shape, str) else list(image_shape)
+    height = image_shape[1]
+    if height <= 32:  # cifar-style
+        num_stages = 3
+        if (num_layers - 2) % 9 == 0 and num_layers >= 164:
+            per_unit = [(num_layers - 2) // 9]
+            filter_list = [16, 64, 128, 256]
+            bottle_neck = True
+        elif (num_layers - 2) % 6 == 0 and num_layers < 164:
+            per_unit = [(num_layers - 2) // 6]
+            filter_list = [16, 16, 32, 64]
+            bottle_neck = False
+        else:
+            raise ValueError("no %d-layer cifar resnet" % num_layers)
+        units = per_unit * num_stages
+    else:
+        num_stages = 4
+        stage_plan = {18: ([2, 2, 2, 2], False), 34: ([3, 4, 6, 3], False),
+                      50: ([3, 4, 6, 3], True), 101: ([3, 4, 23, 3], True),
+                      152: ([3, 8, 36, 3], True), 200: ([3, 24, 36, 3], True),
+                      269: ([3, 30, 48, 8], True)}
+        if num_layers not in stage_plan:
+            raise ValueError("no %d-layer imagenet resnet" % num_layers)
+        units, bottle_neck = stage_plan[num_layers]
+        filter_list = [64, 256, 512, 1024, 2048] if bottle_neck else \
+            [64, 64, 128, 256, 512]
+    return resnet(units, num_stages, filter_list, num_classes, image_shape,
+                  bottle_neck=bottle_neck, version=version)

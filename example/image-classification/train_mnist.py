@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Train an MNIST classifier (reference parity:
+example/image-classification/train_mnist.py).
+
+Uses MNISTIter over local idx files when --data-dir has them, else
+falls back to an in-memory synthetic digit problem so the script runs
+in offline environments.
+"""
+import argparse
+import importlib
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from common import fit  # noqa: E402
+
+
+def get_mnist_iter(args, kv):
+    """MNISTIter over idx files, or a synthetic stand-in."""
+    image_file = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    label_file = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    shape = (1, 28, 28)
+    if os.path.exists(image_file):
+        train = mx.io.MNISTIter(image=image_file, label=label_file,
+                                data_shape=shape, batch_size=args.batch_size,
+                                shuffle=True, flat=False)
+        vi = os.path.join(args.data_dir, "t10k-images-idx3-ubyte")
+        vl = os.path.join(args.data_dir, "t10k-labels-idx1-ubyte")
+        val = mx.io.MNISTIter(image=vi, label=vl, data_shape=shape,
+                              batch_size=args.batch_size,
+                              flat=False) if os.path.exists(vi) else None
+        return train, val
+    logging.warning("MNIST files not found under %s; using synthetic digits",
+                    args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 4096
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+    for i in range(n):  # a learnable class signal
+        x[i, 0, :, y[i] * 2] += 1.0
+    train = mx.io.NDArrayIter(x, y.astype(np.float32), args.batch_size,
+                              shuffle=True, label_name="softmax_label")
+    val = mx.io.NDArrayIter(x[:512], y[:512].astype(np.float32),
+                            args.batch_size, label_name="softmax_label")
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    parser.add_argument("--dtype", type=str, default="float32")
+    parser.add_argument("--benchmark", type=int, default=0)
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10, batch_size=64,
+                        lr=0.05, lr_factor=0, disp_batches=100)
+    args = parser.parse_args()
+
+    net_module = importlib.import_module("symbols." + args.network)
+    sym = net_module.get_symbol(num_classes=args.num_classes)
+    fit.fit(args, sym, get_mnist_iter)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,9 +67,14 @@ class Speedometer:
 
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
                 if param.eval_metric is not None:
+                    # reading the metric value drains the device queue
+                    # (device-side accumulation is lazy), so the timing
+                    # window below measures completed work, not the
+                    # host's async enqueue rate
                     name_value = param.eval_metric.get_name_value()
+                    speed = self.frequent * self.batch_size / \
+                        (time.time() - self.tic)
                     if self.auto_reset:
                         param.eval_metric.reset()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
@@ -77,6 +82,8 @@ class Speedometer:
                     logging.info(msg, param.epoch, count, speed,
                                  *sum(name_value, ()))
                 else:
+                    speed = self.frequent * self.batch_size / \
+                        (time.time() - self.tic)
                     logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                                  param.epoch, count, speed)
                 self.tic = time.time()

@@ -48,8 +48,12 @@ _LOSS_OPS = frozenset({
 class Executor:
     """A bound, compiled computation (reference: python/mxnet/executor.py:45)."""
 
-    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req):
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                 compute_dtype=None, cast_exclude=()):
         self._symbol = symbol
+        self._compute_dtype = (jnp.dtype(compute_dtype)
+                               if compute_dtype is not None else None)
+        self._cast_exclude = frozenset(cast_exclude)
         self._ctx = Context(ctx) if ctx is not None else current_context()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -81,11 +85,28 @@ class Executor:
         fn_eval = build_graph_fn(symbol, self.arg_names, self.aux_names, False)
         diff_idx = tuple(self._diff_idx)
 
+        # mixed-precision policy (compute_dtype='bfloat16'): fp32 master
+        # args cast to bf16 at graph entry (labels / excluded names kept);
+        # vjp through the cast hands fp32 grads to the optimizer.  The
+        # reference's fp16 path (optimizer.py:434 multi-precision) done
+        # the compiled-step way.
+        cdt = self._compute_dtype
+        cast_idx = frozenset(
+            i for i, n in enumerate(self.arg_names)
+            if cdt is not None and n not in self._cast_exclude)
+
+        def _cast(args):
+            if cdt is None:
+                return args
+            return [a.astype(cdt)
+                    if (i in cast_idx and a.dtype == jnp.float32) else a
+                    for i, a in enumerate(args)]
+
         def fwd_eval(args, aux, key):
-            return fn_eval(args, aux, key)
+            return fn_eval(_cast(args), aux, key)
 
         def fwd_train(args, aux, key):
-            return fn_train(args, aux, key)
+            return fn_train(_cast(args), aux, key)
 
         def fb(args, aux, key, seeds):
             diff = [args[i] for i in diff_idx]
@@ -94,7 +115,7 @@ class Executor:
                 full = list(args)
                 for j, i in enumerate(diff_idx):
                     full[i] = diff_args[j]
-                outs, new_aux = fn_train(full, aux, key)
+                outs, new_aux = fn_train(_cast(full), aux, key)
                 return tuple(outs), new_aux
 
             outs, vjp_fn, new_aux = jax.vjp(f, diff, has_aux=True)
@@ -104,11 +125,123 @@ class Executor:
         self._jit_fwd_eval = jax.jit(fwd_eval)
         self._jit_fwd_train = jax.jit(fwd_train)
         self._jit_fb = jax.jit(fb)
+        self._fn_train = fn_train
+        self._cast_fn = _cast
+        # fused optimizer step (install_fused_update): fwd+bwd+update as
+        # ONE donated XLA program — the reference's bulked train segment
+        # (graph_executor.cc:1336) plus server-side update, compiled
+        self._fused_update = None   # (one_fn, scalars_fn)
+        self._fused_state = None    # list of state tuples per diff arg
+        self._jit_fbu = None
+        self._updates_applied = False
+
+    # -- fused optimizer step ------------------------------------------------
+    def install_fused_update(self, optimizer, param_names=None):
+        """Fold the optimizer into the compiled train step (kvstore=tpu).
+
+        After installation, ``forward(is_train=True)`` on a loss graph
+        runs fwd+bwd+update as ONE donated XLA program.  Gradients are
+        consumed inside the program (XLA frees them without an HBM
+        round-trip): ``backward()`` becomes a commit-nothing no-op and
+        grad_dict is NOT populated — use the unfused path (kvstore local/
+        device) when per-step gradient inspection is needed.
+        ``updates_applied`` tells Module.update to skip the push/pull.
+        Returns False (and installs nothing) for optimizers without a
+        fused kernel, or when ``param_names`` is given and some
+        differentiable arg is not a parameter (e.g. inputs_need_grad:
+        the optimizer must never be applied to data inputs)."""
+        from . import optimizer as opt_mod
+
+        kernel = opt_mod.fused_update_kernel(optimizer)
+        if kernel is None or not self._diff_idx or not self._is_loss_graph:
+            return False
+        if param_names is not None:
+            allowed = set(param_names)
+            if any(self.arg_names[i] not in allowed for i in self._diff_idx):
+                return False
+        # decouple weight buffers from any master/kvstore aliases: the
+        # fused step donates them, which would invalidate shared buffers
+        for i in self._diff_idx:
+            nd = self.arg_dict[self.arg_names[i]]
+            nd._data = jnp.array(nd._data, copy=True)
+        self._fused_update = (optimizer, kernel[0], kernel[1])
+        self._fused_state = None
+        self._jit_fbu = None
+        self._updates_applied = False
+        return True
+
+    @property
+    def updates_applied(self):
+        return self._updates_applied
+
+    def _build_fbu(self):
+        import jax as _jax
+
+        diff_idx = tuple(self._diff_idx)
+        fn_train, _cast = self._fn_train, self._cast_fn
+        one = self._fused_update[2]
+
+        def fbu(diff, rest, aux, key, seeds, states, lrs, wds):
+            def f(diff_args):
+                full = list(rest)
+                for j, i in enumerate(diff_idx):
+                    full[i] = diff_args[j]
+                outs, new_aux = fn_train(_cast(full), aux, key)
+                return tuple(outs), new_aux
+
+            outs, vjp_fn, new_aux = _jax.vjp(f, list(diff), has_aux=True)
+            (grads,) = vjp_fn(tuple(seeds))
+            new_diff, new_states = [], []
+            # lrs/wds are ONE packed (n,) array each — per-scalar host
+            # transfers would dominate the step on a tunneled device
+            for j, (w, g, st) in enumerate(zip(diff, grads, states)):
+                nw, nst = one(w, g, st, lrs[j], wds[j])
+                new_diff.append(nw)
+                new_states.append(nst)
+            # grads are consumed in-program (XLA frees them); they are not
+            # outputs — saves an HBM round-trip per step.  backward() is a
+            # no-op in fused mode (grad_dict intentionally not populated).
+            return list(outs), new_diff, new_states, new_aux
+
+        # donate weights + optimizer state (exclusively owned: the arg
+        # NDArrays are rebound to the outputs right after the call)
+        return _jax.jit(fbu, donate_argnums=(0, 5))
+
+    def _forward_fused(self, args, aux, key):
+        from . import optimizer as opt_mod
+
+        optimizer = self._fused_update[0]
+        init_state = self._fused_update[1]
+        diff_set = set(self._diff_idx)
+        diff = [args[i] for i in self._diff_idx]
+        # None placeholders where diff args go (overwritten inside the
+        # program) — the donated weight buffers must not appear twice
+        rest = [None if i in diff_set else a for i, a in enumerate(args)]
+        if self._fused_state is None:
+            self._fused_state = [init_state(d) for d in diff]
+        lrs, wds = [], []
+        for i in self._diff_idx:
+            lr, wd = opt_mod.fused_lr_wd(optimizer, self.arg_names[i])
+            lrs.append(lr)
+            wds.append(wd)
+        lrs = np.asarray(lrs, np.float32)
+        wds = np.asarray(wds, np.float32)
+        seeds = self._default_seeds(args, aux, key)
+        if self._jit_fbu is None:
+            self._jit_fbu = self._build_fbu()
+        outs, new_diff, new_states, new_aux = self._jit_fbu(
+            diff, rest, aux, key, seeds, self._fused_state, lrs, wds)
+        self._fused_state = new_states
+        for j, i in enumerate(self._diff_idx):
+            self.arg_dict[self.arg_names[i]]._data = new_diff[j]
+        self._cached_grads = None
+        self._updates_applied = True
+        return outs, new_aux
 
     # -- binding constructors ----------------------------------------------
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
-                     shared_exec=None):
+                     shared_exec=None, compute_dtype=None, cast_exclude=()):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         known = {k: tuple(v) for k, v in shape_kwargs.items()
@@ -145,11 +278,12 @@ class Executor:
                 aux_dict[n] = shared_exec.aux_dict[n]
             else:
                 aux_dict[n] = nd_zeros(shp, ctx=ctx)
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                        compute_dtype=compute_dtype, cast_exclude=cast_exclude)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
-              shared_exec=None):
+              shared_exec=None, compute_dtype=None, cast_exclude=()):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if isinstance(args, dict):
@@ -178,7 +312,8 @@ class Executor:
                 aux_dict = {**{a: nd_zeros(aux_shapes[a], ctx=ctx)
                                for a in aux_names if a in aux_shapes}, **aux_dict}
                 break
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                        compute_dtype=compute_dtype, cast_exclude=cast_exclude)
 
     # -- execution ----------------------------------------------------------
     @property
@@ -216,10 +351,13 @@ class Executor:
             else:
                 tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)
         args, aux, key = self._args(), self._aux(), self._next_key()
-        if is_train and self._diff_idx and self._is_loss_graph:
+        if is_train and self._fused_update is not None:
+            outs, new_aux = self._forward_fused(args, aux, key)
+        elif is_train and self._diff_idx and self._is_loss_graph:
             seeds = self._default_seeds(args, aux, key)
             outs, grads, new_aux = self._jit_fb(args, aux, key, seeds)
             self._cached_grads = grads
+            self._updates_applied = False
         else:
             outs, new_aux = (self._jit_fwd_train(args, aux, key) if is_train
                              else self._jit_fwd_eval(args, aux, key))
@@ -250,6 +388,10 @@ class Executor:
         forward(is_train=True) — this just commits them to the grad
         arrays (kWriteTo/kAddTo semantics)."""
         if not self._diff_idx:
+            return
+        if out_grads is None and self._updates_applied:
+            # fused step: gradients were consumed by the in-program
+            # optimizer update; nothing to commit
             return
         if out_grads is not None:
             if isinstance(out_grads, NDArray):
@@ -329,7 +471,9 @@ class Executor:
                 grad_dict[n] = nd_zeros(arg_dict[n].shape, ctx=self._ctx,
                                         dtype=arg_dict[n].dtype)
         return Executor(self._symbol, self._ctx, arg_dict, grad_dict,
-                        dict(self.aux_dict), self._grad_req)
+                        dict(self.aux_dict), self._grad_req,
+                        compute_dtype=self._compute_dtype,
+                        cast_exclude=self._cast_exclude)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Reference: graph_executor.cc:121 monitor tap (output-level)."""

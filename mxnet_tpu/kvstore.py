@@ -166,6 +166,113 @@ class KVStore:
             self._updater.set_states(fin.read())
 
 
+class KVStoreTPU(KVStore):
+    """Fused-update store for on-device training (kvstore=tpu).
+
+    The reference's update-on-kvstore applies the optimizer key by key on
+    the server/device (kvstore_dist_server.h:282, comm.h reduce).  Eager
+    per-key updates would cost hundreds of device dispatches per step on
+    TPU, so here ``push`` only buffers the merged gradient and the first
+    ``pull`` flushes ALL pending keys as ONE jitted XLA program built
+    from the same fused update kernels the eager path uses
+    (ops/optimizer_ops.py, reference src/operator/optimizer_op-inl.h) —
+    numerics identical, one dispatch per step.
+
+    lr/wd enter the program as traced scalars, so LR schedules never
+    trigger recompilation; optimizer count/scheduler bookkeeping runs in
+    Python at flush time exactly as the eager path would.
+    """
+
+    fused_update = True
+
+    def __init__(self, kv_type="tpu"):
+        super().__init__(kv_type)
+        self._pending = {}    # key -> merged grad (jax array)
+        self._fstate = {}     # key -> tuple of state jax arrays
+        self._fused_jit = None
+
+    def set_optimizer(self, optimizer):
+        super().set_optimizer(optimizer)
+        self._fused_jit = None
+        self._fstate.clear()
+
+    def _fused_kind(self):
+        o = self._optimizer
+        if o is None or opt.fused_update_kernel(o) is None:
+            return None
+        return type(o).__name__
+
+    def push(self, key, value, priority=0):
+        if self._updater is None or self._fused_kind() is None:
+            return super().push(key, value, priority)
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            if k in self._pending:
+                # base-store semantics are one optimizer update PER push
+                # (gradient accumulation callers rely on it) — apply the
+                # buffered update before accepting a second push
+                self._flush()
+            merged = vlist[0]._data
+            for v in vlist[1:]:
+                merged = merged + v._data
+            self._pending[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._pending:
+            self._flush()
+        return super().pull(key, out=out, priority=priority,
+                            ignore_sparse=ignore_sparse)
+
+    # -- the fused update ----------------------------------------------------
+    def _build_fused(self):
+        import jax
+
+        _, one = opt.fused_update_kernel(self._optimizer)
+
+        def fused(ws, gs, states, lrs, wds):
+            # lrs/wds are ONE packed (n,) array each (per-scalar host
+            # transfers would dominate on a tunneled device)
+            new_ws, new_states = [], []
+            for j, (w, g, st) in enumerate(zip(ws, gs, states)):
+                nw, nst = one(w, g, st, lrs[j], wds[j])
+                new_ws.append(nw)
+                new_states.append(nst)
+            return new_ws, new_states
+
+        # donate only the optimizer state: pull() hands out the store's
+        # weight buffers as aliases, so donating ws would invalidate
+        # arrays previously pulled by callers
+        return jax.jit(fused, donate_argnums=(2,))
+
+    def _flush(self):
+        import numpy as np
+
+        o = self._optimizer
+        init_state, _ = opt.fused_update_kernel(o)
+        keys = list(self._pending)
+        ws, gs, states, lrs, wds = [], [], [], [], []
+        for k in keys:
+            lr, wd = opt.fused_lr_wd(o, self._key_int(k))
+            lrs.append(lr)
+            wds.append(wd)
+            ws.append(self._store[k]._data)
+            gs.append(self._pending[k])
+            if k not in self._fstate:
+                self._fstate[k] = init_state(self._store[k]._data)
+            states.append(self._fstate[k])
+        if self._fused_jit is None:
+            self._fused_jit = self._build_fused()
+        new_ws, new_states = self._fused_jit(
+            ws, gs, states, np.asarray(lrs, np.float32),
+            np.asarray(wds, np.float32))
+        for k, nw, nst in zip(keys, new_ws, new_states):
+            self._store[k]._data = nw
+            self._fstate[k] = tuple(nst)
+        self._pending.clear()
+
+
 def create(name="local"):
     """Create a KVStore (reference: kvstore.py:628, kvstore.cc:40).
 
@@ -180,4 +287,6 @@ def create(name="local"):
              "dist_async", "dist")
     if name not in valid:
         raise MXNetError("unknown KVStore type %r" % name)
+    if name in ("tpu", "nccl", "device"):
+        return KVStoreTPU(name)
     return KVStore(name)

@@ -34,7 +34,10 @@ def _create_kvstore(kvstore, num_device, arg_params):
     elif isinstance(kvstore, kvs.KVStore):
         kv = kvstore
     elif isinstance(kvstore, str):
-        if num_device == 1 and "dist" not in kvstore:
+        # 'tpu' keeps its store even on one device: the fused one-dispatch
+        # update path lives there (KVStoreTPU)
+        if num_device == 1 and "dist" not in kvstore and kvstore not in (
+                "tpu", "nccl", "device"):
             kv = None
         else:
             kv = kvs.create(kvstore)
@@ -61,7 +64,19 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """Reference: model.py:99."""
+    """Reference: model.py:99.
+
+    For fused stores (kvstore=tpu) all pushes go first so the store can
+    apply every pending update as one compiled program on the first pull;
+    per-key semantics are unchanged (keys are independent)."""
+    if getattr(kvstore, "fused_update", False):
+        live = [(i, a, g) for i, (a, g) in
+                enumerate(zip(param_arrays, grad_arrays)) if g[0] is not None]
+        for index, _, grad_list in live:
+            kvstore.push(param_names[index], grad_list, priority=-index)
+        for index, arg_list, _ in live:
+            kvstore.pull(param_names[index], arg_list, priority=-index)
+        return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:

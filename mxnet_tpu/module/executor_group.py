@@ -64,7 +64,10 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, compute_dtype=None,
+                 cast_exclude=()):
+        self.compute_dtype = compute_dtype
+        self.cast_exclude = tuple(cast_exclude)
         self.param_names = param_names
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -185,7 +188,8 @@ class DataParallelExecutorGroup:
         type_dict.update({l.name: l.dtype for l in label_shapes_i})
         return self.symbol.simple_bind(
             ctx=context, grad_req=self.grad_req, type_dict=type_dict,
-            shared_exec=shared_exec, **input_shapes)
+            shared_exec=shared_exec, compute_dtype=self.compute_dtype,
+            cast_exclude=self.cast_exclude, **input_shapes)
 
     def _collect_arrays(self):
         """Expose param/grad/data arrays per device (reference: :310)."""

@@ -76,6 +76,10 @@ _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                     multi_output=False, use_ignore=False, preserve_shape=False,
                     normalization="null", out_grad=False, smooth_alpha=0.0, **attrs):
+    # softmax in fp32 even under a bf16 compute policy: bf16 log-sum-exp
+    # over 1000 classes drifts; grads return bf16 through the cast's VJP
+    if data.dtype != jnp.float32:
+        data = data.astype(jnp.float32)
     return _softmax_output_core(
         data, label, float(grad_scale), float(ignore_label), bool(use_ignore),
         normalization == "batch", normalization == "valid", bool(multi_output))

@@ -145,13 +145,13 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     pad = normalize_tuple(pad, nd) if pad else (0,) * nd
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     _conv_dn(nd, layout))
+    # bf16 in -> bf16 out: the TPU MXU accumulates in fp32 internally, and
+    # an explicit preferred_element_type=f32 upcast breaks the conv
+    # transpose rule (f32 cotangent vs bf16 residual in grad-of-weight)
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None)
-    if out.dtype != data.dtype:
-        out = out.astype(data.dtype)
+        dimension_numbers=dn, feature_group_count=num_group)
     if not no_bias and bias is not None:
         c_axis = dn.out_spec.index(1) if hasattr(dn, "out_spec") else 1
         shape = [1] * out.ndim
@@ -302,10 +302,13 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var,
     shape[ax] = data.shape[ax]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if __is_train__ and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
-        new_mean = momentum * moving_mean + (1 - momentum) * mean
-        new_var = momentum * moving_var + (1 - momentum) * var
+        # stats in f32 even for bf16 activations (mixed-precision policy):
+        # a bf16 mean over a 224x224x64 channel loses ~3 decimal digits
+        sdata = data.astype(jnp.float32) if data.dtype != jnp.float32 else data
+        mean = jnp.mean(sdata, axis=red)
+        var = jnp.var(sdata, axis=red)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean.astype(moving_mean.dtype)
+        new_var = momentum * moving_var + (1 - momentum) * var.astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var

@@ -21,7 +21,8 @@ def _prep_grad(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
     g = grad * rescale_grad
     if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
-    if weight is not None and wd:
+    # wd may be a traced scalar (fused kvstore update) — no truthiness test
+    if weight is not None and wd is not None:
         g = g + wd * weight
     return g
 

@@ -104,12 +104,24 @@ class ParallelTrainer:
     (the reference's update-on-kvstore, but compiled-in)."""
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, donate=True):
+                 mesh=None, donate=True, dtype=None):
         self._block = block
         self._loss = loss_fn
         self._mesh = mesh if mesh is not None else make_mesh()
         self._opt = make_optimizer(optimizer, **(optimizer_params or {}))
         self._donate = donate
+        # mixed-precision policy (reference analogue: multi-precision mode,
+        # optimizer.py:434 / kvstore_dist_server.h:231 fp16 master copies —
+        # here bf16 compute with fp32 master params, the TPU-native choice):
+        # params stay fp32; activations/matmuls/convs run in bf16; loss and
+        # optimizer update in fp32.  Grads reach the optimizer fp32 through
+        # the cast's VJP.
+        if dtype in ("bfloat16", "bf16", jnp.bfloat16):
+            self._amp_dtype = jnp.bfloat16
+        elif dtype in (None, "float32", "fp32", jnp.float32):
+            self._amp_dtype = None
+        else:
+            raise MXNetError("unsupported trainer dtype: %r" % (dtype,))
 
         params = block.collect_params()
         self._param_names = list(params.keys())
@@ -148,10 +160,17 @@ class ParallelTrainer:
         apply_train = pure_block_apply(block, self._param_names, True)
         apply_eval = pure_block_apply(block, self._param_names, False)
 
+        amp = self._amp_dtype
+
         def loss_of(params, key, x, y):
+            if amp is not None:
+                params = {k: v.astype(amp) if v.dtype == jnp.float32 else v
+                          for k, v in params.items()}
+                x = x.astype(amp) if x.dtype == jnp.float32 else x
             out = apply_train(params, key, x)
             if isinstance(out, tuple):
                 out = out[0]
+            out = out.astype(jnp.float32)  # loss always in fp32
             with autograd.pause(train_mode=True):
                 l = loss_blk(NDArray(out), NDArray(y))
             return jnp.mean(l._data)
@@ -177,8 +196,13 @@ class ParallelTrainer:
             donate_argnums=(0, 1) if self._donate else ())
 
         def evaluate(params, x, key):
+            if amp is not None:
+                params = {k: v.astype(amp) if v.dtype == jnp.float32 else v
+                          for k, v in params.items()}
+                x = x.astype(amp) if x.dtype == jnp.float32 else x
             out = apply_eval(params, key, x)
-            return out[0] if isinstance(out, tuple) else out
+            out = out[0] if isinstance(out, tuple) else out
+            return out.astype(jnp.float32)
 
         self._jit_eval = jax.jit(
             evaluate, in_shardings=(param_shardings, batch_sharding, None))

@@ -137,6 +137,8 @@ class Symbol:
             index = names.index(index)
         # index into the *expanded* output list
         flat = self._flat_outputs()
+        if isinstance(index, slice):
+            return Symbol(flat[index])
         return Symbol([flat[index]])
 
     def _flat_outputs(self):
@@ -375,11 +377,18 @@ class Symbol:
     # -- evaluation / binding ----------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
-        """Reference: symbol.py:1284 -> GraphExecutor::Init (simple-bind)."""
+                    shared_exec=None, shared_buffer=None, compute_dtype=None,
+                    cast_exclude=(), **kwargs):
+        """Reference: symbol.py:1284 -> GraphExecutor::Init (simple-bind).
+
+        compute_dtype='bfloat16' enables the executor's mixed-precision
+        policy (fp32 masters, bf16 compute); cast_exclude names args kept
+        fp32 (labels)."""
         from ..executor import Executor
         return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
-                                     shared_exec=shared_exec)
+                                     shared_exec=shared_exec,
+                                     compute_dtype=compute_dtype,
+                                     cast_exclude=cast_exclude)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):

@@ -1,0 +1,165 @@
+"""Fused-update kvstore + executor fused step + mixed precision.
+
+Reference analogues: tests/python/unittest/test_kvstore.py (updater on
+store semantics), test_module.py (fit loop), and the fp16 training mode
+(optimizer.py:434 multi-precision) — here the TPU-native bf16 policy.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _toy_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=64):
+    x = np.random.rand(n, 1, 8, 8).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 10).astype(np.int32).clip(0, 9)
+    return x, y.astype(np.float32)
+
+
+def test_fused_kvstore_matches_eager_sgd():
+    """KVStoreTPU's one-dispatch flush must produce the same weights as
+    the eager per-key Updater (same kernels, ops/optimizer_ops.py)."""
+    rng = np.random.RandomState(0)
+    shapes = [(8, 4), (16,), (3, 5, 2)]
+    keys = ["w%d" % i for i in range(len(shapes))]
+    init = [rng.randn(*s).astype(np.float32) for s in shapes]
+    grads = [[rng.randn(*s).astype(np.float32) for s in shapes]
+             for _ in range(4)]
+
+    def run(kv_name):
+        kv = mx.kvstore.create(kv_name)
+        opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                                  wd=0.01, rescale_grad=1.0 / 8)
+        kv.set_optimizer(opt)
+        outs = [nd.array(v.copy()) for v in init]
+        for k, v in zip(keys, outs):
+            kv.init(k, v)
+        for step_grads in grads:
+            for k, g in zip(keys, step_grads):
+                kv.push(k, [nd.array(g)])
+            for k, o in zip(keys, outs):
+                kv.pull(k, out=[o])
+        return [o.asnumpy() for o in outs]
+
+    fused = run("tpu")      # KVStoreTPU: buffered push, fused flush
+    eager = run("local")    # eager per-key updater
+    for f, e in zip(fused, eager):
+        np.testing.assert_allclose(f, e, rtol=2e-5, atol=2e-6)
+
+
+def test_fused_kvstore_matches_eager_adam():
+    rng = np.random.RandomState(1)
+    shape = (6, 3)
+    init = rng.randn(*shape).astype(np.float32)
+    grads = [rng.randn(*shape).astype(np.float32) for _ in range(5)]
+
+    def run(kv_name):
+        kv = mx.kvstore.create(kv_name)
+        kv.set_optimizer(mx.optimizer.create("adam", learning_rate=0.01,
+                                             wd=0.001))
+        out = nd.array(init.copy())
+        kv.init("w", out)
+        for g in grads:
+            kv.push("w", [nd.array(g)])
+            kv.pull("w", out=[out])
+        return out.asnumpy()
+
+    np.testing.assert_allclose(run("tpu"), run("local"), rtol=2e-5, atol=2e-6)
+
+
+def test_module_fused_step_matches_unfused():
+    """kvstore=tpu (fused executor step) and kvstore=local (eager
+    updater) must train to the same weights from the same init."""
+    sym = _toy_symbol()
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+
+    def train(kv):
+        mx.random.seed(7)
+        np.random.seed(7)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, num_epoch=2, kvstore=kv,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Xavier(), force_init=True,
+                force_rebind=True)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    w_fused = train("tpu")
+    w_eager = train("local")
+    assert set(w_fused) == set(w_eager)
+    for k in w_fused:
+        np.testing.assert_allclose(w_fused[k], w_eager[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+
+
+def test_module_bf16_trains():
+    """compute_dtype='bfloat16': fp32 masters, bf16 compute; the toy
+    problem must still learn."""
+    sym = _toy_symbol()
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu(), compute_dtype="bfloat16")
+    mod.fit(it, num_epoch=8, kvstore="tpu",
+            optimizer_params={"learning_rate": 0.3, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    args, _ = mod.get_params()
+    for k, v in args.items():
+        assert v.dtype == np.float32, "master params must stay fp32 (%s)" % k
+    it.reset()
+    score = mod.score(it, mx.metric.Accuracy())
+    assert score[0][1] > 0.4, score
+
+
+def test_parallel_trainer_bf16():
+    """ParallelTrainer dtype='bfloat16' — loss decreases, masters fp32."""
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3),
+            nn.BatchNorm(in_channels=8), nn.Activation("relu"),
+            nn.GlobalAvgPool2D(), nn.Flatten(), nn.Dense(4, in_units=8))
+    net.initialize(mx.init.Xavier())
+    mesh = parallel.make_mesh(dp=8)
+    tr = parallel.ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.1,
+                                          "momentum": 0.9},
+                                  mesh=mesh, dtype="bfloat16")
+    x = nd.array(np.random.rand(16, 3, 8, 8).astype(np.float32))
+    y = nd.array(np.random.randint(0, 4, 16).astype(np.float32))
+    losses = [float(tr.step(x, y).asnumpy()) for _ in range(15)]
+    assert losses[-1] < losses[0]
+    assert all(v.dtype == np.float32 for v in tr.params.values())
+
+
+def test_accuracy_device_accumulation():
+    """Accuracy over NDArrays accumulates lazily on device; get() syncs
+    and returns the right value."""
+    m = mx.metric.Accuracy()
+    pred = nd.array(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                             dtype=np.float32))
+    label = nd.array(np.array([1, 0, 0], dtype=np.float32))
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == "accuracy"
+    assert abs(val - 2.0 / 3.0) < 1e-6
+    # numpy inputs still work
+    m2 = mx.metric.Accuracy()
+    m2.update([np.array([1, 0])], [np.array([[0.1, 0.9], [0.2, 0.8]])])
+    assert abs(m2.get()[1] - 0.5) < 1e-6
