@@ -15,6 +15,8 @@ from . import register as _register
 
 _register.populate(__name__)
 
+from . import contrib  # noqa: E402,F401  (needs populated registry)
+
 
 def Custom(*args, op_type=None, **kwargs):
     """Run a registered custom op (reference: src/operator/custom/custom.cc,

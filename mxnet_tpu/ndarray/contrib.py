@@ -1,0 +1,22 @@
+"""``mx.nd.contrib`` namespace: every registered ``_contrib_*`` op is
+exposed without the prefix (reference: python/mxnet/ndarray/contrib.py,
+generated from the op registry the same way)."""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import _OP_REGISTRY
+from .register import _make_op_func
+
+
+def _populate():
+    mod = sys.modules[__name__]
+    for name, opdef in _OP_REGISTRY.items():
+        if not name.startswith("_contrib_"):
+            continue
+        short = name[len("_contrib_"):]
+        if short.isidentifier() and not hasattr(mod, short):
+            setattr(mod, short, _make_op_func(short, opdef))
+
+
+_populate()

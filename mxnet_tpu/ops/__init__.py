@@ -17,3 +17,4 @@ from . import loss          # noqa: F401
 from . import init_ops      # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import contrib       # noqa: F401

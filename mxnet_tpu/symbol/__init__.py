@@ -90,6 +90,8 @@ def _populate(module_name=__name__):
 
 _populate()
 
+from . import contrib  # noqa: E402,F401  (needs populated registry)
+
 
 def zeros(shape, dtype="float32", **kwargs):
     return _make_symbol_call("_zeros", [], {"shape": shape, "dtype": dtype})

@@ -1,0 +1,21 @@
+"""``mx.sym.contrib`` namespace: ``_contrib_*`` ops without the prefix
+(reference: python/mxnet/symbol/contrib.py)."""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import _OP_REGISTRY
+
+
+def _populate():
+    from . import _make_sym_func
+    mod = sys.modules[__name__]
+    for name, opdef in _OP_REGISTRY.items():
+        if not name.startswith("_contrib_"):
+            continue
+        short = name[len("_contrib_"):]
+        if short.isidentifier() and not hasattr(mod, short):
+            setattr(mod, short, _make_sym_func(name, opdef))
+
+
+_populate()

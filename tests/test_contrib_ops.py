@@ -1,0 +1,230 @@
+"""Detection / contrib operator tests.
+
+Modelled on the reference's tests/python/unittest/test_operator.py
+(test_multibox_prior/target, test_box_nms, test_roipooling) and
+test_contrib_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def np_iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_multibox_prior_shapes_and_values():
+    data = nd.zeros((1, 3, 4, 6))
+    sizes, ratios = (0.5, 0.25), (1, 2, 0.5)
+    out = nd.contrib.MultiBoxPrior(data, sizes=sizes, ratios=ratios)
+    A = len(sizes) + len(ratios) - 1
+    assert out.shape == (1, 4 * 6 * A, 4)
+    boxes = out.asnumpy()[0]
+    # first anchor of first cell: ratio 1, size 0.5, centered (0.5/6, 0.5/4)
+    cx, cy = 0.5 / 6, 0.5 / 4
+    hw = 0.5 * 4 / 6 / 2
+    hh = 0.5 / 2
+    np.testing.assert_allclose(boxes[0], [cx - hw, cy - hh, cx + hw, cy + hh],
+                               rtol=1e-5)
+    # clip keeps all coords in [0,1]
+    clipped = nd.contrib.MultiBoxPrior(data, sizes=sizes, ratios=ratios,
+                                       clip=True).asnumpy()
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+
+
+def test_multibox_target_basic():
+    # one anchor exactly overlapping the gt must be positive
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9],
+                                  [0.0, 0.0, 0.05, 0.05]]], np.float32))
+    # one gt box of class 2 matching anchor 0
+    labels = nd.array(np.array([[[2, 0.1, 0.1, 0.5, 0.5],
+                                 [-1, -1, -1, -1, -1]]], np.float32))
+    cls_preds = nd.zeros((1, 4, 3))
+    loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_preds)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 3  # class 2 -> target 3 (0 reserved for background)
+    assert cls_t[1] == 0 and cls_t[2] == 0  # unmatched -> background
+    mask = loc_mask.asnumpy()[0].reshape(3, 4)
+    assert mask[0].sum() == 4 and mask[1:].sum() == 0
+    # perfectly aligned anchor: offsets 0
+    np.testing.assert_allclose(loc_t.asnumpy()[0][:4], 0.0, atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    rng = np.random.RandomState(0)
+    a = rng.uniform(0, 0.4, (1, 20, 4)).astype(np.float32)
+    a[..., 2:] = a[..., :2] + 0.2
+    anchors = nd.array(a)
+    labels = nd.array(np.array([[[0, 0.0, 0.0, 0.21, 0.21]]], np.float32))
+    cls_preds = nd.array(rng.randn(1, 3, 20).astype(np.float32))
+    _, _, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, labels, cls_preds, negative_mining_ratio=2.0,
+        ignore_label=-1, negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    n_pos = int((ct > 0).sum())
+    n_neg = int((ct == 0).sum())
+    n_ign = int((ct == -1).sum())
+    assert n_pos >= 1
+    assert n_neg <= max(2 * n_pos, 1)
+    assert n_pos + n_neg + n_ign == 20
+
+
+def test_multibox_detection_roundtrip():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.55, 0.55, 0.95, 0.95]]], np.float32))
+    # loc_pred zero -> decoded boxes == anchors
+    loc_pred = nd.zeros((1, 8))
+    cls_prob = nd.array(np.array(
+        [[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]], np.float32))  # (1,3,2)
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.05).asnumpy()[0]
+    # rows [cls_id, score, x1, y1, x2, y2]; class ids have background
+    # removed (argmax index - 1), rows sorted by score
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    assert kept[0][0] == 1  # anchor0: class idx 2 -> detection id 1
+    np.testing.assert_allclose(kept[0][1], 0.7, atol=1e-5)
+    np.testing.assert_allclose(kept[:, 2:].min(), 0.1, atol=1e-5)
+
+
+def test_box_nms():
+    # three boxes: two heavily overlapping, one separate
+    data = np.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                      [0, 0.8, 0.12, 0.12, 0.5, 0.5],
+                      [1, 0.7, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             force_suppress=True).asnumpy()[0]
+    kept = out[out[:, 1] >= 0]
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.7, 0.9], rtol=1e-5)
+    # per-class NMS keeps same-class suppression only
+    data2 = data.copy()
+    data2[0, 1, 0] = 2  # different class id for overlapping box
+    out2 = nd.contrib.box_nms(nd.array(data2), overlap_thresh=0.5,
+                              force_suppress=False, id_index=0).asnumpy()[0]
+    assert (out2[:, 1] >= 0).sum() == 3
+
+
+def test_box_iou():
+    a = nd.array(np.array([[0, 0, 2, 2]], np.float32))
+    b = nd.array(np.array([[1, 1, 3, 3], [4, 4, 5, 5]], np.float32))
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou, [[1.0 / 7.0, 0.0]], rtol=1e-5)
+
+
+def test_bipartite_matching():
+    score = nd.array(np.array([[[0.5, 0.6], [0.9, 0.4], [0.3, 0.8]]],
+                              np.float32))
+    row, col = nd.contrib.bipartite_matching(score, threshold=0.1)
+    row = row.asnumpy()[0]
+    col = col.asnumpy()[0]
+    # greedy: (1,0)=0.9 first, then (2,1)=0.8; row0 unmatched
+    assert row[1] == 0 and row[2] == 1 and row[0] == -1
+    assert col[0] == 1 and col[1] == 2
+
+
+def test_roi_pooling_forward_backward():
+    data = np.arange(2 * 1 * 6 * 6, dtype=np.float32).reshape(2, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 3, 3], [1, 2, 2, 5, 5]], np.float32)
+    out = nd.ROIPooling(nd.array(data), nd.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (2, 1, 2, 2)
+    # roi0 covers rows/cols 0..3 of image 0; max of top-left 2x2 bin = idx (1,1)
+    np.testing.assert_allclose(out[0, 0], [[7, 9], [19, 21]])
+    # gradient flows to the max element only (numeric-gradient oracle)
+    import mxnet_tpu.symbol as sym
+    s = sym.ROIPooling(sym.Variable("data"), sym.Variable("rois"),
+                       pooled_size=(2, 2), spatial_scale=1.0)
+    check_numeric_gradient(s, {"data": data, "rois": rois},
+                           grad_nodes=["data"], rtol=1e-2, atol=1e-2)
+
+
+def test_roi_align_shapes():
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.randn(1, 3, 8, 8).astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 6, 6]], np.float32))
+    out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 3, 2, 2)
+    # constant feature map -> constant output (bilinear exactness)
+    cdata = nd.ones((1, 2, 8, 8)) * 3.0
+    cout = nd.contrib.ROIAlign(cdata, rois, pooled_size=(2, 2),
+                               spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(cout, 3.0, rtol=1e-6)
+
+
+def test_proposal_shapes():
+    rng = np.random.RandomState(0)
+    B, H, W = 1, 4, 4
+    A = 2 * 3  # len(scales) * len(ratios)
+    cls_prob = nd.array(rng.uniform(0, 1, (B, 2 * A, H, W)).astype(np.float32))
+    bbox_pred = nd.array((rng.randn(B, 4 * A, H, W) * 0.1).astype(np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               feature_stride=16, scales=(2, 4),
+                               ratios=(0.5, 1, 2), rpn_pre_nms_top_n=12,
+                               rpn_post_nms_top_n=4, rpn_min_size=2)
+    assert rois.shape == (4, 5)
+    r = rois.asnumpy()
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1:3] >= 0).all() and (r[:, 3] <= 63).all() \
+        and (r[:, 4] <= 63).all()
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    offset = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=4).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), pad=(1, 1), num_filter=4).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (4, 16)
+    # interleaved layout: even cols real, odd cols imag
+    np_f = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(f.asnumpy()[:, 0::2], np_f.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(f.asnumpy()[:, 1::2], np_f.imag, rtol=1e-4,
+                               atol=1e-4)
+    # reference-scaled inverse: ifft(fft(x)) == x * D
+    rt = nd.contrib.ifft(f).asnumpy()
+    np.testing.assert_allclose(rt, x * 8, rtol=1e-3, atol=1e-3)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1, -1, 1], np.float32)
+    out = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                  out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+
+def test_symbol_contrib_namespace():
+    import mxnet_tpu.symbol as sym
+    data = sym.Variable("data")
+    prior = sym.contrib.MultiBoxPrior(data, sizes=(0.5,), ratios=(1, 2))
+    assert "data" in prior.list_arguments()
+    shapes, _, _ = prior.infer_shape(data=(1, 3, 2, 2))
+    ex = prior.bind(None, {"data": nd.zeros((1, 3, 2, 2))})
+    out = ex.forward()[0]
+    assert out.shape == (1, 2 * 2 * 2, 4)
