@@ -323,7 +323,10 @@ def _bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1,
             flat = jnp.argmin(sc)
             i, j = flat // M, flat % M
             val = sc[i, j] * sign
-            ok = (val > threshold) if not is_ascend else (val >= 0)
+            # reference contract (bounding_box-inl.h:589): accept iff
+            # score > thresh (descend) / score < thresh (ascend); the
+            # +inf exhaustion sentinel fails both tests
+            ok = (val > threshold) if not is_ascend else (val < threshold)
             rm = rm.at[i].set(jnp.where(ok, j, rm[i]))
             cm = cm.at[j].set(jnp.where(ok, i, cm[j]))
             sc = jnp.where(ok, sc.at[i, :].set(sentinel).at[:, j].set(sentinel),
@@ -386,9 +389,10 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
             (cls_id[:, None] == cls_id[None, :])
         keep = _greedy_nms_keep(boxes, score, valid, float(nms_threshold),
                                 same_ok)
-        # background removed from the id space: argmax index j -> j-1
-        # (reference: multibox_detection.cc `p_out[...] = id - 1`)
-        out_id = cls_id - 1
+        # background removed from the id space (reference:
+        # multibox_detection.cc `p_out[...] = id - 1` with bg fixed at 0);
+        # generalized: only classes above background_id shift down
+        out_id = jnp.where(cls_id > bg, cls_id - 1, cls_id)
         rows = jnp.concatenate(
             [out_id[:, None].astype(prob.dtype), score[:, None], boxes],
             axis=-1)
@@ -452,9 +456,14 @@ def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
                sample_ratio=2, **attrs):
     """ROIAlign with bilinear sampling (successor to ROIPooling; matches
     the contract detectors expect: no coordinate rounding, average of
-    sample_ratio^2 bilinear samples per bin)."""
+    sample_ratio^2 bilinear samples per bin).
+
+    sample_ratio=-1 means adaptive ceil(bin_size) sampling in the
+    reference; per-ROI sample counts are data-dependent shapes XLA
+    cannot compile, so it maps to a fixed 2x2 grid here (the value
+    detectors typically configure explicitly)."""
     PH, PW = normalize_tuple(pooled_size, 2)
-    S = max(int(sample_ratio), 1)
+    S = 2 if int(sample_ratio) <= 0 else int(sample_ratio)
     B, C, H, W = data.shape
     scale = float(spatial_scale)
 
@@ -523,7 +532,16 @@ def _rpn_anchors(H, W, feature_stride, scales, ratios):
     return (shifts[:, :, None, :] + base_boxes[None, None]).reshape(-1, 4)
 
 
-@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal"))
+def _boolattr(v):
+    """Parse a bool attr that may arrive as a string via the symbol path."""
+    if isinstance(v, str):
+        return v.lower() in ("1", "true")
+    return bool(v)
+
+
+@register("_contrib_Proposal", aliases=("Proposal", "_contrib_MultiProposal"),
+          num_outputs=lambda attrs: 2 if _boolattr(attrs.get("output_score",
+                                                             False)) else 1)
 def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
               rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
               scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
@@ -579,7 +597,7 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), post_n)
     rois = jnp.concatenate([bidx[:, None], boxes.reshape(-1, 4)], axis=-1)
     rois = lax.stop_gradient(rois)
-    if output_score:
+    if _boolattr(output_score):
         return rois, lax.stop_gradient(scores.reshape(-1, 1))
     return rois
 
@@ -681,17 +699,17 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
     B, C, H, W = data.shape
     scale = float(spatial_scale)
 
-    def bilinear(fmap, y, x):
-        y = jnp.clip(y, 0.0, H - 1.0)
-        x = jnp.clip(x, 0.0, W - 1.0)
-        y0 = jnp.floor(y).astype(jnp.int32)
-        x0 = jnp.floor(x).astype(jnp.int32)
-        y1 = jnp.minimum(y0 + 1, H - 1)
-        x1 = jnp.minimum(x0 + 1, W - 1)
-        ly, lx = y - y0, x - x0
-        return (fmap[y0, x0] * (1 - ly) * (1 - lx) +
-                fmap[y0, x1] * (1 - ly) * lx +
-                fmap[y1, x0] * ly * (1 - lx) + fmap[y1, x1] * ly * lx)
+    # static bin -> part / group-channel index maps (vectorized over the
+    # whole (OD, P, P, S, S) sample grid; one gather per corner instead
+    # of an unrolled P*P*OD python loop, which would blow up trace size)
+    part_h = np.minimum(np.arange(P) * PS // P, PS - 1)
+    part_w = np.minimum(np.arange(P) * PS // P, PS - 1)
+    grp_h = np.minimum(np.arange(P) * GS // P, GS - 1)
+    grp_w = np.minimum(np.arange(P) * GS // P, GS - 1)
+    chan = ((np.arange(OD)[:, None, None] * GS + grp_h[None, :, None]) * GS
+            + grp_w[None, None, :])                       # (OD, P, P)
+    chan_j = jnp.asarray(chan)
+    part_hj, part_wj = jnp.asarray(part_h), jnp.asarray(part_w)
 
     def one(roi, tr):
         bidx = roi[0].astype(jnp.int32)
@@ -703,29 +721,30 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
         rh = jnp.maximum(y2 - y1, 0.1)
         bw, bh = rw / P, rh / P
         fmap = data[bidx]
-        out = jnp.zeros((OD, P, P))
-        for ph in range(P):
-            for pw in range(P):
-                part_h = min(ph * PS // P, PS - 1)
-                part_w = min(pw * PS // P, PS - 1)
-                if no_trans or tr is None:
-                    dx = dy = 0.0
-                else:
-                    dy = tr[0, part_h, part_w] * float(trans_std) * rh
-                    dx = tr[1, part_h, part_w] * float(trans_std) * rw
-                sy = jnp.arange(S, dtype=jnp.float32)
-                sx = jnp.arange(S, dtype=jnp.float32)
-                yy = y1 + ph * bh + dy + (sy[:, None] + 0.5) * bh / S
-                xx = x1 + pw * bw + dx + (sx[None, :] + 0.5) * bw / S
-                gh = min(ph * GS // P, GS - 1)
-                gw = min(pw * GS // P, GS - 1)
-                for od in range(OD):
-                    c = (od * GS + gh) * GS + gw
-                    v = jnp.mean(bilinear(fmap[c], yy, xx))
-                    out = out.at[od, ph, pw].set(v)
-        return out
+        dy = tr[0][part_hj[:, None], part_wj[None, :]] * float(trans_std) * rh
+        dx = tr[1][part_hj[:, None], part_wj[None, :]] * float(trans_std) * rw
+        ph = jnp.arange(P, dtype=jnp.float32)
+        sy = (jnp.arange(S, dtype=jnp.float32) + 0.5) * bh / S
+        sx = (jnp.arange(S, dtype=jnp.float32) + 0.5) * bw / S
+        yy = (y1 + ph[:, None, None, None] * bh + dy[:, :, None, None]
+              + sy[None, None, :, None])                  # (P, P, S, S)
+        xx = (x1 + ph[None, :, None, None] * bw + dx[:, :, None, None]
+              + sx[None, None, None, :])
+        y = jnp.clip(yy, 0.0, H - 1.0)
+        x = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        ly, lx = y - y0, x - x0
+        c = chan_j[:, :, :, None, None]                   # (OD, P, P, 1, 1)
+        v = (fmap[c, y0[None], x0[None]] * ((1 - ly) * (1 - lx))[None]
+             + fmap[c, y0[None], x1i[None]] * ((1 - ly) * lx)[None]
+             + fmap[c, y1i[None], x0[None]] * (ly * (1 - lx))[None]
+             + fmap[c, y1i[None], x1i[None]] * (ly * lx)[None])
+        return jnp.mean(v, axis=(3, 4))                   # (OD, P, P)
 
-    if trans is None or no_trans:
+    if trans is None or _boolattr(no_trans):
         tr_arg = jnp.zeros((rois.shape[0], 2, PS, PS))
     else:
         tr_arg = trans.reshape(-1, 2, PS, PS)[:rois.shape[0]]

@@ -59,7 +59,7 @@ def _fn_input_names(op: OpDef):
     sig = inspect.signature(op.fn)
     required, optional = [], []
     _optional_arrays = {"bias", "gamma", "state_cell", "sequence_length",
-                       "data_lengths", "label_lengths"}
+                       "data_lengths", "label_lengths", "trans"}
     for p in sig.parameters.values():
         if p.kind in (inspect.Parameter.VAR_POSITIONAL,):
             required.append("*data")
@@ -79,6 +79,8 @@ def _op_input_names(op: OpDef, attrs):
     a = coerce_attrs(attrs)
     if "bias" in opt and not a.get("no_bias", False):
         names.append("bias")
+    if "trans" in opt and not a.get("no_trans", False):
+        names.append("trans")
     if op.name == "RNN" and a.get("mode") == "lstm":
         names.append("state_cell")
     if op.name == "LeakyReLU":

@@ -1,0 +1,236 @@
+#!/usr/bin/env python3
+"""im2rec — build RecordIO image datasets.
+
+TPU-native equivalent of the reference dataset packer
+(``tools/im2rec.py`` in the reference tree): walks an image directory,
+writes a ``.lst`` listing (index \\t label(s) \\t relpath) and packs the
+images into ``.rec`` (+ ``.idx``) RecordIO files that
+``mxnet_tpu.io.ImageRecordIter`` streams at training time.
+
+Two phases, same CLI contract as the reference:
+  --list   : generate prefix.lst from an image tree (labels = folder ids)
+  (default): read prefix*.lst and encode to prefix*.rec/.idx
+
+Encoding uses a process pool (``--num-thread``) with PIL as the codec
+(this build has no OpenCV); records are written by a single writer
+process in index order per chunk.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+import traceback
+from multiprocessing import Pool
+
+_HERE = os.path.abspath(os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) for every image under root."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for folder, label in sorted(cat.items(), key=lambda kv: kv[1]):
+            print(os.path.relpath(folder, root), label)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for item in image_list:
+            labels = "\t".join("%f" % float(x) for x in item[2:])
+            fout.write("%d\t%s\t%s\n" % (item[0], labels, item[1]))
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    if n == 0:
+        raise SystemExit("no images found under %s" % args.root)
+    chunk = (n + args.chunks - 1) // args.chunks
+    for c in range(args.chunks):
+        part = image_list[c * chunk:(c + 1) * chunk]
+        suffix = "_%d" % c if args.chunks > 1 else ""
+        sep = int(len(part) * args.train_ratio)
+        sep_test = int(len(part) * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + suffix + ".lst", part)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + suffix + "_test.lst",
+                           part[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + suffix + "_val.lst",
+                           part[sep + sep_test:])
+            write_list(args.prefix + suffix + "_train.lst",
+                       part[sep_test:sep + sep_test])
+
+
+def read_list(path_in):
+    """Parse a .lst line: index \\t label... \\t relpath."""
+    with open(path_in) as fin:
+        for lineno, line in enumerate(fin):
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                print("lst should have at least 3 columns, skipping line %d"
+                      % lineno)
+                continue
+            idx = int(float(parts[0]))
+            labels = [float(x) for x in parts[1:-1]]
+            yield (idx, parts[-1], labels)
+
+
+def encode_one(args, item):
+    """Load one image file, optionally resize/crop, JPEG-encode to bytes."""
+    from PIL import Image
+    idx, relpath, labels = item
+    fullpath = os.path.join(args.root, relpath)
+    header = recordio.IRHeader(0, labels[0] if len(labels) == 1 else labels,
+                               idx, 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as f:
+            return idx, recordio.pack(header, f.read())
+    img = Image.open(fullpath)
+    if img.mode != ("L" if args.color == 0 else "RGB"):
+        img = img.convert("L" if args.color == 0 else "RGB")
+    if args.resize:
+        w, h = img.size
+        if min(w, h) > args.resize:
+            if w > h:
+                img = img.resize((w * args.resize // h, args.resize),
+                                 Image.BILINEAR)
+            else:
+                img = img.resize((args.resize, h * args.resize // w),
+                                 Image.BILINEAR)
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w - s) // 2 + s, (h - s) // 2 + s))
+    import io as _pyio
+    buf = _pyio.BytesIO()
+    fmt = "PNG" if args.encoding == ".png" else "JPEG"
+    if fmt == "JPEG":
+        img.save(buf, format=fmt, quality=args.quality)
+    else:
+        img.save(buf, format=fmt)
+    return idx, recordio.pack(header, buf.getvalue())
+
+
+def _worker(payload):
+    args, item = payload
+    try:
+        return encode_one(args, item)
+    except Exception:
+        traceback.print_exc()
+        print("imread error trying to load file: %s" % item[1])
+        return item[0], None
+
+
+def write_rec(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    items = list(read_list(lst_path))
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    t0 = time.time()
+    done = 0
+    if args.num_thread > 1:
+        pool = Pool(args.num_thread)
+        stream = pool.imap(_worker, ((args, it) for it in items),
+                           chunksize=16)
+    else:
+        pool = None
+        stream = (_worker((args, it)) for it in items)
+    for idx, buf in stream:
+        if buf is not None:
+            record.write_idx(idx, buf)
+        done += 1
+        if done % 1000 == 0:
+            print("time: %.3f count: %d" % (time.time() - t0, done))
+            t0 = time.time()
+    if pool is not None:
+        pool.close()
+        pool.join()
+    record.close()
+    print("wrote %s (%d records)" % (prefix + ".rec", done))
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Create a RecordIO image dataset (list and/or encode).",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    p.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    p.add_argument("root", help="root folder of the images")
+    g = p.add_argument_group("list options")
+    g.add_argument("--list", action="store_true",
+                   help="generate the .lst listing instead of encoding")
+    g.add_argument("--exts", nargs="+",
+                   default=[".jpeg", ".jpg", ".png"])
+    g.add_argument("--chunks", type=int, default=1)
+    g.add_argument("--train-ratio", type=float, default=1.0)
+    g.add_argument("--test-ratio", type=float, default=0.0)
+    g.add_argument("--recursive", action="store_true",
+                   help="label = id of each image's containing folder")
+    g.add_argument("--no-shuffle", dest="shuffle", action="store_false",
+                   help="keep listing order instead of shuffling")
+    r = p.add_argument_group("record options")
+    r.add_argument("--pass-through", action="store_true",
+                   help="copy original file bytes, skip re-encode")
+    r.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this size before packing")
+    r.add_argument("--center-crop", action="store_true")
+    r.add_argument("--quality", type=int, default=95)
+    r.add_argument("--num-thread", type=int, default=1,
+                   help="encoding worker processes")
+    r.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    r.add_argument("--encoding", type=str, default=".jpg",
+                   choices=[".jpg", ".png"])
+    args = p.parse_args()
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+
+    if args.list:
+        make_list(args)
+        return
+    working_dir = os.path.dirname(args.prefix)
+    base = os.path.basename(args.prefix)
+    lsts = [os.path.join(working_dir, f)
+            for f in sorted(os.listdir(working_dir))
+            if f.startswith(base) and f.endswith(".lst")]
+    if not lsts:
+        raise SystemExit("no .lst files matching prefix %s; run with --list "
+                         "first" % args.prefix)
+    for lst in lsts:
+        print("encoding %s" % lst)
+        write_rec(args, lst)
+
+
+if __name__ == "__main__":
+    main()

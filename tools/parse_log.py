@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""parse_log — tabulate training logs.
+
+Equivalent of the reference's log parser (``tools/parse_log.py``):
+scans a training log for per-epoch train/validation metric lines and
+epoch times (the format emitted by ``module.BaseModule.fit`` +
+``callback.Speedometer``) and prints a markdown or TSV table.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+
+
+def parse(lines, metric_names):
+    patterns = (
+        [re.compile(r".*Epoch\[(\d+)\] Train-%s.*=([.\d]+)" % m)
+         for m in metric_names]
+        + [re.compile(r".*Epoch\[(\d+)\] Validation-%s.*=([.\d]+)" % m)
+           for m in metric_names]
+        + [re.compile(r".*Epoch\[(\d+)\] Time.*=([.\d]+)")])
+    ncols = len(patterns)
+    table = {}
+    for line in lines:
+        for col, pat in enumerate(patterns):
+            m = pat.match(line)
+            if m:
+                epoch = int(m.group(1))
+                row = table.setdefault(epoch, [(0.0, 0)] * ncols)
+                total, cnt = row[col]
+                row[col] = (total + float(m.group(2)), cnt + 1)
+                break
+    return table
+
+
+def main():
+    p = argparse.ArgumentParser(description="Parse a training log")
+    p.add_argument("logfile", type=str)
+    p.add_argument("--format", type=str, default="markdown",
+                   choices=["markdown", "none"])
+    p.add_argument("--metric-names", type=str, nargs="+",
+                   default=["accuracy"])
+    args = p.parse_args()
+
+    with open(args.logfile) as f:
+        table = parse(f, args.metric_names)
+
+    headers = (["train-" + m for m in args.metric_names]
+               + ["val-" + m for m in args.metric_names] + ["time"])
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(headers) + " |")
+        print("| --- " * (len(headers) + 1) + "|")
+        fmt = "| %2d | " "%s |"
+    for epoch in sorted(table):
+        row = table[epoch]
+        cells = ["%f" % (t / c) if c else "-" for t, c in row[:-1]]
+        t, c = row[-1]
+        cells.append("%.1f" % (t / c) if c else "-")
+        if args.format == "markdown":
+            print("| %2d | %s |" % (epoch + 1, " | ".join(cells)))
+        else:
+            print("\t".join(["%2d" % (epoch + 1)] + cells))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""rec2idx — regenerate the .idx offset index of a RecordIO file.
+
+Equivalent of the reference's index builder (``tools/rec2idx.py``):
+scans the .rec sequentially, recording the byte offset of each record
+keyed by the record id stored in its IRHeader (falling back to the
+ordinal position when the payload has no parseable header).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_HERE = os.path.abspath(os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def build_index(rec_path, idx_path):
+    reader = recordio.MXRecordIO(rec_path, "r")
+    count = 0
+    with open(idx_path, "w") as fout:
+        while True:
+            pos = reader.tell()
+            buf = reader.read()
+            if buf is None:
+                break
+            try:
+                header, _ = recordio.unpack(buf)
+                key = header.id
+            except Exception:
+                key = count
+            fout.write("%d\t%d\n" % (key, pos))
+            count += 1
+    reader.close()
+    return count
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Rebuild the .idx index for a RecordIO file")
+    p.add_argument("record", type=str, help="path to the .rec file")
+    p.add_argument("index", type=str, nargs="?", default=None,
+                   help="output .idx path (default: record with .idx suffix)")
+    args = p.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print("wrote %s (%d records)" % (idx, n))
+
+
+if __name__ == "__main__":
+    main()
