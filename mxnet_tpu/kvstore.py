@@ -24,7 +24,7 @@ from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "KVStoreDist", "create"]
 
 
 def _ctype_key_value(keys, vals):
@@ -63,6 +63,16 @@ class KVStore:
                 raise MXNetError("key %r already initialized" % (k,))
             self._store[k] = vlist[0].copy()
 
+    def _reduce(self, k, vlist):
+        """Merge per-device values for one key (reference CommCPU/CommDevice
+        Reduce); dist stores extend this with a cross-process all-reduce."""
+        merged = vlist[0]
+        if len(vlist) > 1:
+            merged = vlist[0].copy()
+            for v in vlist[1:]:
+                merged += v
+        return merged
+
     def push(self, key, value, priority=0):
         """Aggregate values into the store, applying the updater if set
         (reference: kvstore.py:158; server ApplyUpdates
@@ -71,12 +81,7 @@ class KVStore:
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))
-            # reduce across devices (reference CommCPU/CommDevice Reduce)
-            merged = vlist[0]
-            if len(vlist) > 1:
-                merged = vlist[0].copy()
-                for v in vlist[1:]:
-                    merged += v
+            merged = self._reduce(k, vlist)
             if self._updater is not None:
                 self._updater(self._key_int(k), merged, self._store[k])
             else:
@@ -273,13 +278,105 @@ class KVStoreTPU(KVStore):
         self._pending.clear()
 
 
+class KVStoreDist(KVStore):
+    """Multi-process synchronous data-parallel store (kvstore=dist_*).
+
+    Reference: the ps-lite parameter server (kvstore_dist.h:44 worker
+    ZPush/ZPull, kvstore_dist_server.h:151-282 sync aggregation +
+    ApplyUpdates).  TPU-native redesign: there is no server process —
+    aggregation IS an XLA all-reduce over ICI/DCN across the
+    jax.distributed process group, and every process then applies the
+    identical optimizer update to its replicated copy.  Numerics match
+    dist_sync exactly: one update per step on the globally-summed
+    gradient; ``init`` broadcasts rank 0's value so replicas start
+    identical (reference: workers init once on the server, others pull).
+
+    ``dist_async`` maps to the same synchronous collective path — without
+    a server there is no update-on-arrival to be had; async staleness is
+    a PS artifact, not a capability, so sync is strictly stronger.
+    """
+
+    def __init__(self, kv_type="dist_sync"):
+        super().__init__(kv_type)
+        from .parallel import distributed
+        distributed.init_distributed()
+        self._jit_cache = {}
+
+    # -- collective data plane -------------------------------------------
+    def _global_mesh(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()), ("w",))
+
+    def _allreduce(self, arr, root_only=False):
+        """Sum a per-process jax array across all processes.
+
+        root_only: contribute zeros unless this is process 0 — the
+        broadcast used by ``init``.
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if jax.process_count() == 1:
+            return arr
+        mesh = self._global_mesh()
+        local = mesh.local_devices
+        n_local = len(local)
+        if root_only and jax.process_index() != 0:
+            arr = jnp.zeros_like(arr)
+        # shard layout: one (1, ...) slice per local device; device 0
+        # carries the process's value, other local devices zeros, so the
+        # global sum is exactly sum over processes (no rescaling error)
+        zero = jnp.zeros_like(arr)
+        shards = [jax.device_put(arr[None] if i == 0 else zero[None], d)
+                  for i, d in enumerate(local)]
+        gshape = (len(mesh.devices.ravel()),) + arr.shape
+        garr = jax.make_array_from_single_device_arrays(
+            gshape, NamedSharding(mesh, P("w")), shards)
+        key = (arr.shape, str(arr.dtype), n_local)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                lambda x: jnp.sum(x, axis=0),
+                out_shardings=NamedSharding(mesh, P()))
+        out = self._jit_cache[key](garr)
+        return out.addressable_data(0)
+
+    def init(self, key, value):
+        super().init(key, value)
+        keys, _ = _ctype_key_value(key, value)
+        for k in keys:
+            self._store[k]._data = self._allreduce(self._store[k]._data,
+                                                   root_only=True)
+
+    def _reduce(self, k, vlist):
+        merged = super()._reduce(k, vlist)
+        # wrap in a fresh NDArray: when len(vlist)==1 merged IS the
+        # caller's gradient array, which push must not mutate
+        return NDArray(self._allreduce(merged._data))
+
+    def barrier(self):
+        """Global sync point (reference: kvstore.h:349 Barrier)."""
+        import jax
+        if jax.process_count() == 1:
+            return
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+        except ImportError:  # pragma: no cover
+            import jax.numpy as jnp
+            self._allreduce(jnp.ones((1,)))
+
+
 def create(name="local"):
     """Create a KVStore (reference: kvstore.py:628, kvstore.cc:40).
 
     Supported: local, local_allreduce_cpu, local_allreduce_device, device,
-    nccl, tpu, dist_sync, dist_device_sync, dist_async (dist types map to
-    the jax.distributed-backed collective path; on one process they are
-    identical to local)."""
+    nccl, tpu, dist_sync, dist_device_sync, dist_async (dist types run
+    cross-process XLA all-reduce over the jax.distributed process group;
+    on one process they degrade to local semantics)."""
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "local_allreduce_cpu", "local_allreduce_device",
@@ -287,6 +384,8 @@ def create(name="local"):
              "dist_async", "dist")
     if name not in valid:
         raise MXNetError("unknown KVStore type %r" % name)
+    if name.startswith("dist"):
+        return KVStoreDist(name)
     if name in ("tpu", "nccl", "device"):
         return KVStoreTPU(name)
     return KVStore(name)

@@ -28,6 +28,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (multi-process, convergence)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     """Reference: tests/python/unittest/common.py with_seed() — fixed,

@@ -1,0 +1,89 @@
+"""Multi-process dist_sync kvstore correctness.
+
+Reference analogue: tests/nightly/dist_sync_kvstore.py launched as N
+local processes via tools/launch.py --launcher local
+(docs/faq/distributed_training.md:218-233).  Here: spawn 2 worker
+subprocesses with the DMLC_* env the launcher exports; each pushes
+rank-dependent gradients into create("dist_sync") and asserts the
+all-reduced result, rank-0 init broadcast, updater semantics, and
+barrier().
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import numpy as np
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert nw == 2, nw
+
+# init broadcast: every process passes a DIFFERENT value; all must end
+# up with rank 0's
+kv.init("w", nd.array(np.full((4, 3), float(rank + 1), np.float32)))
+out = nd.zeros((4, 3))
+kv.pull("w", out=out)
+assert np.allclose(out.asnumpy(), 1.0), out.asnumpy()
+
+# push sums across processes (no updater -> store += sum)
+kv.push("w", nd.array(np.full((4, 3), float(rank + 1), np.float32)))
+kv.pull("w", out=out)
+# 1 (init) + (1+2) (summed push) = 4
+assert np.allclose(out.asnumpy(), 4.0), out.asnumpy()
+
+# per-device list push: local reduce then global reduce
+kv.push("w", [nd.ones((4, 3)), nd.ones((4, 3))])
+kv.pull("w", out=out)
+assert np.allclose(out.asnumpy(), 8.0), out.asnumpy()
+
+# updater semantics on the globally-summed gradient
+kv2_key = "u"
+kv._set_updater(lambda key, grad, weight: weight.__isub__(0.1 * grad))
+kv.init(kv2_key, nd.zeros((2, 2)))
+kv.push(kv2_key, nd.ones((2, 2)) * (rank + 1))
+o2 = nd.zeros((2, 2))
+kv.pull(kv2_key, out=o2)
+assert np.allclose(o2.asnumpy(), -0.3), o2.asnumpy()  # -0.1 * (1+2)
+
+kv.barrier()
+print("WORKER_OK rank=%%d" %% rank)
+"""
+
+
+@pytest.mark.slow
+def test_dist_sync_kvstore_two_processes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER % {"repo": REPO})
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": "9413",
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_NUM_WORKER": "2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out[-3000:])
+        assert "WORKER_OK" in out
