@@ -232,10 +232,14 @@ class Embedding(HybridBlock):
         self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
                         "dtype": dtype}
         with self.name_scope():
+            # sparse_grad: backward produces a row_sparse gradient so the
+            # optimizer's lazy path updates only the looked-up rows
+            # (reference: basic_layers.py Embedding sparse_grad)
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim),
                 init=weight_initializer, dtype=dtype,
-                allow_deferred_init=True)
+                allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def _shape_hook(self, inputs):
         pass

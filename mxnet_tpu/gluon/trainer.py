@@ -124,7 +124,15 @@ class Trainer:
                         "warning and skip updating of Parameters with stale "
                         "gradient" % (param.name, "device"))
                 continue
-            self._updaters[0](i, param.grad(), param.data())
+            grad = param.grad()
+            if param._grad_stype == "row_sparse":
+                # tape backward accumulates dense; rows never touched this
+                # step are exact zeros, so the nonzero-row detection in the
+                # RowSparse constructor recovers the touched-row set for
+                # the optimizer's lazy path
+                from ..ndarray import sparse as _sp
+                grad = _sp.RowSparseNDArray(grad._data)
+            self._updaters[0](i, grad, param.data())
 
     def save_states(self, fname):
         """Reference: trainer.py save_states."""

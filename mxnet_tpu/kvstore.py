@@ -99,15 +99,30 @@ class KVStore:
                 o._data = src._data.astype(o.dtype) if o.dtype != src.dtype else src._data
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull selected rows (reference: kvstore.py PullRowSparse)."""
+        """Pull only the requested rows (reference: kvstore.py
+        row_sparse_pull / KVStore::PullRowSparse, kvstore.h:144): out
+        receives a row_sparse view holding exactly the rows named by
+        ``row_ids``; all other rows are zero."""
+        import jax.numpy as jnp
         assert out is not None and row_ids is not None
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(keys)
+        assert len(row_ids) == len(keys), \
+            "one row_ids array per key is required"
         for k, olist, rid in zip(keys, outs, row_ids):
-            src = self._store[k]
+            if k not in self._store:
+                raise MXNetError("key %r has not been initialized" % (k,))
+            src = self._store[k]._data
+            ids = jnp.unique(rid._data.astype(jnp.int32))
+            rows = jnp.take(src, ids, axis=0)
+            filtered = jnp.zeros_like(src).at[ids].set(rows)
             for o in olist:
-                o._data = src._data  # dense storage; row filtering is a view
+                o._data = filtered.astype(o.dtype) \
+                    if o.dtype != self._store[k].dtype else filtered
+                if getattr(o, "stype", "default") == "row_sparse":
+                    o._indices = ids.astype(jnp.int64)
+                    o._values = rows
         return
 
     # -- compression / updater ----------------------------------------------

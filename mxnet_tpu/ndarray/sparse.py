@@ -20,7 +20,11 @@ from .ndarray import NDArray, array as _dense_array
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_stype", "_indices", "_indptr")
+    __slots__ = ("_stype", "_indices", "_indptr", "_values")
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return dot(self, other, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
 
     @property
     def stype(self):
@@ -54,6 +58,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._stype = "row_sparse"
         self._indices = idx
         self._indptr = None
+        self._values = jnp.take(dense, idx.astype(jnp.int32), axis=0)
 
     @property
     def indices(self):
@@ -82,6 +87,7 @@ class CSRNDArray(BaseSparseNDArray):
                 if np_d.shape[0] else np.array([], np.int64)
             self._indptr = jnp.asarray(indptr_np, dtype=jnp.int64)
             self._indices = jnp.asarray(indices_np, dtype=jnp.int64)
+            self._values = jnp.asarray(np_d[nz])
         else:
             d = np.asarray(data)
             ip = np.asarray(indptr, dtype=np.int64)
@@ -93,6 +99,7 @@ class CSRNDArray(BaseSparseNDArray):
             dense = jnp.asarray(dense_np)
             self._indptr = jnp.asarray(ip)
             self._indices = jnp.asarray(ix)
+            self._values = jnp.asarray(d)
         super().__init__(dense, ctx=ctx)
         self._stype = "csr"
 
@@ -106,12 +113,7 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def data(self):
-        np_d = self.asnumpy()
-        ip = np.asarray(self._indptr)
-        ix = np.asarray(self._indices)
-        vals = np.concatenate([np_d[r, ix[ip[r]:ip[r + 1]]] for r in range(np_d.shape[0])]) \
-            if np_d.shape[0] else np.array([], np_d.dtype)
-        return _dense_array(vals)
+        return NDArray(self._values)
 
 
 def cast_storage(arr, stype):
@@ -175,3 +177,43 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         return RowSparseNDArray(data, indices=indices, shape=shape, ctx=ctx)
     a = np.asarray(arg1 if not isinstance(arg1, NDArray) else arg1.asnumpy())
     return RowSparseNDArray(jnp.asarray(a), ctx=ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware matrix product (reference: src/operator/tensor/dot-inl.h
+    FComputeEx paths DotCsrDnsDns / DotCsrTDnsDns).
+
+    dot(csr, dense): gather rhs rows at the stored column indices and
+    segment-sum by row — touches only the nnz values, never the dense
+    backing.  dot(csr.T, dense): scatter-add into the output rows.  Falls
+    back to the dense op for any other operand combination.
+    """
+    import jax
+
+    if isinstance(lhs, CSRNDArray) and not transpose_b and \
+            not isinstance(rhs, BaseSparseNDArray):
+        n_rows, n_cols = lhs.shape
+        vals = lhs._values
+        cols = lhs._indices.astype(jnp.int32)
+        counts = np.diff(np.asarray(lhs._indptr))
+        rows = jnp.asarray(
+            np.repeat(np.arange(n_rows), counts).astype(np.int32))
+        r = rhs._data
+        squeeze = r.ndim == 1
+        if squeeze:
+            r = r[:, None]
+        if transpose_a:
+            contrib = vals[:, None] * r[rows]
+            out = jnp.zeros((n_cols, r.shape[1]), r.dtype).at[cols].add(
+                contrib)
+        else:
+            contrib = vals[:, None] * r[cols]
+            out = jax.ops.segment_sum(contrib, rows, num_segments=n_rows)
+        if squeeze:
+            out = out[:, 0]
+        return NDArray(out)
+    from . import ndarray as _ndmod
+    return getattr(_ndmod.NDArray, "dot")(
+        NDArray(lhs._data) if isinstance(lhs, BaseSparseNDArray) else lhs,
+        NDArray(rhs._data) if isinstance(rhs, BaseSparseNDArray) else rhs,
+        transpose_a=transpose_a, transpose_b=transpose_b)

@@ -126,3 +126,72 @@ def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     new_mom = momentum * mom - (1 - momentum) * g
     new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
     return new_w, new_mom
+
+
+# ---------------------------------------------------------------------------
+# Row-sparse (lazy) updates
+#
+# Reference: the row_sparse variants in src/operator/optimizer_op-inl.h
+# (SGDUpdateRspRspImpl, SGDMomUpdateRspRspImpl, AdamUpdateRspRspImpl):
+# only rows present in the gradient are touched — momentum/variance of
+# untouched rows do NOT decay (lazy_update semantics).  TPU-native shape:
+# gather touched rows -> fused row update -> scatter back; one XLA
+# program regardless of row count.
+# ---------------------------------------------------------------------------
+_rs_jit_cache = {}
+
+
+def _rs_jit(fn):
+    import jax
+    if fn.__name__ not in _rs_jit_cache:
+        _rs_jit_cache[fn.__name__] = jax.jit(fn, donate_argnums=())
+    return _rs_jit_cache[fn.__name__]
+
+
+def _rs_prep(vals, w_rows, rescale, clip, wd):
+    g = vals * rescale
+    g = jnp.where(clip >= 0, jnp.clip(g, -clip, clip), g)
+    return g + wd * w_rows
+
+
+def _sgd_rowsparse(weight, vals, idx, lr, wd, rescale, clip):
+    w_rows = weight[idx]
+    g = _rs_prep(vals, w_rows, rescale, clip, wd)
+    return weight.at[idx].set(w_rows - lr * g)
+
+
+def _sgd_mom_rowsparse(weight, mom, vals, idx, lr, momentum, wd, rescale,
+                       clip):
+    w_rows = weight[idx]
+    g = _rs_prep(vals, w_rows, rescale, clip, wd)
+    new_mom_rows = momentum * mom[idx] - lr * g
+    return (weight.at[idx].set(w_rows + new_mom_rows),
+            mom.at[idx].set(new_mom_rows))
+
+
+def _adam_rowsparse(weight, mean, var, vals, idx, lr, beta1, beta2, epsilon,
+                    wd, rescale, clip):
+    w_rows = weight[idx]
+    g = _rs_prep(vals, w_rows, rescale, clip, wd)
+    m_rows = beta1 * mean[idx] + (1.0 - beta1) * g
+    v_rows = beta2 * var[idx] + (1.0 - beta2) * g * g
+    w_new = w_rows - lr * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    return (weight.at[idx].set(w_new), mean.at[idx].set(m_rows),
+            var.at[idx].set(v_rows))
+
+
+def sgd_rowsparse(weight, vals, idx, **kw):
+    return _rs_jit(_sgd_rowsparse)(weight, vals, idx, kw["lr"], kw["wd"],
+                                   kw["rescale"], kw["clip"])
+
+
+def sgd_mom_rowsparse(weight, mom, vals, idx, **kw):
+    return _rs_jit(_sgd_mom_rowsparse)(weight, mom, vals, idx, kw["lr"],
+                                       kw["momentum"], kw["wd"],
+                                       kw["rescale"], kw["clip"])
+
+
+def adam_rowsparse(weight, mean, var, vals, idx, **kw):
+    return _rs_jit(_adam_rowsparse)(weight, mean, var, vals, idx, kw["lr"],
+                                    kw["beta1"], kw["beta2"], kw["epsilon"],
+                                    kw["wd"], kw["rescale"], kw["clip"])

@@ -1,0 +1,154 @@
+"""Sparse compute: lazy row updates, CSR dot, row_sparse_pull.
+
+Reference analogues: tests/python/unittest/test_sparse_operator.py +
+test_sparse_ndarray.py (sparse dot, sparse optimizer updates), and the
+row_sparse kernels in src/operator/optimizer_op-inl.h.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def test_rowsparse_accessors():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 4]
+    assert rs.data.asnumpy().shape == (2, 3)
+    assert np.allclose(rs.asnumpy(), dense)
+
+
+def test_csr_dot_matches_dense():
+    rng = np.random.RandomState(0)
+    a = rng.rand(5, 7).astype(np.float32)
+    a[a < 0.6] = 0.0  # ~60% sparse
+    b = rng.rand(7, 4).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    out = nd.dot(csr, nd.array(b))
+    assert np.allclose(out.asnumpy(), a @ b, atol=1e-5)
+    # transpose_a: (7,4) <- (5,7)^T @ (5,4)
+    c = rng.rand(5, 4).astype(np.float32)
+    out_t = nd.dot(csr, nd.array(c), transpose_a=True)
+    assert np.allclose(out_t.asnumpy(), a.T @ c, atol=1e-5)
+    # vector rhs
+    v = rng.rand(7).astype(np.float32)
+    out_v = nd.dot(csr, nd.array(v))
+    assert np.allclose(out_v.asnumpy(), a @ v, atol=1e-5)
+    # method form
+    assert np.allclose(csr.dot(nd.array(b)).asnumpy(), a @ b, atol=1e-5)
+
+
+def test_csr_dot_never_reads_dense_backing():
+    """The kernel must consume only (values, indices, indptr)."""
+    csr = sparse.csr_matrix((np.array([1.0, 2.0, 3.0], np.float32),
+                             np.array([0, 2, 1]), np.array([0, 2, 3])),
+                            shape=(2, 3))
+    b = np.arange(12, np.float32).reshape(3, 4) if False else \
+        np.arange(12).astype(np.float32).reshape(3, 4)
+    ref = csr.asnumpy() @ b
+    # corrupt the dense backing; sparse dot must not notice
+    import jax.numpy as jnp
+    csr._data = jnp.full((2, 3), 777.0)
+    out = nd.dot(csr, nd.array(b))
+    assert np.allclose(out.asnumpy(), ref)
+
+
+def test_sgd_lazy_update_touched_rows_only():
+    """Momentum of untouched rows must NOT decay (reference
+    SGDMomUpdateRspRspImpl lazy semantics)."""
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           lazy_update=True)
+    w = nd.ones((8, 4))
+    state = opt.create_state(0, w)
+    # seed momentum everywhere
+    dense_g = np.ones((8, 4), np.float32)
+    opt.update(0, w, sparse.row_sparse_array(dense_g), state)
+    mom_before = state.asnumpy().copy()
+    w_before = w.asnumpy().copy()
+    # second update touches only rows 2 and 5
+    g2 = np.zeros((8, 4), np.float32)
+    g2[2] = 1.0
+    g2[5] = 2.0
+    opt.update(0, w, sparse.row_sparse_array(g2), state)
+    w_after = w.asnumpy()
+    mom_after = state.asnumpy()
+    untouched = [r for r in range(8) if r not in (2, 5)]
+    assert np.array_equal(w_after[untouched], w_before[untouched])
+    assert np.array_equal(mom_after[untouched], mom_before[untouched])
+    assert not np.allclose(w_after[[2, 5]], w_before[[2, 5]])
+    # dense update on the same state WOULD decay untouched momentum
+    opt_d = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                             lazy_update=False)
+    w_d = nd.array(w_before)
+    st_d = nd.array(mom_before)
+    opt_d.update(0, w_d, nd.array(g2), st_d)
+    assert not np.array_equal(st_d.asnumpy()[untouched],
+                              mom_before[untouched])
+
+
+def test_adam_lazy_update():
+    opt = mx.optimizer.Adam(learning_rate=0.01, lazy_update=True)
+    w = nd.ones((6, 3))
+    mean, var = opt.create_state(0, w)
+    g = np.zeros((6, 3), np.float32)
+    g[1] = 0.5
+    opt.update(0, w, sparse.row_sparse_array(g), (mean, var))
+    w_np = w.asnumpy()
+    assert np.array_equal(w_np[[0, 2, 3, 4, 5]],
+                          np.ones((5, 3), np.float32))
+    assert not np.allclose(w_np[1], 1.0)
+    assert np.array_equal(mean.asnumpy()[0], np.zeros(3, np.float32))
+    assert not np.allclose(mean.asnumpy()[1], 0.0)
+
+
+def test_kvstore_row_sparse_pull_honors_row_ids():
+    kv = mx.kv.create("local")
+    vals = np.arange(24).astype(np.float32).reshape(6, 4)
+    kv.init("emb", nd.array(vals))
+    out = sparse.zeros("row_sparse", (6, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4, 1]))
+    got = out.asnumpy()
+    assert np.array_equal(got[1], vals[1])
+    assert np.array_equal(got[4], vals[4])
+    untouched = [0, 2, 3, 5]
+    assert np.array_equal(got[untouched], np.zeros((4, 4), np.float32))
+    assert sorted(out.indices.asnumpy().tolist()) == [1, 4]
+
+
+def test_embedding_sparse_grad_training():
+    """End-to-end: Embedding(sparse_grad=True) + Trainer only moves the
+    looked-up rows (reference: gluon sparse embedding training)."""
+    from mxnet_tpu import gluon, autograd
+    net = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    net.initialize(mx.init.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5, "momentum": 0.9})
+    x = nd.array(np.array([1, 3, 3], np.float32))
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    trainer.step(1)
+    w = net.weight.data().asnumpy()
+    untouched = [r for r in range(10) if r not in (1, 3)]
+    assert np.array_equal(w[untouched], np.ones((8, 4), np.float32))
+    assert not np.allclose(w[1], 1.0)
+    assert not np.allclose(w[3], 1.0)
+    # row 3 was looked up twice -> gradient doubled -> moved further
+    assert abs(w[3, 0] - 1.0) > abs(w[1, 0] - 1.0)
+
+
+def test_retain():
+    dense = np.zeros((5, 2), np.float32)
+    dense[[0, 2, 4]] = [[1, 1], [2, 2], [3, 3]]
+    rs = sparse.row_sparse_array(dense)
+    kept = rs.retain(nd.array([0, 4]))
+    got = kept.asnumpy()
+    assert np.array_equal(got[[0, 4]], dense[[0, 4]])
+    assert np.array_equal(got[[1, 2, 3]], np.zeros((3, 2), np.float32))
+    assert kept.indices.asnumpy().tolist() == [0, 4]
