@@ -14,6 +14,8 @@ from .trainer import ParallelTrainer, pure_block_apply  # noqa: F401
 from .attention import (  # noqa: F401
     ring_attention, ulysses_attention, local_attention,
 )
+from .pipeline import pipeline_apply, stack_stages  # noqa: F401
+from .moe import switch_moe, stack_experts  # noqa: F401
 from .distributed import (  # noqa: F401
     init_distributed, rank, num_workers, is_initialized,
 )

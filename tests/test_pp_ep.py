@@ -1,0 +1,137 @@
+"""Pipeline (pp) and expert (ep) parallelism numerics on the 8-CPU mesh.
+
+Reference analogue: tests/python/unittest/test_model_parallel.py (multi-
+device semantics verified without hardware).  VERDICT round-1 item 9:
+pp/ep must numerically match the single-device model.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu import parallel
+
+
+def _stage_params(rng, n_stages, dim):
+    return [dict(w=jnp.asarray(rng.randn(dim, dim).astype(np.float32) * 0.3),
+                 b=jnp.asarray(rng.randn(dim).astype(np.float32) * 0.1))
+            for _ in range(n_stages)]
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+@pytest.mark.parametrize("pp,mb", [(4, 4), (4, 8), (8, 8)])
+def test_pipeline_matches_sequential(pp, mb):
+    mesh = parallel.make_mesh(dp=8 // pp, pp=pp)
+    rng = np.random.RandomState(0)
+    stages = _stage_params(rng, pp, 6)
+    stacked = parallel.stack_stages(stages)
+    x = jnp.asarray(rng.randn(16, 6).astype(np.float32))
+    ref = x
+    for p in stages:
+        ref = _stage_fn(p, ref)
+    out = parallel.pipeline_apply(_stage_fn, stacked, x, mesh,
+                                  num_microbatches=mb)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    pp = 4
+    mesh = parallel.make_mesh(dp=8 // pp, pp=pp)
+    rng = np.random.RandomState(1)
+    stages = _stage_params(rng, pp, 4)
+    stacked = parallel.stack_stages(stages)
+    x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+
+    def loss_pipe(params):
+        out = parallel.pipeline_apply(_stage_fn, params, x, mesh)
+        return jnp.mean((out - y) ** 2)
+
+    def loss_seq(params):
+        h = x
+        for s in range(pp):
+            h = _stage_fn(jax.tree.map(lambda a: a[s], params), h)
+        return jnp.mean((h - y) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_seq)):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def _expert_fn(params, toks):
+    return jnp.tanh(toks @ params["w1"]) @ params["w2"]
+
+
+def _moe_dense_ref(x, gate_w, ep_params):
+    """Single-device reference: route each token to its argmax expert."""
+    probs = jax.nn.softmax(np.asarray(x) @ np.asarray(gate_w), axis=-1)
+    eid = np.argmax(probs, axis=-1)
+    out = np.zeros_like(np.asarray(x))
+    for t in range(x.shape[0]):
+        p = jax.tree.map(lambda a: a[eid[t]], ep_params)
+        out[t] = np.asarray(_expert_fn(p, x[t:t + 1]))[0] * probs[t, eid[t]]
+    return out
+
+
+@pytest.mark.parametrize("ep,E", [(8, 8), (4, 8), (2, 4)])
+def test_switch_moe_matches_dense(ep, E):
+    mesh = parallel.make_mesh(dp=8 // ep, ep=ep)
+    rng = np.random.RandomState(2)
+    D, H, T = 6, 10, 32
+    gate_w = jnp.asarray(rng.randn(D, E).astype(np.float32))
+    experts = [dict(w1=jnp.asarray(rng.randn(D, H).astype(np.float32) * 0.4),
+                    w2=jnp.asarray(rng.randn(H, D).astype(np.float32) * 0.4))
+               for _ in range(E)]
+    stacked = parallel.stack_experts(experts)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    # capacity high enough that nothing drops
+    out = parallel.switch_moe(x, gate_w, stacked, _expert_fn, mesh,
+                              capacity_factor=float(E))
+    ref = _moe_dense_ref(x, gate_w, stacked)
+    assert np.allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_switch_moe_capacity_drops_tokens():
+    """Over-capacity tokens contribute exactly zero."""
+    ep, E, D = 2, 2, 4
+    mesh = parallel.make_mesh(dp=8 // ep, ep=ep)
+    rng = np.random.RandomState(3)
+    # gate forces every token to expert 0
+    gate_w = jnp.asarray(
+        np.stack([np.ones(D), -np.ones(D)], axis=1).astype(np.float32) * 5)
+    experts = [dict(w1=jnp.asarray(rng.randn(D, D).astype(np.float32)),
+                    w2=jnp.asarray(rng.randn(D, D).astype(np.float32)))
+               for _ in range(E)]
+    stacked = parallel.stack_experts(experts)
+    x = jnp.abs(jnp.asarray(rng.randn(8, D).astype(np.float32))) + 0.1
+    out = parallel.switch_moe(x, gate_w, stacked, _expert_fn, mesh,
+                              capacity_factor=0.5)  # C = 1 per source dev
+    nonzero_rows = np.asarray(jnp.any(out != 0, axis=-1)).sum()
+    # 2 source devices x capacity 1 = at most 2 surviving tokens
+    assert nonzero_rows <= 2
+    assert nonzero_rows >= 1
+
+
+def test_switch_moe_grads_flow():
+    ep, E, D, T = 4, 4, 4, 16
+    mesh = parallel.make_mesh(dp=8 // ep, ep=ep)
+    rng = np.random.RandomState(4)
+    gate_w = jnp.asarray(rng.randn(D, E).astype(np.float32))
+    experts = [dict(w1=jnp.asarray(rng.randn(D, D).astype(np.float32)),
+                    w2=jnp.asarray(rng.randn(D, D).astype(np.float32)))
+               for _ in range(E)]
+    stacked = parallel.stack_experts(experts)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+
+    def loss(params):
+        out = parallel.switch_moe(x, gate_w, params, _expert_fn, mesh,
+                                  capacity_factor=float(E))
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(stacked)
+    total = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree.leaves(grads))
+    assert np.isfinite(total) and total > 0
