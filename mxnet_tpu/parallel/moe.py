@@ -25,12 +25,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..base import MXNetError
+from .pipeline import stack_stages as stack_experts  # same stacking helper
+
 __all__ = ["switch_moe", "stack_experts"]
-
-
-def stack_experts(param_trees):
-    """Stack per-expert parameter pytrees on a new leading expert axis."""
-    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *param_trees)
 
 
 def switch_moe(x, gate_w, expert_params, expert_fn, mesh,
@@ -47,11 +45,10 @@ def switch_moe(x, gate_w, expert_params, expert_fn, mesh,
     ep = mesh.shape[axis]
     E = gate_w.shape[1]
     if E % ep:
-        raise ValueError("num experts %d not divisible by ep=%d" % (E, ep))
+        raise MXNetError("num experts %d not divisible by ep=%d" % (E, ep))
     T = x.shape[0]
     if T % ep:
-        raise ValueError("token count %d not divisible by ep=%d" % (T, ep))
-    E_local = E // ep
+        raise MXNetError("token count %d not divisible by ep=%d" % (T, ep))
     T_local = T // ep
     # per-(expert, source-device) queue capacity
     C = max(int(capacity_factor * T_local / E), 1)

@@ -21,13 +21,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
 
 __all__ = ["pipeline_apply", "stack_stages"]
 
 
 def stack_stages(param_trees):
-    """Stack per-stage parameter pytrees on a new leading stage axis."""
+    """Stack per-stage (or per-expert — moe.py aliases this) parameter
+    pytrees on a new leading axis."""
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *param_trees)
 
 
@@ -46,7 +49,7 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
     M = int(num_microbatches or S)
     B = x.shape[0]
     if B % M:
-        raise ValueError("batch %d not divisible into %d microbatches"
+        raise MXNetError("batch %d not divisible into %d microbatches"
                          % (B, M))
     mbs = x.reshape((M, B // M) + x.shape[1:])
 
@@ -74,10 +77,10 @@ def pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches=None,
             buf = lax.ppermute(out, axis, perm)
             return (buf, outs), None
 
-        # pvary: the carry is device-varying under shard_map (each stage
-        # holds different activations), so the init must be typed as such
-        init = (lax.pvary(jnp.zeros(mb_shape, x.dtype), axis),
-                lax.pvary(jnp.zeros(mbs.shape, x.dtype), axis))
+        # the carry is device-varying under shard_map (each stage holds
+        # different activations), so the init must be typed as such
+        init = (lax.pcast(jnp.zeros(mb_shape, x.dtype), axis, to="varying"),
+                lax.pcast(jnp.zeros(mbs.shape, x.dtype), axis, to="varying"))
         (_, outs), _ = lax.scan(body, init, jnp.arange(M + S - 1))
         # result lives on the last stage only; psum replicates it (and
         # transposes to an identity-on-last-stage in backward)
