@@ -789,3 +789,22 @@ def _count_sketch(data, h, s, out_dim=0, **attrs):
     contrib = data * si[None, :]
     out = jnp.zeros(data.shape[:-1] + (out_dim,), data.dtype)
     return out.at[..., hi].add(contrib)
+
+
+# ---------------------------------------------------------------------------
+# Pallas-fused inference epilogue
+# ---------------------------------------------------------------------------
+@register("_contrib_fused_bn_relu")
+def _fused_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+                   act=True, **attrs):
+    """Inference BatchNorm folded to scale/bias + ReLU as ONE Pallas pass
+    (ops/pallas_kernels.py fused_scale_bias_relu; reference analogue: the
+    BN+Activation fusion of nn/mkldnn).  data NCHW."""
+    from .pallas_kernels import fused_scale_bias_relu
+    scale = gamma * lax.rsqrt(moving_var + eps)
+    bias = beta - moving_mean * scale
+    B, C = data.shape[0], data.shape[1]
+    flat = jnp.transpose(data, (0, 2, 3, 1)).reshape(-1, C)
+    y = fused_scale_bias_relu(flat, scale, bias, relu=_boolattr(act))
+    H, W = data.shape[2], data.shape[3]
+    return jnp.transpose(y.reshape(B, H, W, C), (0, 3, 1, 2))

@@ -1,0 +1,318 @@
+"""Pallas TPU kernels for hot paths where XLA fusion is not enough.
+
+SURVEY.md §2.5/§7 names these the north star for the operator library's
+hot paths.  Two kernels live here:
+
+- ``flash_attention`` — blockwise online-softmax attention (forward and
+  backward), the kernel behind long-context attention: O(T) memory
+  instead of XLA's materialized (T, T) logits.  This is the per-device
+  block kernel of ring/Ulysses sequence parallelism
+  (parallel/attention.py); reference long-sequence analogue: the fused
+  cuDNN RNN workspace kernels (src/operator/cudnn_rnn-inl.h).
+- ``fused_scale_bias_relu`` — the inference BatchNorm + ReLU epilogue as
+  one VMEM-resident pass (reference: the BN+Activation fusion MKL-DNN
+  does on CPU, nn/mkldnn/mkldnn_base-inl.h).
+
+Both run natively on TPU and in `interpret=True` mode everywhere else
+(CPU tests exercise the same kernel code paths).
+
+Layout note: per-row softmax stats (m, l, lse, delta) are stored with a
+trailing 128-lane dim, every lane holding the same value — the Mosaic
+tiling constraint (last two block dims divisible by (8, 128)) forbids
+1-D row vectors, and this is the same convention jax's in-tree flash
+kernel uses.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _interpret():
+    return not _on_tpu()
+
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Flash attention
+# ---------------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, scale, causal, bq, bk, nk):
+    """Grid (BH, nQ, nK); accumulate across the sequential nK dimension in
+    VMEM scratch, finalize on the last K step (the canonical online-
+    softmax schedule)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: skip K blocks entirely above the diagonal
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[:]                                    # (BQ, D)
+        k = k_ref[:]                                    # (BK, D)
+        v = v_ref[:]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_ref[:, :1]                           # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                 # (BQ, 1)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[:] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[:] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, acc_ref, *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[:, :1])
+        dp = jax.lax.dot_general(do_ref[:], v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[:, :1]) * scale
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _final():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          bq, bk, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True if not causal else (ki * bk <= qi * bq + bq - 1)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[:, :1])                      # (BQ, BK)
+        do = do_ref[:]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[:, :1]) * scale             # (BQ, BK)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pick_block(t, pref):
+    b = min(pref, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _qspec(bq, d):
+    return pl.BlockSpec((None, bq, d), lambda b, i, j: (b, i, 0))
+
+
+def _kspec(bk, d):
+    return pl.BlockSpec((None, bk, d), lambda b, i, j: (b, j, 0))
+
+
+def _lmspec(bq):
+    return pl.BlockSpec((None, bq, LANES), lambda b, i, j: (b, i, 0))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Blockwise online-softmax attention.
+
+    q, k, v: (BH, T, D) — fold batch and heads into the leading dim.
+    Returns (BH, T, D).  O(T) memory; causal masking skips upper-
+    triangular K blocks entirely.
+    """
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[_qspec(bq, d), _kspec(bk, d), _kspec(bk, d)],
+        out_specs=[_qspec(bq, d), _lmspec(bq)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
+    o, res = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return o, res
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, tq, LANES))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=s, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[_qspec(bq, d), _kspec(bk, d), _kspec(bk, d),
+                  _qspec(bq, d), _lmspec(bq), _lmspec(bq)],
+        out_specs=_qspec(bq, d),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    qspec_t = pl.BlockSpec((None, bq, d), lambda b, j, i: (b, i, 0))
+    kspec_t = pl.BlockSpec((None, bk, d), lambda b, j, i: (b, j, 0))
+    lmspec_t = pl.BlockSpec((None, bq, LANES), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=s, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, lmspec_t, lmspec_t],
+        out_specs=[kspec_t, kspec_t],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Fused inference BatchNorm + ReLU epilogue
+# ---------------------------------------------------------------------------
+def _scale_bias_relu_kernel(x_ref, s_ref, b_ref, o_ref, *, relu):
+    y = x_ref[:] * s_ref[:] + b_ref[:]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def fused_scale_bias_relu(x, scale, bias, relu=True, block=1024):
+    """y = relu(x * scale + bias) in one VMEM pass.
+
+    x: (N, C) with per-column scale/bias (callers reshape NCHW to
+    (N*H*W, C) layout first).  The inference BatchNorm epilogue:
+    scale = gamma/sqrt(var+eps), bias = beta - mean*scale.
+    """
+    n, c = x.shape
+    bn = _pick_block(n, block)
+    kernel = functools.partial(_scale_bias_relu_kernel, relu=relu)
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x, scale.reshape(1, c), bias.reshape(1, c))

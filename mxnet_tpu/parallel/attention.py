@@ -30,13 +30,46 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
 
 
+def _flash_eligible(q, k, causal, q_offset, kv_offset):
+    """Flash path: TPU backend, aligned offsets (the kernel's causal mask
+    assumes a shared origin), block-divisible sequence lengths."""
+    try:
+        import jax as _jax
+        if _jax.default_backend() != "tpu":
+            return False
+    except Exception:  # pragma: no cover
+        return False
+    if causal and (q_offset != 0 or kv_offset != 0):
+        return False
+    # kernel picks halving block sizes; power-of-two-divisible lengths
+    # keep the grid exact
+    return q.shape[1] % 8 == 0 and k.shape[1] % 8 == 0
+
+
 def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
-                    scale=None):
-    """Plain softmax attention on local blocks.
+                    scale=None, impl="auto"):
+    """Softmax attention on local blocks.
 
     q: (B, Tq, H, D), k/v: (B, Tk, H, D).  Offsets give the global
-    positions of the first query/key for causal masking across shards."""
+    positions of the first query/key for causal masking across shards.
+
+    impl: "auto" uses the Pallas flash kernel on TPU when offsets are
+    aligned and T divides into blocks (O(T) memory instead of the
+    materialized (T, T) logits); "einsum"/"flash" force a path.
+    """
     d = q.shape[-1]
+    use_flash = (impl == "flash" or
+                 (impl == "auto" and _flash_eligible(q, k, causal,
+                                                     q_offset, kv_offset)))
+    if use_flash:
+        from ..ops.pallas_kernels import flash_attention
+        b, tq, h, _ = q.shape
+        tk = k.shape[1]
+        fold = lambda a, t: jnp.transpose(a, (0, 2, 1, 3)).reshape(
+            b * h, t, d)
+        o = flash_attention(fold(q, tq), fold(k, tk), fold(v, tk),
+                            causal, scale)
+        return jnp.transpose(o.reshape(b, h, tq, d), (0, 2, 1, 3))
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:

@@ -1,0 +1,106 @@
+"""Pallas kernel numerics (interpret mode on the CPU mesh; the same
+kernel code compiles natively on TPU).
+
+Reference analogue: the fused-kernel coverage of tests/cpp/operator/
+(batchnorm_test.cc, op perf harness) — VERDICT round-1 item 3.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+
+def _ref_attn(q, k, v, causal, T, D):
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / math.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T,D,bq,bk", [(64, 16, 16, 16), (48, 8, 16, 8)])
+def test_flash_attention_forward(causal, T, D, bq, bk):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(3, T, D).astype(np.float32))
+               for _ in range(3))
+    o = pk.flash_attention(q, k, v, causal, None, bq, bk)
+    r = _ref_attn(q, k, v, causal, T, D)
+    assert float(jnp.abs(o - r).max()) < 1e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads(causal):
+    T, D = 32, 8
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(2, T, D).astype(np.float32))
+               for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(q, k, v, causal, None, 8, 8) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attn(q, k, v, causal, T, D) ** 2)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_flash_attention_numerically_stable():
+    """Large logits: online softmax must not overflow."""
+    T, D = 16, 8
+    q = jnp.full((1, T, D), 30.0)
+    k = jnp.full((1, T, D), 30.0)
+    v = jnp.ones((1, T, D))
+    o = pk.flash_attention(q, k, v, False, None, 8, 8)
+    assert np.isfinite(np.asarray(o)).all()
+    assert np.allclose(np.asarray(o), 1.0, atol=1e-5)
+
+
+def test_fused_scale_bias_relu():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(128, 24).astype(np.float32))
+    s = jnp.asarray(rng.rand(24).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(24).astype(np.float32))
+    y = pk.fused_scale_bias_relu(x, s, b, relu=True)
+    assert float(jnp.abs(y - jnp.maximum(x * s + b, 0)).max()) < 1e-6
+    y2 = pk.fused_scale_bias_relu(x, s, b, relu=False)
+    assert float(jnp.abs(y2 - (x * s + b)).max()) < 1e-6
+
+
+def test_contrib_fused_bn_relu_op():
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 6, 5, 5).astype(np.float32)
+    gamma = rng.rand(6).astype(np.float32) + 0.5
+    beta = rng.randn(6).astype(np.float32)
+    mean = rng.randn(6).astype(np.float32) * 0.1
+    var = rng.rand(6).astype(np.float32) + 0.5
+    out = nd.contrib.fused_bn_relu(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mean),
+        nd.array(var), eps=1e-5).asnumpy()
+    scale = gamma / np.sqrt(var + 1e-5)
+    ref = np.maximum(x * scale[None, :, None, None]
+                     + (beta - mean * scale)[None, :, None, None], 0)
+    assert np.abs(out - ref).max() < 1e-5
+
+
+def test_local_attention_flash_impl_matches_einsum():
+    """The integration point ulysses uses: impl='flash' (interpret on
+    CPU) must match the einsum path."""
+    from mxnet_tpu.parallel import attention as att
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 32, 4, 8).astype(np.float32))
+    for causal in (False, True):
+        a = att.local_attention(q, k, v, causal=causal, impl="flash")
+        b = att.local_attention(q, k, v, causal=causal, impl="einsum")
+        assert float(jnp.abs(a - b).max()) < 1e-5
