@@ -48,4 +48,5 @@ from . import operator  # noqa: F401
 from .operator import CustomOp, CustomOpProp  # noqa: F401
 from . import log  # noqa: F401
 from . import rtc  # noqa: F401
+from . import contrib  # noqa: F401
 from . import test_utils  # noqa: F401

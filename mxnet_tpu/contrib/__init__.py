@@ -1,0 +1,4 @@
+"""Contrib namespace (reference: python/mxnet/contrib/) — experimental
+subsystems: quantization, text embeddings, tensorboard bridge, onnx.
+"""
+from . import quantization  # noqa: F401

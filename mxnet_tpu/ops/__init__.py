@@ -18,3 +18,4 @@ from . import init_ops      # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib       # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,175 @@
+"""INT8 quantization operators.
+
+Reference: ``src/operator/quantization/`` — quantize/quantize_v2,
+dequantize, requantize, quantized_conv, quantized_fully_connected,
+quantized_pooling, quantized_flatten (quantize-inl.h, requantize-inl.h,
+quantized_conv.cu, quantized_fully_connected.cc).
+
+TPU-native design: int8 is an MXU-native input type, so the quantized
+conv/FC lower to ``lax.dot_general``/``conv_general_dilated`` with int8
+operands and ``preferred_element_type=int32`` — the same systolic-array
+path XLA uses for int8 inference.  Scales ride alongside as (1,) float
+arrays exactly like the reference's min/max tensor convention:
+every quantized tensor travels as (int_data, min_range, max_range).
+
+Symmetric signed quantization (the reference's int8 path):
+  r      = max(|min|, |max|)            # threshold
+  q      = clip(round(x * 127 / r))     # int8
+  x_hat  = q * r / 127
+An int32 accumulator value v represents v * (r_d * r_w) / (127 * 127);
+its (min, max) carries that scale through the dequantize/requantize
+contract (reference quantization_utils.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, normalize_tuple
+
+INT8_MAX = 127.0
+INT32_MAX = float(2 ** 31 - 1)
+
+
+def _range_of(min_r, max_r):
+    return jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)).reshape(())
+
+
+@register("_contrib_quantize", num_outputs=3)
+def _quantize(data, min_range, max_range, out_type="int8", **attrs):
+    """Quantize float data given min/max range tensors (reference:
+    quantize-inl.h QuantizeCompute)."""
+    if out_type != "int8":
+        raise NotImplementedError("TPU quantization is int8 (symmetric)")
+    r = _range_of(min_range, max_range)
+    r = jnp.where(r > 0, r, 1.0)
+    q = jnp.clip(jnp.round(data / r * INT8_MAX), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), -r.reshape(1), r.reshape(1)
+
+
+@register("_contrib_quantize_v2", num_outputs=3)
+def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                 out_type="int8", **attrs):
+    """Quantize with calibrated thresholds, or online min/max when no
+    calibration is given (reference: quantize_v2-inl.h)."""
+    if out_type != "int8":
+        raise NotImplementedError("TPU quantization is int8 (symmetric)")
+    if min_calib_range is not None and max_calib_range is not None:
+        r = jnp.maximum(abs(float(min_calib_range)),
+                        abs(float(max_calib_range)))
+        r = jnp.asarray(r, jnp.float32)
+    else:
+        r = jnp.maximum(jnp.max(jnp.abs(data)), 1e-30)
+    q = jnp.clip(jnp.round(data / r * INT8_MAX), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), (-r).reshape(1), r.reshape(1)
+
+
+@register("_contrib_dequantize")
+def _dequantize(data, min_range, max_range, out_type="float32", **attrs):
+    """int8/int32 -> float (reference: dequantize-inl.h)."""
+    r = _range_of(min_range, max_range)
+    denom = INT8_MAX if data.dtype == jnp.int8 else INT32_MAX
+    return data.astype(jnp.float32) * (r / denom)
+
+
+@register("_contrib_requantize", num_outputs=3)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None, **attrs):
+    """int32 -> int8, optionally against calibrated thresholds
+    (reference: requantize-inl.h)."""
+    r_in = _range_of(min_range, max_range)
+    x = data.astype(jnp.float32) * (r_in / INT32_MAX)
+    if min_calib_range is not None and max_calib_range is not None:
+        r_out = jnp.asarray(max(abs(float(min_calib_range)),
+                                abs(float(max_calib_range))), jnp.float32)
+    else:
+        r_out = jnp.maximum(jnp.max(jnp.abs(x)), 1e-30)
+    q = jnp.clip(jnp.round(x / r_out * INT8_MAX), -INT8_MAX, INT8_MAX)
+    return q.astype(jnp.int8), (-r_out).reshape(1), r_out.reshape(1)
+
+
+def _int32_minmax(dmin, dmax, wmin, wmax):
+    """(min,max) of an int8xint8->int32 accumulator, following the
+    reference's scale-propagation contract: int32 value v stands for
+    v * r_d * r_w / 127^2, so the advertised range is INT32_MAX at that
+    scale (quantization_utils.h Quantization{Range}ForS8S8Multiplication).
+    """
+    r = (_range_of(dmin, dmax) * _range_of(wmin, wmax)
+         / (INT8_MAX * INT8_MAX) * INT32_MAX)
+    return (-r).reshape(1), r.reshape(1)
+
+
+@register("_contrib_quantized_fully_connected", num_outputs=3)
+def _quantized_fc(data, weight, data_min, data_max, weight_min, weight_max,
+                  num_hidden=0, flatten=True, no_bias=True, **attrs):
+    """int8 FC on the MXU (reference: quantized_fully_connected.cc).
+
+    Bias is intentionally NOT part of the int8 op — the graph pass adds
+    it in float after dequantize, which is strictly more accurate than
+    the reference's int8 bias requantization."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    out = lax.dot_general(x.astype(jnp.int8), weight.astype(jnp.int8),
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    omin, omax = _int32_minmax(data_min, data_max, weight_min, weight_max)
+    return out, omin, omax
+
+
+@register("_contrib_quantized_conv", num_outputs=3)
+def _quantized_conv(data, weight, data_min, data_max, weight_min, weight_max,
+                    kernel=(1, 1), stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                    num_filter=1, num_group=1, no_bias=True, layout="NCHW",
+                    **attrs):
+    """int8 convolution (reference: quantized_conv.cu) via XLA's integer
+    conv path, fp32 never materialized."""
+    stride = normalize_tuple(stride, 2)
+    pad = normalize_tuple(pad, 2)
+    dilate = normalize_tuple(dilate, 2)
+    out = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    omin, omax = _int32_minmax(data_min, data_max, weight_min, weight_max)
+    return out, omin, omax
+
+
+@register("_contrib_quantized_pooling", num_outputs=3)
+def _quantized_pooling(data, data_min, data_max, kernel=(2, 2),
+                       stride=None, pad=(0, 0), pool_type="max",
+                       global_pool=False, **attrs):
+    """Pooling on int8 values; max-pool commutes with quantization so the
+    range passes through unchanged (reference: quantized_pooling.cc)."""
+    kernel = normalize_tuple(kernel, 2)
+    stride = normalize_tuple(stride if stride is not None else kernel, 2)
+    pad = normalize_tuple(pad, 2)
+    if global_pool:
+        kernel = data.shape[-2:]
+        stride = (1, 1)
+        pad = (0, 0)
+    if pool_type == "max":
+        init, op = jnp.iinfo(jnp.int8).min, lax.max
+        out = lax.reduce_window(
+            data, jnp.asarray(init, data.dtype), op,
+            (1, 1) + kernel, (1, 1) + stride,
+            [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+    elif pool_type == "avg":
+        s = lax.reduce_window(
+            data.astype(jnp.int32), jnp.asarray(0, jnp.int32), lax.add,
+            (1, 1) + kernel, (1, 1) + stride,
+            [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+        out = (s / (kernel[0] * kernel[1])).round().astype(data.dtype)
+    else:
+        raise NotImplementedError("quantized pooling: %s" % pool_type)
+    return out, data_min, data_max
+
+
+@register("_contrib_quantized_flatten", num_outputs=3)
+def _quantized_flatten(data, data_min, data_max, **attrs):
+    return data.reshape(data.shape[0], -1), data_min, data_max

@@ -718,6 +718,25 @@ def _svm_label_shapes(attrs, known):
     return {"label": (known["data"][0],)}
 
 
+def _quantized_fc_shapes(attrs, known):
+    d = known["data"]
+    nh = attrs["num_hidden"]
+    flat = attrs.get("flatten", True)
+    in_dim = int(np.prod(d[1:])) if flat else d[-1]
+    return {"weight": (nh, in_dim), "weight_min": (1,), "weight_max": (1,)}
+
+
+def _quantized_conv_shapes(attrs, known):
+    d = known["data"]
+    k = attrs["kernel"]
+    if isinstance(k, int):
+        k = (k,)
+    nf = attrs["num_filter"]
+    ng = attrs.get("num_group", 1)
+    return {"weight": (nf, d[1] // ng) + tuple(k),
+            "weight_min": (1,), "weight_max": (1,)}
+
+
 _PARAM_SHAPE_HOOKS = {
     "SoftmaxOutput": _softmax_output_shapes,
     "LinearRegressionOutput": _regression_label_shapes,
@@ -735,6 +754,8 @@ _PARAM_SHAPE_HOOKS = {
     "Embedding": _embed_shapes,
     "LeakyReLU": _prelu_shapes,
     "RNN": _rnn_param_size,
+    "_contrib_quantized_fully_connected": _quantized_fc_shapes,
+    "_contrib_quantized_conv": _quantized_conv_shapes,
 }
 
 

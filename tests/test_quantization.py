@@ -1,0 +1,146 @@
+"""INT8 quantization: ops + quantize_model graph pass + calibration.
+
+Reference analogues: tests/python/quantization/test_quantization.py
+(quantize/dequantize/requantize ops, quantized conv/FC, quantize_model).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32) * 3
+    q, mn, mxr = nd.contrib.quantize_v2(nd.array(x))
+    assert q.dtype == np.int8
+    back = nd.contrib.dequantize(q, mn, mxr).asnumpy()
+    # quantization step = range/127
+    step = np.abs(x).max() / 127.0
+    assert np.abs(back - x).max() <= step * 0.51
+
+
+def test_quantize_with_calib_range():
+    x = nd.array(np.array([[0.5, -2.0, 10.0]], np.float32))
+    q, mn, mxr = nd.contrib.quantize_v2(x, min_calib_range=-2.0,
+                                        max_calib_range=2.0)
+    # 10.0 saturates at 127 under the calibrated range
+    assert q.asnumpy()[0, 2] == 127
+    assert float(mxr.asnumpy()[0]) == pytest.approx(2.0)
+
+
+def test_requantize():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 5).astype(np.float32)
+    q, mn, mxr = nd.contrib.quantize_v2(nd.array(x))
+    # promote to a fake int32 accumulator at the int32 scale
+    r = float(mxr.asnumpy()[0])
+    acc = nd.array((q.asnumpy().astype(np.int64) *
+                    int((2 ** 31 - 1) / 127)).astype(np.int32), dtype=np.int32)
+    q8, mn8, mx8 = nd.contrib.requantize(acc, mn, mxr)
+    back = nd.contrib.dequantize(q8, mn8, mx8).asnumpy()
+    assert np.abs(back - x).max() <= r / 127 * 1.2
+
+
+def test_quantized_fc_matches_float():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 16).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32)
+    qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w))
+    out, omin, omax = nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax, num_hidden=8)
+    assert out.dtype == np.int32
+    deq = nd.contrib.dequantize(out, omin, omax).asnumpy()
+    ref = x @ w.T
+    rel = np.abs(deq - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
+def test_quantized_conv_matches_float():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(5, 3, 3, 3).astype(np.float32)
+    qx, xmin, xmax = nd.contrib.quantize_v2(nd.array(x))
+    qw, wmin, wmax = nd.contrib.quantize_v2(nd.array(w))
+    out, omin, omax = nd.contrib.quantized_conv(
+        qx, qw, xmin, xmax, wmin, wmax, kernel=(3, 3), num_filter=5,
+        pad=(1, 1))
+    deq = nd.contrib.dequantize(out, omin, omax).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=5, pad=(1, 1), no_bias=True).asnumpy()
+    rel = np.abs(deq - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.03, rel
+
+
+def _small_convnet():
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                         name="conv1")
+    a1 = sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                     name="pool1")
+    f = sym.Flatten(p1, name="flat")
+    fc = sym.FullyConnected(f, num_hidden=10, name="fc1")
+    return fc
+
+
+def _init_params(net, shapes):
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    rng = np.random.RandomState(4)
+    params = {}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        params[name] = nd.array(rng.randn(*shp).astype(np.float32) * 0.2)
+    return params
+
+
+@pytest.mark.parametrize("calib_mode", ["none", "naive", "entropy"])
+def test_quantize_model_end_to_end(calib_mode):
+    net = _small_convnet()
+    shapes = {"data": (4, 3, 8, 8)}
+    params = _init_params(net, shapes)
+    rng = np.random.RandomState(5)
+    x = rng.rand(4, 3, 8, 8).astype(np.float32)
+
+    calib_data = None
+    if calib_mode != "none":
+        calib_data = mx.io.NDArrayIter(
+            data=rng.rand(16, 3, 8, 8).astype(np.float32),
+            label=np.zeros(16, np.float32), batch_size=4)
+    qsym, qparams, _ = mx.contrib.quantization.quantize_model(
+        net, params, calib_mode=calib_mode, calib_data=calib_data,
+        data_names=("data",))
+
+    # quantized weights really are int8
+    assert qparams["conv1_weight_quantize"].dtype == np.int8
+    assert qparams["fc1_weight_quantize"].dtype == np.int8
+    assert "conv1_weight_min" in qparams and "fc1_weight_max" in qparams
+
+    # fp32 reference
+    exe = net.simple_bind(data=shapes["data"], grad_req="null")
+    for k, v in params.items():
+        exe.arg_dict[k]._data = v._data
+    ref = exe.forward(is_train=False, data=x)[0].asnumpy()
+
+    qexe = qsym.simple_bind(data=shapes["data"], grad_req="null")
+    for k, v in qparams.items():
+        if k in qexe.arg_dict:
+            qexe.arg_dict[k]._data = v._data
+    out = qexe.forward(is_train=False, data=x)[0].asnumpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.06, "calib=%s rel err %f" % (calib_mode, rel)
+
+
+def test_quantize_model_excluded_layers():
+    net = _small_convnet()
+    shapes = {"data": (2, 3, 8, 8)}
+    params = _init_params(net, shapes)
+    qsym, qparams, _ = mx.contrib.quantization.quantize_model(
+        net, params, excluded_sym_names=["fc1"])
+    args = qsym.list_arguments()
+    assert "conv1_weight_quantize" in args
+    assert "fc1_weight_quantize" not in args   # excluded stays fp32
+    assert "fc1_weight" in args
