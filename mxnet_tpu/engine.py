@@ -1,21 +1,69 @@
-"""Engine control API.
+"""Engine control API + deferred exception propagation.
 
 Reference: ``python/mxnet/engine.py`` — bulk(size) scope that batches
-engine pushes (MXEngineSetBulkSize).
+engine pushes (MXEngineSetBulkSize) — and the threaded engine's async
+exception model: each Var/Opr carries a ``std::exception_ptr`` set on a
+worker thread and rethrown at the next sync point
+(src/engine/threaded_engine.h:179,256, threaded_engine.cc:463-467;
+tested by tests/python/unittest/test_exc_handling.py).
 
 TPU-native: the dependency engine is XLA's async dispatch; "bulking" —
 the reference's trick of fusing many small ops into one engine job
 (graph_executor.cc:1336 op segments) — corresponds to jit boundaries
 here.  The bulk scope is kept for API parity and records the requested
 size so instrumented callers can observe it; actual fusion is already
-maximal (whole-graph jit)."""
+maximal (whole-graph jit).
+
+Deferred exceptions: work that runs off the main thread (prefetching
+data iterators, custom-op callbacks, any caller of
+``record_exception``) stores its error here, and EVERY sync point —
+``nd.waitall()``, ``NDArray.wait_to_read()``, ``.asnumpy()`` — rethrows
+it, exactly like the reference's exception_ptr hand-off."""
 from __future__ import annotations
 
 import contextlib
+import threading
 
-__all__ = ["bulk", "set_bulk_size"]
+__all__ = ["bulk", "set_bulk_size", "record_exception", "check_raise",
+           "clear_exception"]
 
 _BULK_SIZE = [0]
+
+_EXC_LOCK = threading.Lock()
+_DEFERRED_EXC = []   # first recorded exception wins, like exception_ptr
+
+
+def record_exception(exc):
+    """Store an exception raised on a worker thread; it rethrows at the
+    next sync point (reference: ThreadedEngine::OnCompleteStatic
+    capturing into opr->exception_ptr)."""
+    with _EXC_LOCK:
+        if not _DEFERRED_EXC:
+            _DEFERRED_EXC.append(exc)
+
+
+def check_raise():
+    """Rethrow a deferred worker exception, clearing it (reference:
+    rethrow at WaitForVar/WaitForAll, threaded_engine.cc:463-467)."""
+    if _DEFERRED_EXC:                       # cheap unlocked fast path
+        with _EXC_LOCK:
+            if _DEFERRED_EXC:
+                exc = _DEFERRED_EXC.pop()
+                raise exc
+
+
+def clear_exception():
+    with _EXC_LOCK:
+        _DEFERRED_EXC.clear()
+
+
+def consume_exception(exc):
+    """Drop a specific recorded exception — used when a caller delivers
+    it directly (e.g. a data iterator rethrowing in next()) so sync
+    points don't raise it a second time."""
+    with _EXC_LOCK:
+        if _DEFERRED_EXC and _DEFERRED_EXC[0] is exc:
+            _DEFERRED_EXC.clear()
 
 
 def set_bulk_size(size):

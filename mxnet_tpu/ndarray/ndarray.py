@@ -108,13 +108,18 @@ class NDArray:
 
     # -- sync / host transfer ----------------------------------------------
     def wait_to_read(self):
-        """Reference: NDArray::WaitToRead (include/mxnet/ndarray.h:305)."""
+        """Reference: NDArray::WaitToRead (include/mxnet/ndarray.h:305);
+        sync points rethrow deferred worker exceptions."""
+        from .. import engine
+        engine.check_raise()
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
 
     def asnumpy(self):
         """Blocking copy to host (reference: ndarray.py asnumpy)."""
+        from .. import engine
+        engine.check_raise()
         return np.asarray(self._data)
 
     def asscalar(self):
@@ -586,11 +591,15 @@ def imdecode(buf, **kwargs):  # pragma: no cover - needs cv2
 
 
 def waitall():
-    """Reference: MXNDArrayWaitAll / Engine::WaitForAll."""
-    try:
-        (jax.effects_barrier if hasattr(jax, "effects_barrier") else lambda: None)()
-    except Exception:
-        pass
+    """Reference: MXNDArrayWaitAll / Engine::WaitForAll.
+
+    Rethrows exceptions recorded by worker threads (prefetchers, custom
+    ops) — the reference's async-exception contract
+    (threaded_engine.cc:463-467, test_exc_handling.py)."""
+    from .. import engine
+    (jax.effects_barrier if hasattr(jax, "effects_barrier")
+     else lambda: None)()
+    engine.check_raise()
 
 
 # ---------------------------------------------------------------------------

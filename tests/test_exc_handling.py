@@ -1,0 +1,89 @@
+"""Async exception propagation at sync points.
+
+Reference analogue: tests/python/unittest/test_exc_handling.py over the
+engine's exception_ptr hand-off (threaded_engine.cc:463-467): an error
+raised on a worker thread must surface at the next sync point
+(waitall / wait_to_read / asnumpy), not vanish.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_slot():
+    engine.clear_exception()
+    yield
+    engine.clear_exception()
+
+
+def test_waitall_rethrows_worker_exception():
+    err = mx.MXNetError("boom from worker")
+    engine.record_exception(err)
+    with pytest.raises(mx.MXNetError, match="boom from worker"):
+        nd.waitall()
+    nd.waitall()  # cleared after the rethrow, like exception_ptr
+
+
+def test_wait_to_read_and_asnumpy_rethrow():
+    x = nd.ones((2, 2))
+    engine.record_exception(RuntimeError("deferred"))
+    with pytest.raises(RuntimeError, match="deferred"):
+        x.wait_to_read()
+    y = nd.ones((2,))
+    engine.record_exception(RuntimeError("deferred2"))
+    with pytest.raises(RuntimeError, match="deferred2"):
+        y.asnumpy()
+
+
+def test_first_exception_wins():
+    engine.record_exception(ValueError("first"))
+    engine.record_exception(ValueError("second"))
+    with pytest.raises(ValueError, match="first"):
+        engine.check_raise()
+
+
+def test_prefetching_iter_propagates_worker_error():
+    class BadIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=2)
+            self.n = 0
+            self.provide_data = [mx.io.DataDesc("data", (2, 3))]
+            self.provide_label = [mx.io.DataDesc("softmax_label", (2,))]
+
+        def reset(self):
+            self.n = 0
+
+        def next(self):
+            self.n += 1
+            if self.n > 1:
+                raise mx.MXNetError("decode failed on worker")
+            return mx.io.DataBatch(data=[nd.zeros((2, 3))],
+                                   label=[nd.zeros((2,))], pad=0)
+
+    it = mx.io.PrefetchingIter(BadIter())
+    batches = 0
+    with pytest.raises(mx.MXNetError, match="decode failed on worker"):
+        for _ in it:
+            batches += 1
+    assert batches == 1
+
+
+def test_image_record_iter_error_reaches_waitall(tmp_path):
+    """A corrupt record fails on the producer thread; the error surfaces
+    both at next() and (if next isn't called) at waitall()."""
+    pytest.importorskip("PIL")
+    from mxnet_tpu import recordio
+    fname = str(tmp_path / "bad.rec")
+    rec = recordio.MXRecordIO(fname, "w")
+    rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
+                            b"not an image"))
+    rec.close()
+    it = mx.io.ImageRecordIter(path_imgrec=fname, data_shape=(3, 8, 8),
+                               batch_size=1, preprocess_threads=1)
+    with pytest.raises(Exception):
+        it.next()
+    nd.waitall()  # consumed by next(); no double delivery
+    it.close()
