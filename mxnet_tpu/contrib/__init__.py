@@ -1,4 +1,11 @@
 """Contrib namespace (reference: python/mxnet/contrib/) — experimental
-subsystems: quantization, text embeddings, tensorboard bridge, onnx.
+subsystems: quantization, text embeddings, tensorboard bridge, onnx
+importer, contrib op namespaces, DataLoaderIter.
 """
 from . import quantization  # noqa: F401
+from . import text  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import onnx  # noqa: F401
+from . import io  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401

@@ -1,0 +1,50 @@
+"""Contrib IO namespace (reference: python/mxnet/contrib/io.py —
+DataLoaderIter wrapping a gluon DataLoader as a DataIter)."""
+from __future__ import annotations
+
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Present a gluon DataLoader as a classic DataIter (reference:
+    contrib/io.py DataLoaderIter)."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        self._data_name = data_name
+        self._label_name = label_name
+        first = next(self._iter)
+        self._first = first
+        data, label = first if isinstance(first, (list, tuple)) else (first,
+                                                                      None)
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape, data.dtype)]
+        self.provide_label = ([DataDesc(label_name, label.shape, label.dtype)]
+                              if label is not None else [])
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._first = None
+
+    def next(self):
+        if self._first is not None:
+            item, self._first = self._first, None
+        else:
+            item = next(self._iter)   # StopIteration ends the epoch
+        data, label = item if isinstance(item, (list, tuple)) else (item,
+                                                                    None)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else None, pad=0)
+
+    def iter_next(self):
+        if self._first is not None:
+            return True
+        try:
+            self._first = next(self._iter)
+            return True
+        except StopIteration:
+            return False

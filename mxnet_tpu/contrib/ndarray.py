@@ -1,0 +1,7 @@
+"""Contrib ndarray op namespace (reference:
+python/mxnet/contrib/ndarray.py) — re-exports nd.contrib so
+``mx.contrib.ndarray.MultiBoxPrior`` style calls work."""
+from ..ndarray import contrib as _src
+
+globals().update({k: v for k, v in vars(_src).items()
+                  if not k.startswith("_")})

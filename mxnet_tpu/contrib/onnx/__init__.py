@@ -1,0 +1,2 @@
+"""ONNX model importer (reference: python/mxnet/contrib/onnx/_import)."""
+from .import_onnx import import_model, GraphIR, NodeIR  # noqa: F401

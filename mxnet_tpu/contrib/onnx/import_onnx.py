@@ -1,0 +1,208 @@
+"""Import ONNX graphs into native Symbols.
+
+Reference: ``python/mxnet/contrib/onnx/_import`` (GraphProto walker +
+per-op translation table ``_convert_map``).
+
+Structure: ``import_model(path)`` parses the protobuf with the optional
+``onnx`` package into a tiny neutral IR (GraphIR/NodeIR), and
+``import_graph_ir`` translates that IR into (sym, arg_params,
+aux_params).  The IR layer keeps the translation fully testable without
+the onnx dependency, which this build does not ship.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ... import symbol as sym_mod
+from ...base import MXNetError
+
+__all__ = ["import_model", "import_graph_ir", "GraphIR", "NodeIR"]
+
+
+@dataclasses.dataclass
+class NodeIR:
+    op_type: str
+    inputs: List[str]
+    outputs: List[str]
+    attrs: Dict[str, Any]
+
+
+@dataclasses.dataclass
+class GraphIR:
+    inputs: List[str]                  # graph input tensor names
+    outputs: List[str]                 # graph output tensor names
+    nodes: List[NodeIR]
+    initializers: Dict[str, np.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# per-op translation (reference: _import/op_translations.py)
+# ---------------------------------------------------------------------------
+def _pair(v):
+    v = list(v)
+    return tuple(v if len(v) > 1 else v * 2)
+
+
+def _conv(ins, attrs):
+    kernel = _pair(attrs.get("kernel_shape", (1, 1)))
+    strides = _pair(attrs.get("strides", (1, 1)))
+    dil = _pair(attrs.get("dilations", (1, 1)))
+    pads = list(attrs.get("pads", (0, 0, 0, 0)))
+    pad = (pads[0], pads[1]) if len(pads) >= 2 else (0, 0)
+    group = int(attrs.get("group", 1))
+    num_filter = attrs["__num_filter__"]
+    return sym_mod.Convolution(
+        *ins, kernel=kernel, stride=strides, dilate=dil, pad=pad,
+        num_group=group, num_filter=num_filter, no_bias=len(ins) == 2)
+
+
+def _gemm(ins, attrs):
+    if attrs.get("transB", 0) != 1:
+        raise MXNetError("Gemm import requires transB=1 (weight as (out,in))")
+    num_hidden = attrs["__num_hidden__"]
+    return sym_mod.FullyConnected(*ins, num_hidden=num_hidden,
+                                  no_bias=len(ins) == 2, flatten=True)
+
+
+def _pool(kind):
+    def conv(ins, attrs):
+        kernel = _pair(attrs.get("kernel_shape", (2, 2)))
+        strides = _pair(attrs.get("strides", kernel))
+        pads = list(attrs.get("pads", (0, 0, 0, 0)))
+        pad = (pads[0], pads[1]) if len(pads) >= 2 else (0, 0)
+        return sym_mod.Pooling(ins[0], kernel=kernel, stride=strides,
+                               pad=pad, pool_type=kind)
+    return conv
+
+
+def _global_pool(kind):
+    def conv(ins, attrs):
+        return sym_mod.Pooling(ins[0], kernel=(1, 1), global_pool=True,
+                               pool_type=kind)
+    return conv
+
+
+def _batchnorm(ins, attrs):
+    eps = attrs.get("epsilon", 1e-5)
+    mom = attrs.get("momentum", 0.9)
+    return sym_mod.BatchNorm(*ins, eps=eps, momentum=mom, fix_gamma=False)
+
+
+def _reshape(ins, attrs):
+    shape = attrs.get("shape")
+    if shape is None:
+        raise MXNetError("Reshape import needs a static shape attribute "
+                         "(opset<5 style); dynamic shape inputs are not "
+                         "supported")
+    return sym_mod.Reshape(ins[0], shape=tuple(int(s) for s in shape))
+
+
+_CONVERT_MAP = {
+    "Conv": _conv,
+    "Gemm": _gemm,
+    "MatMul": lambda ins, attrs: sym_mod.dot(*ins),
+    "Relu": lambda ins, attrs: sym_mod.Activation(ins[0], act_type="relu"),
+    "Sigmoid": lambda ins, attrs: sym_mod.Activation(ins[0],
+                                                     act_type="sigmoid"),
+    "Tanh": lambda ins, attrs: sym_mod.Activation(ins[0], act_type="tanh"),
+    "Add": lambda ins, attrs: sym_mod.broadcast_add(*ins),
+    "Sub": lambda ins, attrs: sym_mod.broadcast_sub(*ins),
+    "Mul": lambda ins, attrs: sym_mod.broadcast_mul(*ins),
+    "Div": lambda ins, attrs: sym_mod.broadcast_div(*ins),
+    "Sum": lambda ins, attrs: sym_mod.add_n(*ins),
+    "MaxPool": _pool("max"),
+    "AveragePool": _pool("avg"),
+    "GlobalMaxPool": _global_pool("max"),
+    "GlobalAveragePool": _global_pool("avg"),
+    "BatchNormalization": _batchnorm,
+    "Flatten": lambda ins, attrs: sym_mod.Flatten(ins[0]),
+    "Reshape": _reshape,
+    "Concat": lambda ins, attrs: sym_mod.concat(
+        *ins, dim=int(attrs.get("axis", 1))),
+    "Softmax": lambda ins, attrs: sym_mod.softmax(
+        ins[0], axis=int(attrs.get("axis", 1))),
+    "Dropout": lambda ins, attrs: sym_mod.Dropout(
+        ins[0], p=float(attrs.get("ratio", 0.5))),
+    "Identity": lambda ins, attrs: ins[0],
+    "Transpose": lambda ins, attrs: sym_mod.transpose(
+        ins[0], axes=tuple(attrs.get("perm", ()))),
+}
+
+
+def import_graph_ir(graph):
+    """GraphIR -> (sym, arg_params, aux_params)."""
+    tensors = {}
+    arg_params = {}
+    aux_params = {}
+    init_names = set(graph.initializers)
+    for name in graph.inputs:
+        if name not in init_names:
+            tensors[name] = sym_mod.Variable(name)
+
+    def param_sym(name):
+        if name not in tensors:
+            tensors[name] = sym_mod.Variable(name)
+        return tensors[name]
+
+    from ... import nd
+    for node in graph.nodes:
+        if node.op_type not in _CONVERT_MAP:
+            raise MXNetError("ONNX op %r is not supported by the importer"
+                             % node.op_type)
+        attrs = dict(node.attrs)
+        # shape-bearing hints the translators need, taken from weights
+        if node.op_type == "Conv" and len(node.inputs) >= 2:
+            attrs["__num_filter__"] = int(
+                graph.initializers[node.inputs[1]].shape[0])
+        if node.op_type == "Gemm" and len(node.inputs) >= 2:
+            attrs["__num_hidden__"] = int(
+                graph.initializers[node.inputs[1]].shape[0])
+        ins = [tensors[i] if i in tensors else param_sym(i)
+               for i in node.inputs if i]
+        out = _CONVERT_MAP[node.op_type](ins, attrs)
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        for name, o in zip(node.outputs, outs):
+            tensors[name] = o
+        if node.op_type == "BatchNormalization":
+            # running stats are aux, not args (reference convention)
+            for aux_name in node.inputs[3:5]:
+                aux_params[aux_name] = nd.array(
+                    graph.initializers[aux_name])
+    for name, arr in graph.initializers.items():
+        if name not in aux_params:
+            arg_params[name] = nd.array(np.asarray(arr))
+    outputs = [tensors[o] for o in graph.outputs]
+    out_sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+    return out_sym, arg_params, aux_params
+
+
+def _onnx_to_ir(model):
+    """onnx ModelProto -> GraphIR (requires the onnx package)."""
+    from onnx import numpy_helper, helper
+    g = model.graph
+    inits = {t.name: numpy_helper.to_array(t) for t in g.initializer}
+    nodes = []
+    for n in g.node:
+        attrs = {a.name: helper.get_attribute_value(a) for a in n.attribute}
+        nodes.append(NodeIR(n.op_type, list(n.input), list(n.output),
+                            attrs))
+    return GraphIR([i.name for i in g.input], [o.name for o in g.output],
+                   nodes, inits)
+
+
+def import_model(model_file):
+    """Load an .onnx file (reference: contrib/onnx import_model).
+
+    Returns (sym, arg_params, aux_params)."""
+    try:
+        import onnx
+    except ImportError:
+        raise MXNetError(
+            "import_model requires the `onnx` package, which this build "
+            "does not ship; the translation itself (import_graph_ir) has "
+            "no such dependency")
+    model = onnx.load(model_file)
+    return import_graph_ir(_onnx_to_ir(model))

@@ -1,0 +1,6 @@
+"""Contrib symbol op namespace (reference:
+python/mxnet/contrib/symbol.py) — re-exports sym.contrib."""
+from ..symbol import contrib as _src
+
+globals().update({k: v for k, v in vars(_src).items()
+                  if not k.startswith("_")})

@@ -1,0 +1,158 @@
+"""TensorBoard bridge.
+
+Reference: ``python/mxnet/contrib/tensorboard.py`` — LogMetricsCallback
+writing scalar summaries per batch/epoch.  The reference depends on the
+external ``tensorboard`` package; this build has no such dependency, so
+the event-file writer is implemented natively: TensorBoard event files
+are TFRecord streams of serialized ``Event`` protobufs, and both the
+TFRecord framing (length + masked CRC32C) and the tiny Event/Summary
+message subset are hand-encoded here.  Files written this way load in
+stock TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+# ---------------------------------------------------------------------------
+# CRC32C (Castagnoli), table-driven — required by the TFRecord framing
+# ---------------------------------------------------------------------------
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire encoding for Event{wall_time, step, summary|file_version}
+# field numbers per tensorflow/core/util/event.proto + summary.proto
+# ---------------------------------------------------------------------------
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _pb_double(field, value):
+    return _tag(field, 1) + struct.pack("<d", value)
+
+
+def _pb_float(field, value):
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def _pb_int64(field, value):
+    return _tag(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _pb_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf8")
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _summary_value(tag, simple_value):
+    # Summary.Value: tag = field 1, simple_value = field 2
+    return _pb_bytes(1, tag) + _pb_float(2, simple_value)
+
+
+def _event(wall_time, step, *, file_version=None, scalars=None):
+    # Event: wall_time=1(double), step=2(int64), file_version=3(string),
+    # summary=5(message); Summary: value=1(repeated message)
+    out = _pb_double(1, wall_time) + _pb_int64(2, step)
+    if file_version is not None:
+        out += _pb_bytes(3, file_version)
+    if scalars:
+        summary = b"".join(_pb_bytes(1, _summary_value(t, v))
+                           for t, v in scalars)
+        out += _pb_bytes(5, summary)
+    return out
+
+
+class SummaryWriter:
+    """Write TensorBoard event files (native TFRecord encoder)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = "events.out.tfevents.%010d.%s" % (time.time(),
+                                                  socket.gethostname())
+        self._path = os.path.join(logdir, fname)
+        self._f = open(self._path, "wb")
+        self._write_record(_event(time.time(), 0, file_version="brain.Event:2"))
+
+    def _write_record(self, data):
+        header = struct.pack("<Q", len(data))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(data)
+        self._f.write(struct.pack("<I", _masked_crc(data)))
+        self._f.flush()
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_record(_event(time.time(), int(global_step),
+                                  scalars=[(tag, float(value))]))
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class LogMetricsCallback:
+    """Log metrics to TensorBoard (reference: contrib/tensorboard.py:25
+    — same callback contract as callback.Speedometer)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        step = getattr(param, "epoch", None)
+        step = self.step if step is None else step
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, global_step=step)

@@ -1,0 +1,241 @@
+"""Pretrained token embeddings.
+
+Reference: ``python/mxnet/contrib/text/embedding.py`` — _TokenEmbedding
+base (load a `token<delim>vec` text file into an idx_to_vec matrix over
+a Vocabulary), GloVe / FastText named sources, CustomEmbedding,
+CompositeEmbedding, and a registry.
+
+TPU-note: this build has no network egress, so the named sources load
+from a local ``embedding_root`` directory instead of downloading;
+everything else (indexing, lookup, update) matches the reference
+contract.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...base import MXNetError
+from ...initializer import Initializer  # noqa: F401  (API parity for init args)
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a new embedding source class (reference:
+    embedding.py:39)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create by registered name, e.g. create('glove', ...) (reference:
+    embedding.py:62)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError("Cannot find embedding %r; registered: %s"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names per source (reference:
+    embedding.py:89)."""
+    if embedding_name is not None:
+        return list(_REGISTRY[embedding_name.lower()]
+                    .pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _REGISTRY.items()}
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Base token embedding: a Vocabulary plus an idx_to_vec matrix
+    (reference: embedding.py:132 _TokenEmbedding)."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading -----------------------------------------------------------
+    def _load_embedding(self, path, elem_delim=" ",
+                        init_unknown_vec=np.zeros, encoding="utf8"):
+        if not os.path.isfile(path):
+            raise MXNetError(
+                "pretrained embedding file %s not found (this build has no "
+                "network egress; place the file there manually)" % path)
+        tokens, vecs = [], []
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header or malformed line
+                token, elems = parts[0], parts[1:]
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                if len(elems) != self._vec_len:
+                    logging.warning("line %d: dim %d != %d, skipped",
+                                    lineno, len(elems), self._vec_len)
+                    continue
+                if token in self._token_to_idx:
+                    continue  # first occurrence wins, like the reference
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                tokens.append(token)
+                vecs.append(np.asarray(elems, np.float32))
+        mat = np.zeros((len(self), self._vec_len), np.float32)
+        offset = len(self) - len(vecs)
+        if vecs:
+            mat[offset:] = np.stack(vecs)
+        mat[0] = np.asarray(init_unknown_vec(shape=self._vec_len)
+                            if _accepts_shape(init_unknown_vec)
+                            else init_unknown_vec((self._vec_len,)),
+                            np.float32)
+        self._idx_to_vec = nd.array(mat)
+
+    # -- access ------------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Look up embedding vectors (reference: embedding.py:365)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = self.to_indices(toks)
+        vecs = self._idx_to_vec.asnumpy()[np.asarray(idx)]
+        out = nd.array(vecs)
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens (reference:
+        embedding.py:404)."""
+        if self._idx_to_vec is None:
+            raise MXNetError("no embedding matrix loaded")
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        arr = new_vectors.asnumpy().reshape(len(toks), self._vec_len)
+        mat = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        for t, v in zip(toks, arr):
+            if t not in self._token_to_idx:
+                raise MXNetError(
+                    "token %r is unknown; only tokens in the vocabulary "
+                    "can be updated" % t)
+            mat[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(mat)
+
+
+def _accepts_shape(fn):
+    try:
+        import inspect
+        return "shape" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # pragma: no cover
+        return False
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe vectors from a local file (reference: embedding.py:468)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = os.path.join(os.path.expanduser(embedding_root), "glove",
+                            pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            _reindex_for_vocab(self, vocabulary)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText vectors from a local file (reference: embedding.py:558)."""
+
+    pretrained_file_names = (
+        "wiki.simple.vec", "wiki.en.vec", "crawl-300d-2M.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        path = os.path.join(os.path.expanduser(embedding_root), "fasttext",
+                            pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        if vocabulary is not None:
+            _reindex_for_vocab(self, vocabulary)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file `token<delim>e1<delim>e2...`
+    (reference: embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        if vocabulary is not None:
+            _reindex_for_vocab(self, vocabulary)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference:
+    embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, list):
+            token_embeddings = [token_embeddings]
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        mats = []
+        for emb in token_embeddings:
+            mats.append(np.stack([
+                emb.get_vecs_by_tokens(t).asnumpy()
+                for t in self._idx_to_token]))
+        mat = np.concatenate(mats, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = nd.array(mat)
+
+
+def _reindex_for_vocab(emb, vocabulary):
+    """Restrict/reorder the loaded matrix to a user vocabulary
+    (reference: embedding.py _build_embedding_for_vocabulary)."""
+    mat = np.zeros((len(vocabulary), emb._vec_len), np.float32)
+    full = emb._idx_to_vec.asnumpy()
+    for i, tok in enumerate(vocabulary.idx_to_token):
+        j = emb._token_to_idx.get(tok)
+        if j is not None:
+            mat[i] = full[j]
+    emb._unknown_token = vocabulary.unknown_token
+    emb._reserved_tokens = vocabulary.reserved_tokens
+    emb._idx_to_token = list(vocabulary.idx_to_token)
+    emb._token_to_idx = dict(vocabulary.token_to_idx)
+    emb._idx_to_vec = nd.array(mat)

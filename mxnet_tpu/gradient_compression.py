@@ -1,0 +1,83 @@
+"""2-bit gradient compression with error feedback.
+
+Reference: ``src/kvstore/gradient_compression.cc:52`` — each gradient
+element plus its residual is quantized to {-threshold, 0, +threshold}
+encoded in 2 bits (16 values per uint32 word), and the quantization
+error feeds back into the next step's residual, so the compressed
+stream is unbiased over time.
+
+TPU-native: quantize/dequantize are jitted XLA programs; the packed
+uint32 payload is what a bandwidth-limited collective would move (the
+kvstore path compresses, exchanges, and decompresses — numerics match
+the reference's worker-side compression exactly; on ICI the XLA
+collective itself still moves fp32 unless a custom all-reduce is built
+over the packed words).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["GradientCompression"]
+
+
+def _quantize_2bit(grad, residual, threshold):
+    g = grad + residual
+    code = jnp.where(g >= threshold, 1,
+                     jnp.where(g <= -threshold, 2, 0)).astype(jnp.uint32)
+    value = jnp.where(code == 1, threshold,
+                      jnp.where(code == 2, -threshold, 0.0))
+    new_residual = g - value
+    n = code.size
+    pad = (-n) % 16
+    codes = jnp.concatenate([code.ravel(),
+                             jnp.zeros((pad,), jnp.uint32)]).reshape(-1, 16)
+    shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+    packed = jnp.sum(codes << shifts, axis=1, dtype=jnp.uint32)
+    return packed, new_residual
+
+
+def _dequantize_2bit(packed, shape, threshold):
+    shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+    codes = (packed[:, None] >> shifts) & 3
+    n = int(np.prod(shape))
+    codes = codes.ravel()[:n].reshape(shape)
+    return jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0)).astype(
+                         jnp.float32)
+
+
+class GradientCompression:
+    """Per-key 2-bit compressor with residual state (reference:
+    GradientCompression::Quantize/Dequantize, gradient_compression.cc)."""
+
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise ValueError("supported compression type: 2bit, got %r"
+                             % type)
+        self.type = type
+        self.threshold = float(threshold)
+        self._residual = {}
+        self._q = jax.jit(_quantize_2bit, static_argnums=())
+        self._dq = jax.jit(_dequantize_2bit, static_argnums=(1,))
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}
+
+    def compress(self, key, grad):
+        """grad (jax array) -> packed uint32 words; residual updates."""
+        res = self._residual.get(key)
+        if res is None or res.shape != grad.shape:
+            res = jnp.zeros(grad.shape, jnp.float32)
+        packed, new_res = self._q(grad.astype(jnp.float32), res,
+                                  jnp.float32(self.threshold))
+        self._residual[key] = new_res
+        return packed
+
+    def decompress(self, packed, shape):
+        return self._dq(packed, tuple(shape), jnp.float32(self.threshold))
+
+    def compress_decompress(self, key, grad):
+        """The end-to-end transform a worker's gradient undergoes."""
+        return self.decompress(self.compress(key, grad), grad.shape)

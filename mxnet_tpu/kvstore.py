@@ -53,6 +53,7 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._gc = None
 
     # -- data plane ---------------------------------------------------------
     def init(self, key, value):
@@ -71,6 +72,11 @@ class KVStore:
             merged = vlist[0].copy()
             for v in vlist[1:]:
                 merged += v
+        if self._gc is not None:
+            # 2-bit quantization w/ error feedback on the push path
+            # (reference: gradient_compression.cc applied in kvstore_dist
+            # and CommDevice; here on every store type that reduces)
+            merged = NDArray(self._gc.compress_decompress(k, merged._data))
         return merged
 
     def push(self, key, value, priority=0):
@@ -127,9 +133,14 @@ class KVStore:
 
     # -- compression / updater ----------------------------------------------
     def set_gradient_compression(self, compression_params):
-        """Reference: kvstore.py set_gradient_compression (2-bit PS path).
-        On TPU collectives run in bf16/int8 instead; recorded for parity."""
+        """Enable 2-bit gradient compression with error feedback
+        (reference: kvstore.py set_gradient_compression over
+        gradient_compression.cc).  Gradients pushed after this call are
+        quantized to {-threshold, 0, +threshold} with the quantization
+        error fed back into the next push."""
+        from .gradient_compression import GradientCompression
         self._compression_params = dict(compression_params)
+        self._gc = GradientCompression(**self._compression_params)
 
     def set_optimizer(self, optimizer):
         """Run optimizer on the store (update-on-kvstore; reference
@@ -237,6 +248,8 @@ class KVStoreTPU(KVStore):
             merged = vlist[0]._data
             for v in vlist[1:]:
                 merged = merged + v._data
+            if self._gc is not None:
+                merged = self._gc.compress_decompress(k, merged)
             self._pending[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):

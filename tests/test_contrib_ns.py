@@ -1,0 +1,229 @@
+"""contrib namespace: text vocab/embeddings, tensorboard events, onnx
+importer, gradient compression, LibSVMIter, DataLoaderIter.
+
+Reference analogues: tests/python/unittest/test_contrib_text.py,
+dist_sync_kvstore.py's compute_expected_2bit_quantization, and the
+contrib onnx backend tests.
+"""
+import collections
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+# ---------------------------------------------------------------------------
+# text
+# ---------------------------------------------------------------------------
+def test_vocabulary():
+    from mxnet_tpu.contrib import text
+    counter = text.utils.count_tokens_from_str("a b b c c c\nd d d d")
+    vocab = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                            unknown_token="<unk>", reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then by freq: d(4), c(3), b(2); a dropped (freq 1)
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "nope", "b"]) == [2, 0, 4]
+    assert vocab.to_tokens([3, 0]) == ["c", "<unk>"]
+    assert len(vocab) == 5
+
+
+def test_custom_embedding_and_lookup(tmp_path):
+    from mxnet_tpu.contrib.text import embedding
+    path = tmp_path / "emb.txt"
+    path.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = embedding.CustomEmbedding(str(path))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens(["hello", "unknown", "world"]).asnumpy()
+    assert np.allclose(v[0], [1, 2, 3])
+    assert np.allclose(v[1], 0.0)
+    assert np.allclose(v[2], [4, 5, 6])
+    emb.update_token_vectors("hello", nd.array([[9.0, 9.0, 9.0]]))
+    assert np.allclose(emb.get_vecs_by_tokens("hello").asnumpy(), 9.0)
+
+
+def test_embedding_with_vocabulary(tmp_path):
+    from mxnet_tpu.contrib import text
+    path = tmp_path / "emb.txt"
+    path.write_text("b 1.0 1.0\nc 2.0 2.0\nzzz 3.0 3.0\n")
+    counter = collections.Counter(["b", "b", "c"])
+    vocab = text.Vocabulary(counter)
+    emb = text.embedding.CustomEmbedding(str(path), vocabulary=vocab)
+    assert emb.idx_to_token == vocab.idx_to_token
+    got = emb.get_vecs_by_tokens(["b", "c"]).asnumpy()
+    assert np.allclose(got, [[1, 1], [2, 2]])
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 4
+    assert np.allclose(comp.get_vecs_by_tokens("c").asnumpy(), [2, 2, 2, 2])
+
+
+def test_embedding_registry():
+    from mxnet_tpu.contrib.text import embedding
+    names = embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in names["glove"]
+
+
+# ---------------------------------------------------------------------------
+# tensorboard
+# ---------------------------------------------------------------------------
+def test_tensorboard_event_file(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import SummaryWriter, _masked_crc
+    logdir = str(tmp_path / "logs")
+    w = SummaryWriter(logdir)
+    w.add_scalar("loss", 0.5, global_step=1)
+    w.add_scalar("loss", 0.25, global_step=2)
+    w.close()
+    files = os.listdir(logdir)
+    assert len(files) == 1 and files[0].startswith("events.out.tfevents")
+    raw = open(os.path.join(logdir, files[0]), "rb").read()
+    # walk the TFRecord stream: len(8) + crc(4) + payload + crc(4)
+    records = []
+    pos = 0
+    while pos < len(raw):
+        (length,) = struct.unpack("<Q", raw[pos:pos + 8])
+        (hcrc,) = struct.unpack("<I", raw[pos + 8:pos + 12])
+        assert hcrc == _masked_crc(raw[pos:pos + 8])
+        payload = raw[pos + 12:pos + 12 + length]
+        (dcrc,) = struct.unpack("<I",
+                                raw[pos + 12 + length:pos + 16 + length])
+        assert dcrc == _masked_crc(payload)
+        records.append(payload)
+        pos += 16 + length
+    assert len(records) == 3  # file_version + 2 scalars
+    assert b"brain.Event:2" in records[0]
+    assert b"loss" in records[1]
+
+
+def test_tensorboard_callback(tmp_path):
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    cb = LogMetricsCallback(str(tmp_path / "tb"))
+    metric = mx.metric.Accuracy()
+    metric.update([nd.array([0, 1])], [nd.array([[0.9, 0.1], [0.2, 0.8]])])
+
+    class Param:
+        eval_metric = metric
+        epoch = 0
+    cb(Param())
+    assert os.listdir(str(tmp_path / "tb"))
+
+
+# ---------------------------------------------------------------------------
+# onnx importer (IR-level; the onnx package is absent in this build)
+# ---------------------------------------------------------------------------
+def test_onnx_import_graph_ir():
+    from mxnet_tpu.contrib.onnx import GraphIR, NodeIR
+    from mxnet_tpu.contrib.onnx.import_onnx import import_graph_ir
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    wfc = rng.randn(10, 4 * 4 * 4).astype(np.float32) * 0.2
+    bfc = rng.randn(10).astype(np.float32)
+    graph = GraphIR(
+        inputs=["data", "w1", "wfc", "bfc"],
+        outputs=["prob"],
+        nodes=[
+            NodeIR("Conv", ["data", "w1"], ["c1"],
+                   {"kernel_shape": [3, 3], "pads": [1, 1, 1, 1]}),
+            NodeIR("Relu", ["c1"], ["r1"], {}),
+            NodeIR("MaxPool", ["r1"], ["p1"],
+                   {"kernel_shape": [2, 2], "strides": [2, 2]}),
+            NodeIR("Flatten", ["p1"], ["f1"], {}),
+            NodeIR("Gemm", ["f1", "wfc", "bfc"], ["fc"], {"transB": 1}),
+            NodeIR("Softmax", ["fc"], ["prob"], {}),
+        ],
+        initializers={"w1": w1, "wfc": wfc, "bfc": bfc},
+    )
+    sym, arg_params, aux_params = import_graph_ir(graph)
+    assert sorted(arg_params) == ["bfc", "w1", "wfc"]
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    exe = sym.simple_bind(data=(2, 3, 8, 8), grad_req="null")
+    for k, v in arg_params.items():
+        exe.arg_dict[k]._data = v._data
+    out = exe.forward(is_train=False, data=x)[0].asnumpy()
+    assert out.shape == (2, 10)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    # reference math
+    import jax.numpy as jnp
+    import jax
+    conv = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w1), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    r = jnp.maximum(conv, 0)
+    p = jax.lax.reduce_window(r, -jnp.inf, jax.lax.max, (1, 1, 2, 2),
+                              (1, 1, 2, 2), "VALID")
+    fc = p.reshape(2, -1) @ wfc.T + bfc
+    ref = jax.nn.softmax(fc, axis=1)
+    assert np.abs(out - np.asarray(ref)).max() < 1e-4
+
+
+def test_onnx_import_model_requires_package():
+    from mxnet_tpu.contrib.onnx import import_model
+    with pytest.raises(mx.MXNetError, match="onnx"):
+        import_model("/nonexistent.onnx")
+
+
+# ---------------------------------------------------------------------------
+# gradient compression
+# ---------------------------------------------------------------------------
+def test_gradient_compression_2bit():
+    from mxnet_tpu.gradient_compression import GradientCompression
+    gc = GradientCompression(threshold=0.5)
+    g = np.array([[0.7, -0.6, 0.2], [-0.1, 1.4, 0.0]], np.float32)
+    out = np.asarray(gc.compress_decompress("k", g))
+    expected = np.array([[0.5, -0.5, 0.0], [0.0, 0.5, 0.0]], np.float32)
+    assert np.array_equal(out, expected)
+    # error feedback: residual 0.2 on [0,0] accumulates; a second push of
+    # 0.4 has 0.2+0.4 >= 0.5 -> fires even though 0.4 < threshold
+    g2 = np.array([[0.4, 0.0, 0.0], [0.0, 0.0, 0.0]], np.float32)
+    out2 = np.asarray(gc.compress_decompress("k", g2))
+    assert out2[0, 0] == 0.5
+
+
+def test_kvstore_gradient_compression():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("w", nd.zeros((2, 2)))
+    kv.push("w", nd.array([[2.0, 0.4], [-3.0, 0.0]]))
+    out = nd.zeros((2, 2))
+    kv.pull("w", out=out)
+    assert np.array_equal(out.asnumpy(),
+                          np.array([[1.0, 0.0], [-1.0, 0.0]], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# LibSVMIter + DataLoaderIter
+# ---------------------------------------------------------------------------
+def test_libsvm_iter(tmp_path):
+    path = tmp_path / "data.libsvm"
+    path.write_text("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(path), data_shape=(4,),
+                          batch_size=2)
+    b1 = it.next()
+    assert b1.data[0].stype == "csr"
+    assert np.allclose(b1.data[0].asnumpy(),
+                       [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    assert b1.label[0].asnumpy().tolist() == [1.0, 0.0]
+    b2 = it.next()
+    assert b2.pad == 1
+    with pytest.raises(StopIteration):
+        it.next()
+    it.reset()
+    assert it.next().pad == 0
+
+
+def test_dataloader_iter():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    X = nd.array(np.arange(24, dtype=np.float32).reshape(6, 4))
+    y = nd.array(np.arange(6, dtype=np.float32))
+    ds = gluon.data.ArrayDataset(X, y)
+    loader = gluon.data.DataLoader(ds, batch_size=2)
+    it = DataLoaderIter(loader)
+    assert it.batch_size == 2
+    batches = list(it)
+    assert len(batches) == 3
+    it.reset()
+    assert len(list(it)) == 3
