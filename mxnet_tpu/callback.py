@@ -1,12 +1,18 @@
 """Training callbacks.
 
-Reference: ``python/mxnet/callback.py`` — module_checkpoint, do_checkpoint,
-log_train_metric, Speedometer, ProgressBar, LogValidationMetricsCallback.
+Reference: ``python/mxnet/callback.py`` — module_checkpoint,
+do_checkpoint, log_train_metric, Speedometer, ProgressBar,
+LogValidationMetricsCallback.
+
+Log-format contract: the ``Epoch[%d] ... Speed: ... samples/sec``,
+``Train-<metric>=``, ``Validation-<metric>=`` and ``Time cost=`` line
+shapes are machine-parsed (tools/parse_log.py, bench.py, and the
+reference's own tooling) and must not be reworded; everything else here
+is free-form.
 """
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
@@ -39,8 +45,7 @@ def log_train_metric(period, auto_reset=False):
 
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
+            for name, value in param.eval_metric.get_name_value():
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                              param.epoch, param.nbatch, name, value)
             if auto_reset:
@@ -49,47 +54,44 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Log samples/sec and metrics periodically (reference: callback.py:115)."""
+    """Log samples/sec and metrics periodically (reference: callback.py:115).
+
+    The emitted line shape is part of the log-format contract above.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._tick = None
+        self._last_count = 0
 
     def __call__(self, param):
         count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                if param.eval_metric is not None:
-                    # reading the metric value drains the device queue
-                    # (device-side accumulation is lazy), so the timing
-                    # window below measures completed work, not the
-                    # host's async enqueue rate
-                    name_value = param.eval_metric.get_name_value()
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        if count < self._last_count:       # new epoch restarts the window
+            self._tick = None
+        self._last_count = count
+        if self._tick is None:
+            self._tick = time.time()
+            return
+        if count % self.frequent:
+            return
+        # reading the metric value drains the device queue (device-side
+        # accumulation is lazy), so the window measures completed work,
+        # not the host's async enqueue rate
+        metric_parts = []
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                metric_parts.append("%s=%f" % (name, value))
+            if self.auto_reset:
+                param.eval_metric.reset()
+        speed = self.frequent * self.batch_size / (time.time() - self._tick)
+        head = ("Epoch[%d]" % param.epoch) if metric_parts \
+            else ("Iter[%d]" % param.epoch)
+        logging.info("\t".join(
+            ["%s Batch [%d]" % (head, count),
+             "Speed: %.2f samples/sec" % speed] + metric_parts))
+        self._tick = time.time()
 
 
 class ProgressBar:
@@ -100,19 +102,19 @@ class ProgressBar:
         self.total = total
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = param.nbatch / float(self.total)
+        filled = int(round(self.bar_len * frac))
+        bar = "=" * filled + "-" * (self.bar_len - filled)
+        logging.info("[%s] %d%%\r", bar, int(frac * 100 + 0.999))
 
 
 class LogValidationMetricsCallback:
-    """Log validation metrics at epoch end (reference: callback.py:211)."""
+    """Log validation metrics at epoch end (reference: callback.py:211;
+    line shape is contract — see module docstring)."""
 
     def __call__(self, param):
         if not param.eval_metric:
             return
-        name_value = param.eval_metric.get_name_value()
-        for name, value in name_value:
-            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f",
+                         param.epoch, name, value)

@@ -1,7 +1,11 @@
 """Learning-rate schedulers.
 
 Reference: ``python/mxnet/lr_scheduler.py`` — LRScheduler base,
-FactorScheduler, MultiFactorScheduler, PolyScheduler.
+FactorScheduler, MultiFactorScheduler, PolyScheduler.  Same schedules,
+different mechanics: each scheduler here computes lr(num_update) in
+closed form from the ORIGINAL base lr (the reference mutates base_lr in
+a while-loop), so a scheduler is safe to call with out-of-order update
+counts (the fused kvstore flush may evaluate it speculatively).
 """
 from __future__ import annotations
 
@@ -18,84 +22,86 @@ class LRScheduler:
         self.base_lr = base_lr
 
     def __call__(self, num_update):  # pragma: no cover - abstract
-        raise NotImplementedError("must override this")
+        raise NotImplementedError(
+            "LRScheduler subclasses implement __call__(num_update)")
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference: lr_scheduler.py:53)."""
+    """lr = base * factor^(decays so far), one decay per ``step`` updates,
+    floored at ``stop_factor_lr`` (reference: lr_scheduler.py:53)."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+            raise ValueError("step wants a positive update interval, got %r"
+                             % (step,))
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a factor above 1 would raise the lr over "
+                             "time; pass factor <= 1")
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+        self._logged_decays = 0
 
     def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
+        decays = max(0, (int(num_update) - 1) // self.step)
+        lr = self.base_lr * (self.factor ** decays)
+        floored = lr < self.stop_factor_lr
+        if floored:
+            lr = self.stop_factor_lr
+        if decays > self._logged_decays:
+            self._logged_decays = decays
+            if floored:
+                logging.info("update %d: lr floored at %0.5e (stop_factor_lr)",
+                             num_update, lr)
             else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+                logging.info("update %d: lr decayed to %0.5e", num_update, lr)
+        return lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in a list (reference: lr_scheduler.py:95)."""
+    """lr decays by ``factor`` as num_update passes each boundary in
+    ``step`` (reference: lr_scheduler.py:95)."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1 round")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step wants a non-empty list of boundaries")
+        if any(b < 1 for b in step):
+            raise ValueError("every boundary wants a positive update count")
+        if any(b >= a for b, a in zip(step, step[1:])):
+            raise ValueError("boundaries must strictly increase, got %r"
+                             % (step,))
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("a factor above 1 would raise the lr over "
+                             "time; pass factor <= 1")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
+        self._logged_decays = 0
 
     def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+        decays = sum(1 for b in self.step if num_update > b)
+        lr = self.base_lr * (self.factor ** decays)
+        if decays > self._logged_decays:
+            self._logged_decays = decays
+            logging.info("update %d: lr decayed to %0.5e", num_update, lr)
+        return lr
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero at max_update (reference: lr_scheduler.py:139)."""
+    """lr = base * (1 - n/max_update)^pwr, zero beyond max_update
+    (reference: lr_scheduler.py:139)."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = self.base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError("max_update wants a positive int, got %r"
+                             % (max_update,))
+        self.base_lr_orig = base_lr
         self.max_update = max_update
         self.power = pwr
-        self.base_lr = self.base_lr_orig
 
     def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * pow(
-                1.0 - float(num_update) / float(self.max_update), self.power)
+        frac = min(float(num_update) / self.max_update, 1.0)
+        self.base_lr = self.base_lr_orig * (1.0 - frac) ** self.power
         return self.base_lr

@@ -58,6 +58,7 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
     materialized (T, T) logits); "einsum"/"flash" force a path.
     """
     d = q.shape[-1]
+    k, v = _expand_kv_heads(q, k, v)
     use_flash = (impl == "flash" or
                  (impl == "auto" and _flash_eligible(q, k, causal,
                                                      q_offset, kv_offset)))
@@ -81,8 +82,22 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _expand_kv_heads(q, k, v):
+    """GQA/MQA: replicate K/V heads up to the query head count when
+    num_kv_heads divides num_q_heads (grouped-query attention)."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq == hkv:
+        return k, v
+    if hq % hkv:
+        raise ValueError("GQA needs q heads (%d) divisible by kv heads (%d)"
+                         % (hq, hkv))
+    rep = hq // hkv
+    return (jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
+
+
 def _ring_attention_local(q, k, v, axis_name, causal, scale):
     """Per-device body under shard_map: rotate K/V around the ring."""
+    k, v = _expand_kv_heads(q, k, v)
     axis_size = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
@@ -146,6 +161,12 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     mesh = mesh or current_mesh()
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         return local_attention(q, k, v, causal=causal, scale=scale)
+    sp = mesh.shape[axis_name]
+    if q.shape[1] % sp:
+        raise ValueError(
+            "ring attention needs seq len (%d) divisible by sp (%d); pad "
+            "the sequence (and mask the tail) before sharding" %
+            (q.shape[1], sp))
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
@@ -157,6 +178,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
 
 def _ulysses_local(q, k, v, axis_name, causal, scale):
     """all-to-all seq->head, full local attention, all-to-all back."""
+    k, v = _expand_kv_heads(q, k, v)
     sp = lax.psum(1, axis_name)
     # (b, t/sp, h, d) -> gather seq, scatter heads -> (b, t, h/sp, d)
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -177,9 +199,15 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         return local_attention(q, k, v, causal=causal, scale=scale)
     sp = mesh.shape[axis_name]
-    assert q.shape[2] % sp == 0, \
-        "ulysses needs heads (%d) divisible by sp (%d); use ring_attention" \
-        % (q.shape[2], sp)
+    if q.shape[2] % sp:
+        raise ValueError(
+            "ulysses needs heads (%d) divisible by sp (%d); use "
+            "ring_attention" % (q.shape[2], sp))
+    if q.shape[1] % sp:
+        raise ValueError(
+            "ulysses needs seq len (%d) divisible by sp (%d); pad the "
+            "sequence (and mask the tail) before sharding" %
+            (q.shape[1], sp))
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
