@@ -49,4 +49,7 @@ from .operator import CustomOp, CustomOpProp  # noqa: F401
 from . import log  # noqa: F401
 from . import rtc  # noqa: F401
 from . import contrib  # noqa: F401
+from . import config  # noqa: F401
+from . import predictor  # noqa: F401
+from .predictor import Predictor  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -1,0 +1,96 @@
+"""Environment-variable configuration registry.
+
+Reference: the ``dmlc::GetEnv`` sites across the C++ tree plus their
+documentation page (``docs/faq/env_var.md``) — every knob the runtime
+honors, with type, default, and description, discoverable in one place.
+
+TPU-native: variables are declared with ``register_env`` and read with
+``config.get``; ``list_env()`` renders the registry as the env_var.md
+table.  Unknown ``MXNET_*`` variables found in the process environment
+are reported by ``check_unknown()`` so typos fail loudly instead of
+silently configuring nothing.
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+from .base import getenv
+
+__all__ = ["register_env", "get", "list_env", "check_unknown", "EnvVar"]
+
+
+class EnvVar:
+    __slots__ = ("name", "typ", "default", "description")
+
+    def __init__(self, name, typ, default, description):
+        self.name = name
+        self.typ = typ
+        self.default = default
+        self.description = description
+
+
+_REGISTRY = OrderedDict()
+
+
+def register_env(name, typ=str, default=None, description=""):
+    """Declare a configuration variable (reference: the dmlc::GetEnv
+    call-site + env_var.md doc-entry pair)."""
+    _REGISTRY[name] = EnvVar(name, typ, default, description)
+    return _REGISTRY[name]
+
+
+def get(name):
+    """Read a registered variable with its declared type/default."""
+    if name not in _REGISTRY:
+        raise KeyError("unregistered env var %r; declare it with "
+                       "register_env" % name)
+    var = _REGISTRY[name]
+    return getenv(name, var.default, var.typ)
+
+
+def list_env():
+    """The registry as a markdown table (reference: docs/faq/env_var.md)."""
+    lines = ["| variable | type | default | description |",
+             "| --- | --- | --- | --- |"]
+    for var in _REGISTRY.values():
+        lines.append("| %s | %s | %r | %s |" % (
+            var.name, var.typ.__name__, var.default, var.description))
+    return "\n".join(lines)
+
+
+def check_unknown(prefix="MXNET_"):
+    """MXNET_* variables set in the environment but never registered —
+    likely typos."""
+    return sorted(k for k in os.environ
+                  if k.startswith(prefix) and k not in _REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# the variables this runtime honors
+# ---------------------------------------------------------------------------
+register_env("MXNET_PROFILER_AUTOSTART", bool, False,
+             "start the profiler at import (reference: src/profiler)")
+register_env("MXNET_PROFILER_MODE", int, 0,
+             "profiler instrumentation mode bitmask")
+register_env("MXNET_ENGINE_TYPE", str, "XLA",
+             "accepted for compatibility; scheduling is XLA async "
+             "dispatch, so engine selection is a no-op")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+             "accepted for compatibility; op bulking corresponds to jit "
+             "boundaries here")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+             "size above which dist kvstore treats an array as big "
+             "(sharding hint)")
+register_env("MXNET_CPU_WORKER_NTHREADS", int, 4,
+             "default preprocess/decode worker count for data iterators")
+register_env("MXNET_BACKWARD_DO_MIRROR", bool, False,
+             "gradient checkpointing (jax.checkpoint) in the fused "
+             "training step")
+register_env("MXNET_IMAGE_PREFETCH_BUFFER", int, 4,
+             "ImageRecordIter ready-batch queue depth")
+register_env("MXNET_NATIVE_DISABLE", bool, False,
+             "skip the C++ data-pipeline core even when buildable")
+register_env("MXNET_KVSTORE_HEARTBEAT_DIR", str, None,
+             "shared directory for dist-kvstore worker heartbeats "
+             "(enables get_num_dead_node)")

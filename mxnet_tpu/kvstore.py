@@ -186,6 +186,12 @@ class KVStore:
         # single-process: no-op; multi-host sync is compiled into the
         # collective step on TPU
 
+    def get_num_dead_node(self, node_id=0, timeout_sec=60):
+        """Count of unresponsive workers (reference: kvstore.h:338
+        get_num_dead_node via ps-lite heartbeats).  Single-process
+        stores have no peers to lose."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"
         with open(fname, "wb") as fout:
@@ -329,6 +335,45 @@ class KVStoreDist(KVStore):
         from .parallel import distributed
         distributed.init_distributed()
         self._jit_cache = {}
+        self._hb_dir = None
+        from . import config as _config
+        hb = _config.get("MXNET_KVSTORE_HEARTBEAT_DIR")
+        if hb:
+            import os
+            os.makedirs(hb, exist_ok=True)
+            self._hb_dir = hb
+            self._touch_heartbeat()
+
+    # -- failure detection -----------------------------------------------
+    def _touch_heartbeat(self):
+        if self._hb_dir is None:
+            return
+        import os
+        import time
+        path = "%s/worker-%d.hb" % (self._hb_dir, self.rank)
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+        os.utime(path, None)
+
+    def get_num_dead_node(self, node_id=0, timeout_sec=60):
+        """Workers whose heartbeat file is stale or absent (reference:
+        kvstore.h:338 over ps-lite heartbeats; here over a shared
+        heartbeat directory, MXNET_KVSTORE_HEARTBEAT_DIR — works for
+        local multi-process and any shared filesystem)."""
+        if self._hb_dir is None:
+            return 0
+        import os
+        import time
+        now = time.time()
+        dead = 0
+        for r in range(self.num_workers):
+            path = "%s/worker-%d.hb" % (self._hb_dir, r)
+            try:
+                if now - os.path.getmtime(path) > timeout_sec:
+                    dead += 1
+            except OSError:
+                dead += 1
+        return dead
 
     # -- collective data plane -------------------------------------------
     def _global_mesh(self):
@@ -381,6 +426,7 @@ class KVStoreDist(KVStore):
 
     def _reduce(self, k, vlist):
         merged = super()._reduce(k, vlist)
+        self._touch_heartbeat()
         # wrap in a fresh NDArray: when len(vlist)==1 merged IS the
         # caller's gradient array, which push must not mutate
         return NDArray(self._allreduce(merged._data))
@@ -396,6 +442,28 @@ class KVStoreDist(KVStore):
         except ImportError:  # pragma: no cover
             import jax.numpy as jnp
             self._allreduce(jnp.ones((1,)))
+
+
+def is_worker_node():
+    """Reference: kvstore.h IsWorkerNode (DMLC_ROLE)."""
+    import os
+    return os.environ.get("DMLC_ROLE", "worker") == "worker"
+
+
+def is_server_node():
+    """Reference: kvstore.h IsServerNode — always False: the collective
+    backend has no server processes."""
+    import os
+    return os.environ.get("DMLC_ROLE") == "server"
+
+
+def is_scheduler_node():
+    """Reference: kvstore.h IsSchedulerNode; process 0 plays the
+    coordinator role."""
+    import os
+    if os.environ.get("DMLC_ROLE") == "scheduler":
+        return True
+    return os.environ.get("DMLC_WORKER_ID", "0") == "0"
 
 
 def create(name="local"):

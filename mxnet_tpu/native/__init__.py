@@ -42,6 +42,9 @@ def get_lib():
             return _LIB
         _TRIED = True
         try:
+            from .. import config as _config
+            if _config.get("MXNET_NATIVE_DISABLE"):
+                return _LIB
             if (not os.path.exists(_SO)
                     or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
                 _build()

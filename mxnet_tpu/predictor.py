@@ -1,0 +1,137 @@
+"""Standalone inference predictor.
+
+Reference: ``include/mxnet/c_predict_api.h`` + ``src/c_api/c_predict_api.cc``
+— the deployment surface: load a `prefix-symbol.json` + `prefix-0000.params`
+checkpoint, bind for inference only, set inputs / forward / get outputs.
+The reference exposed this as a C ABI for mobile/embedded targets; the
+TPU-native deployment unit is a jitted XLA program, so the same
+call contract (create, set_input, forward, get_output, reshape, free)
+lives here as a Python class over an inference-bound executor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+from . import ndarray as nd
+
+__all__ = ["Predictor", "create"]
+
+
+class Predictor:
+    """MXPredCreate equivalent (reference: c_predict_api.h:77).
+
+    >>> p = Predictor("model-symbol.json", "model-0001.params",
+    ...               {"data": (1, 3, 224, 224)})
+    >>> p.set_input("data", x)        # or just p.forward(data=x)
+    >>> p.forward()
+    >>> out = p.get_output(0)
+    """
+
+    def __init__(self, symbol_file, param_file, input_shapes,
+                 dev_type="tpu", dev_id=0, output_names=None):
+        from .symbol import load as load_symbol
+        sym = load_symbol(symbol_file)
+        if output_names:
+            outs = sym.get_internals()
+            names = outs.list_outputs()
+            picked = []
+            for want in output_names:
+                if want not in names:
+                    raise MXNetError("output %r not in graph (%s...)"
+                                     % (want, ", ".join(names[:8])))
+                picked.append(outs[names.index(want)])
+            from .symbol import Group
+            sym = picked[0] if len(picked) == 1 else Group(picked)
+        arg_params, aux_params = _load_params(param_file)
+        self._sym = sym
+        self._exe = sym.simple_bind(grad_req="null", **input_shapes)
+        for k, v in arg_params.items():
+            if k in self._exe.arg_dict:
+                self._exe.arg_dict[k]._data = v._data
+        for k, v in aux_params.items():
+            if k in self._exe.aux_dict:
+                self._exe.aux_dict[k]._data = v._data
+        self._input_names = list(input_shapes)
+        self._inputs = {}
+        self._outputs = None
+
+    def set_input(self, name, data):
+        """MXPredSetInput (reference: c_predict_api.h:177)."""
+        if name not in self._input_names:
+            raise MXNetError("unknown input %r; inputs are %s"
+                             % (name, self._input_names))
+        self._inputs[name] = data if isinstance(data, nd.NDArray) \
+            else nd.array(np.asarray(data, np.float32))
+
+    def forward(self, **inputs):
+        """MXPredForward (reference: c_predict_api.h:191)."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise MXNetError("inputs not set: %s" % missing)
+        self._outputs = self._exe.forward(is_train=False, **self._inputs)
+        return self
+
+    def get_output(self, index=0):
+        """MXPredGetOutput (reference: c_predict_api.h:213)."""
+        if self._outputs is None:
+            raise MXNetError("call forward() before get_output()")
+        return self._outputs[index]
+
+    @property
+    def num_outputs(self):
+        return len(self._sym.list_outputs())
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape (reference: c_predict_api.h:120)."""
+        if self._outputs is not None:
+            return tuple(self._outputs[index].shape)
+        self.forward(**{n: nd.zeros(s) for n, s in zip(
+            self._input_names, [self._exe.arg_dict[n].shape
+                                for n in self._input_names])})
+        return tuple(self._outputs[index].shape)
+
+    def reshape(self, input_shapes):
+        """MXPredReshape — rebind with new input shapes sharing params."""
+        new = Predictor.__new__(Predictor)
+        new._sym = self._sym
+        new._exe = self._sym.simple_bind(grad_req="null", **input_shapes)
+        for k in new._exe.arg_dict:
+            if k in self._exe.arg_dict and k not in input_shapes:
+                new._exe.arg_dict[k]._data = self._exe.arg_dict[k]._data
+        for k in new._exe.aux_dict:
+            if k in self._exe.aux_dict:
+                new._exe.aux_dict[k]._data = self._exe.aux_dict[k]._data
+        new._input_names = list(input_shapes)
+        new._inputs = {}
+        new._outputs = None
+        return new
+
+    def free(self):
+        """MXPredFree — release executor buffers."""
+        self._exe = None
+        self._outputs = None
+        self._inputs = {}
+
+
+def _load_params(param_file):
+    """Split a saved param file into arg/aux dicts (prefix convention of
+    model.save_checkpoint: 'arg:name' / 'aux:name')."""
+    loaded = nd.load(param_file)
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def create(symbol_file, param_file, input_shapes, **kwargs):
+    """Factory matching MXPredCreate's call shape."""
+    return Predictor(symbol_file, param_file, input_shapes, **kwargs)

@@ -241,5 +241,7 @@ class Marker:
                 args={"domain": str(self.domain), "scope": scope})
 
 
-if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+from . import config as _config  # noqa: E402
+
+if _config.get("MXNET_PROFILER_AUTOSTART"):
     set_state("run")

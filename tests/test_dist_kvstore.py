@@ -58,6 +58,9 @@ kv.pull(kv2_key, out=o2)
 assert np.allclose(o2.asnumpy(), -0.3), o2.asnumpy()  # -0.1 * (1+2)
 
 kv.barrier()
+
+# failure detection: both workers heartbeat during pushes, so none dead
+assert kv.get_num_dead_node(timeout_sec=300) == 0
 print("WORKER_OK rank=%%d" %% rank)
 """
 
@@ -76,6 +79,7 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
             "DMLC_PS_ROOT_PORT": "9413",
             "DMLC_WORKER_ID": str(rank),
             "DMLC_NUM_WORKER": "2",
+            "MXNET_KVSTORE_HEARTBEAT_DIR": str(tmp_path / "hb"),
         })
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
