@@ -99,7 +99,17 @@ class Context:
     @classmethod
     def default_ctx(cls):
         if not hasattr(cls._default_ctx, "value"):
-            cls._default_ctx.value = Context("cpu", 0)
+            # the reference defaults to cpu() because CPU is its only
+            # always-present device; here the accelerator is the natural
+            # home — defaulting to cpu() on a TPU host would pin params
+            # and grads to host memory (device_put to CpuDevice) and mix
+            # platforms inside one jit
+            try:
+                jax = _jax()
+                has_acc = any(d.platform != "cpu" for d in jax.devices())
+            except Exception:  # pragma: no cover - uninitialized backend
+                has_acc = False
+            cls._default_ctx.value = Context("tpu" if has_acc else "cpu", 0)
         return cls._default_ctx.value
 
 

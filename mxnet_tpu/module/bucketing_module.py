@@ -201,6 +201,9 @@ class BucketingModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
+        # per-bucket executors share the weight buffers, so the donated
+        # executor-fused update is off limits (see Module.init_optimizer)
+        self._curr_module._allow_exec_fusion = False
         self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
                                          force_init=force_init)
         for mod in self._buckets.values():

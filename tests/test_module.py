@@ -242,3 +242,32 @@ def test_model_checkpoint_functions(tmp_path):
     sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
     assert sym2.list_outputs() == sym.list_outputs()
     assert (arg2["fc1_weight"].asnumpy() == 1).all()
+
+
+def test_bucketing_disables_exec_fusion():
+    """Per-bucket executors share weight buffers, so the donated
+    executor-fused update must be off under BucketingModule — both
+    mechanisms active corrupts/deletes shared buffers on TPU (the
+    kvstore fused store is used instead)."""
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        fc = mx.sym.FullyConnected(mx.sym.Flatten(data), num_hidden=4,
+                                   name="fc")
+        return (mx.sym.SoftmaxOutput(fc, label, name="softmax"),
+                ("data",), ("softmax_label",))
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 8))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (2,))],
+             for_training=True)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd")
+    assert mod._curr_module._fused_exec_update is False
+    # a plain Module with the same kvstore DOES fuse into the executor
+    plain = mx.mod.Module(sym_gen(8)[0], context=mx.cpu())
+    plain.bind(data_shapes=[mx.io.DataDesc("data", (2, 8))],
+               label_shapes=[mx.io.DataDesc("softmax_label", (2,))])
+    plain.init_params(mx.init.Xavier())
+    plain.init_optimizer(kvstore="tpu", optimizer="sgd")
+    assert plain._fused_exec_update is True
