@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Gluon imperative/hybrid training example.
+
+TPU-native rendition of the reference's gluon MNIST example
+(``example/gluon/mnist.py``): Block definition, autograd.record,
+Trainer.step, hybridize() for one-program-per-shape compilation.
+
+Uses the real MNIST IDX files when ``--data-dir`` points at them
+(train-images-idx3-ubyte etc.), otherwise a synthetic digits-like
+dataset (no network egress in this build).
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, autograd, nd  # noqa: E402
+
+
+def synthetic_digits(n, seed):
+    """10-class 1x28x28 images: a bright bar whose row encodes the class."""
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.2
+    y = rng.randint(0, 10, size=n)
+    for i in range(n):
+        r = 2 + y[i] * 2
+        X[i, 0, r:r + 3] += 0.7
+    return X, y.astype(np.float32)
+
+
+def load_data(args):
+    if args.data_dir:
+        train = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "train-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=False)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=False)
+        return train, val
+    Xtr, ytr = synthetic_digits(4096, 0)
+    Xva, yva = synthetic_digits(512, 1)
+    return (mx.io.NDArrayIter(Xtr, ytr, args.batch_size, shuffle=True),
+            mx.io.NDArrayIter(Xva, yva, args.batch_size))
+
+
+def build_net(hybridize):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(20, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Conv2D(50, kernel_size=5, activation="relu"),
+            gluon.nn.MaxPool2D(pool_size=2, strides=2),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    if hybridize:
+        net.hybridize()
+    return net
+
+
+def evaluate(net, val_iter):
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    for batch in val_iter:
+        out = net(batch.data[0])
+        metric.update(batch.label, [out])
+    return metric.get()[1]
+
+
+def main():
+    p = argparse.ArgumentParser(description="gluon MNIST")
+    p.add_argument("--data-dir", type=str, default=None,
+                   help="directory with MNIST idx files; synthetic if unset")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.002)
+    p.add_argument("--no-hybridize", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    train_iter, val_iter = load_data(args)
+    net = build_net(not args.no_hybridize)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric = mx.metric.Accuracy()
+        tic = time.time()
+        n = 0
+        for batch in train_iter:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+            n += x.shape[0]
+        logging.info("Epoch[%d] Train-accuracy=%f", epoch,
+                     metric.get()[1])
+        logging.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+        logging.info("Epoch[%d] Validation-accuracy=%f", epoch,
+                     evaluate(net, val_iter))
+        logging.info("Epoch[%d] Speed: %.2f samples/sec", epoch,
+                     n / (time.time() - tic))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""LSTM language model with bucketing.
+
+TPU-native rendition of the reference's bucketed LM example
+(``example/rnn/lstm_bucketing.py``): BucketSentenceIter groups
+sentences by length, BucketingModule keeps one shape-specialized
+executor per bucket (= one XLA program per bucket), and the fused
+lax.scan LSTM runs the sequence dimension on-device.
+
+Trains on a whitespace-tokenized text file (``--data``), or on a
+generated synthetic corpus when none is given (this build has no
+network egress to fetch PTB).
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+
+
+def tokenize(path, vocab=None):
+    sentences = []
+    vocab = vocab if vocab is not None else {"<pad>": 0, "<unk>": 1}
+    with open(path) as f:
+        for line in f:
+            words = line.split()
+            if not words:
+                continue
+            for w in words:
+                if w not in vocab:
+                    vocab[w] = len(vocab)
+            sentences.append([vocab[w] for w in words])
+    return sentences, vocab
+
+
+def synthetic_corpus(n_sentences=2000, vocab_size=64, seed=0):
+    """Markov-chain text so the LM has learnable structure."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab_size) * 0.05, size=vocab_size)
+    sentences = []
+    for _ in range(n_sentences):
+        L = int(rng.choice([8, 16, 24, 32]))
+        s = [int(rng.randint(2, vocab_size))]
+        for _ in range(L - 1):
+            s.append(int(rng.choice(vocab_size, p=trans[s[-1]])))
+        sentences.append(s)
+    return sentences, vocab_size
+
+
+def main():
+    p = argparse.ArgumentParser(description="LSTM LM with bucketing")
+    p.add_argument("--data", type=str, default=None,
+                   help="tokenized text file; synthetic corpus if absent")
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-hidden", type=int, default=200)
+    p.add_argument("--num-embed", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--optimizer", type=str, default="adam")
+    p.add_argument("--buckets", type=int, nargs="+",
+                   default=[8, 16, 24, 32])
+    p.add_argument("--kv-store", type=str, default="tpu")
+    p.add_argument("--disp-batches", type=int, default=50)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    if args.data:
+        sentences, vocab = tokenize(args.data)
+        vocab_size = len(vocab)
+    else:
+        sentences, vocab_size = synthetic_corpus()
+    logging.info("corpus: %d sentences, vocab %d", len(sentences),
+                 vocab_size)
+
+    train_iter = mx.rnn.BucketSentenceIter(
+        sentences, batch_size=args.batch_size, buckets=args.buckets)
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        label = sym.Variable("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        stack = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                      prefix="lstm_l%d_" % i))
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=train_iter.default_bucket_key,
+        context=mx.tpu() if args.kv_store == "tpu" else mx.cpu())
+    model.fit(
+        train_iter,
+        eval_metric=mx.metric.Perplexity(ignore_label=None),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))
+
+
+if __name__ == "__main__":
+    main()
