@@ -19,3 +19,4 @@ from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import contrib       # noqa: F401
 from . import quantization  # noqa: F401
+from . import misc          # noqa: F401

@@ -175,7 +175,7 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                      float(regularization_coefficient), bool(use_linear))
 
 
-@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss"))
+@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss", "_contrib_CTCLoss"))
 def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
               use_data_lengths=False, use_label_lengths=False,
               blank_label="first", **attrs):

@@ -388,3 +388,30 @@ def _l2_normalization(x, eps=1e-10, mode="instance", **attrs):
         raise MXNetError("bad L2Normalization mode %s" % mode)
     norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
     return x / norm
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(A, **attrs):
+    """Inverse from a Cholesky factor: (A A^T)^-1 given lower A
+    (reference: la_op.cc linalg_potri)."""
+    from jax.scipy.linalg import solve_triangular
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = solve_triangular(A, eye, lower=True)
+    return jnp.swapaxes(inv_l, -1, -2) @ inv_l
+
+
+@register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)
+def _linalg_gelqf(A, **attrs):
+    """LQ factorization A = L Q with Q orthonormal rows (reference:
+    la_op.cc linalg_gelqf); computed via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("_linalg_syevd", aliases=("linalg_syevd",), num_outputs=2)
+def _linalg_syevd(A, **attrs):
+    """Symmetric eigendecomposition A = U^T diag(L) U (reference:
+    la_op.cc linalg_syevd; note the reference returns U with
+    eigenvectors as ROWS)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

@@ -1,0 +1,140 @@
+"""Long-tail op coverage (Correlation, Crop, slice_assign, linalg
+potri/gelqf/syevd, image ops, PSROIPooling, ftml, quadratic).
+
+Reference analogues: the corresponding cases in
+tests/python/unittest/test_operator.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_reshape_like_and_identity():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    b = nd.zeros((2, 3))
+    assert nd.reshape_like(a, b).shape == (2, 3)
+
+
+def test_slice_assign():
+    a = nd.zeros((4, 4))
+    r = nd.ones((2, 2))
+    out = nd._slice_assign(a, r, begin=(1, 1), end=(3, 3))
+    expect = np.zeros((4, 4), np.float32)
+    expect[1:3, 1:3] = 1
+    assert np.array_equal(out.asnumpy(), expect)
+    out2 = nd._slice_assign_scalar(a, begin=(0, 0), end=(1, 4), scalar=7.0)
+    assert np.array_equal(out2.asnumpy()[0], np.full(4, 7.0, np.float32))
+
+
+def test_quadratic():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    out = nd.contrib.quadratic(x, a=2.0, b=3.0, c=1.0)
+    assert np.allclose(out.asnumpy(), [6.0, 15.0])
+
+
+def test_crop():
+    x = nd.array(np.arange(2 * 1 * 5 * 5, dtype=np.float32).reshape(2, 1, 5, 5))
+    out = nd.Crop(x, offset=(1, 2), h_w=(3, 2))
+    assert out.shape == (2, 1, 3, 2)
+    assert np.array_equal(out.asnumpy(),
+                          x.asnumpy()[:, :, 1:4, 2:4])
+    like = nd.zeros((2, 1, 2, 2))
+    out2 = nd.Crop(x, like, center_crop=True)
+    assert out2.shape == (2, 1, 2, 2)
+
+
+def test_correlation_identity_peak():
+    """Self-correlation at zero displacement equals the channel-mean of
+    the squared signal; shifted signals peak at the matching
+    displacement."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(1, 3, 8, 8).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, pad_size=1).asnumpy()
+    # pad_size == max_displacement keeps the spatial size (reference
+    # correlation.cc sizing)
+    assert out.shape == (1, 9, 8, 8)
+    center = out[0, 4]   # zero displacement channel
+    ref = (x * x).mean(axis=1)[0]
+    assert np.abs(center - ref).max() < 1e-5
+    # data2 shifted right by 1: the (dy=0, dx=+1) channel should beat center
+    x2 = np.roll(x, 1, axis=3)
+    out2 = nd.Correlation(nd.array(x), nd.array(x2), kernel_size=1,
+                          max_displacement=1, pad_size=1).asnumpy()
+    assert out2[0, 5].mean() > out2[0, 4].mean()
+
+
+def test_linalg_potri_gelqf_syevd():
+    rng = np.random.RandomState(1)
+    m = rng.rand(4, 4).astype(np.float32)
+    spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg_potrf(nd.array(spd))
+    inv = nd.linalg_potri(L).asnumpy()
+    assert np.abs(inv @ spd - np.eye(4)).max() < 1e-3
+    a = rng.rand(3, 5).astype(np.float32)
+    Lq, Q = nd.linalg_gelqf(nd.array(a))
+    assert np.abs(Lq.asnumpy() @ Q.asnumpy() - a).max() < 1e-4
+    assert np.abs(Q.asnumpy() @ Q.asnumpy().T - np.eye(3)).max() < 1e-4
+    sym_m = (m + m.T).astype(np.float32)
+    U, lam = nd.linalg_syevd(nd.array(sym_m))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    assert np.abs(U.T @ np.diag(lam) @ U - sym_m).max() < 1e-3
+
+
+def test_image_ops():
+    rng = np.random.RandomState(2)
+    hwc = (rng.rand(5, 6, 3) * 255).astype(np.uint8)
+    t = nd.to_tensor(nd.array(hwc.astype(np.float32)))
+    assert t.shape == (3, 5, 6)
+    assert abs(float(t.asnumpy().max()) - hwc.max() / 255.0) < 1e-5
+    normed = nd.image_normalize(t, mean=(0.5, 0.5, 0.5),
+                                std=(0.2, 0.2, 0.2)).asnumpy()
+    assert np.allclose(normed, (t.asnumpy() - 0.5) / 0.2, atol=1e-5)
+
+
+def test_psroi_pooling():
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 8, 6, 6).astype(np.float32)   # output_dim 2, group 2
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(x), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=2,
+                                  pooled_size=2, group_size=2)
+    assert out.shape == (1, 2, 2, 2)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_ftml_update():
+    w = nd.ones((3,))
+    g = nd.array(np.array([0.1, -0.2, 0.3], np.float32))
+    d = nd.zeros((3,))
+    v = nd.zeros((3,))
+    z = nd.zeros((3,))
+    w2 = nd.ftml_update(w, g, d, v, z, lr=0.1, t=1)
+    assert np.isfinite(w2.asnumpy()).all()
+    assert not np.allclose(w2.asnumpy(), 1.0)
+    # d/v/z are state outputs written back in place (mutate_aux)
+    assert not np.allclose(v.asnumpy(), 0.0)
+    assert not np.allclose(z.asnumpy(), 0.0)
+
+
+def test_kl_sparse_reg_grad():
+    from mxnet_tpu import autograd
+    x = nd.array(np.full((4, 3), 0.5, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                         penalty=0.1)
+        loss = y.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    # rho=0.5: kl grad = 0.1 * (-0.2/0.5 + 0.8/0.5) = 0.12, split over n=4
+    assert np.allclose(g, 1.0 + 0.12 / 4, atol=1e-5)
+
+
+def test_sparse_embedding_alias():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([0, 3], np.float32))
+    out = nd.contrib.SparseEmbedding(idx, w, input_dim=4, output_dim=3)
+    assert np.array_equal(out.asnumpy(), w.asnumpy()[[0, 3]])
