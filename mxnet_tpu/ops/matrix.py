@@ -391,13 +391,15 @@ def _l2_normalization(x, eps=1e-10, mode="instance", **attrs):
 
 
 @register("_linalg_potri", aliases=("linalg_potri",))
-def _linalg_potri(A, **attrs):
-    """Inverse from a Cholesky factor: (A A^T)^-1 given lower A
-    (reference: la_op.cc linalg_potri)."""
+def _linalg_potri(A, lower=True, **attrs):
+    """Inverse from a Cholesky factor: (A A^T)^-1 for lower A, or
+    (A^T A)^-1 for upper (reference: la_op.cc linalg_potri)."""
     from jax.scipy.linalg import solve_triangular
     eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
-    inv_l = solve_triangular(A, eye, lower=True)
-    return jnp.swapaxes(inv_l, -1, -2) @ inv_l
+    inv = solve_triangular(A, eye, lower=bool(lower))
+    if lower:
+        return jnp.swapaxes(inv, -1, -2) @ inv
+    return inv @ jnp.swapaxes(inv, -1, -2)
 
 
 @register("_linalg_gelqf", aliases=("linalg_gelqf",), num_outputs=2)

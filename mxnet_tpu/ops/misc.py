@@ -70,6 +70,9 @@ def _crop(data, *like, offset=(0, 0), h_w=(0, 0), center_crop=False,
         y0, x0 = (H - th) // 2, (W - tw) // 2
     else:
         y0, x0 = offset
+    if y0 + th > H or x0 + tw > W or y0 < 0 or x0 < 0:
+        raise ValueError("crop window offset %r + size (%d, %d) exceeds "
+                         "input (%d, %d)" % ((y0, x0), th, tw, H, W))
     return data[:, :, y0:y0 + th, x0:x0 + tw]
 
 
@@ -95,7 +98,6 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     border = D + K // 2
     out_h = int(np.ceil((Hp - 2 * border) / float(s1)))
     out_w = int(np.ceil((Wp - 2 * border) / float(s1)))
-    n_disp = 2 * (D // s2) + 1
     disps = [(dy * s2, dx * s2)
              for dy in range(-(D // s2), D // s2 + 1)
              for dx in range(-(D // s2), D // s2 + 1)]
@@ -123,29 +125,35 @@ def _correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     return jnp.stack(outs, axis=1)                       # (B, n_disp^2, h, w)
 
 
-@register("IdentityAttachKLSparseReg")
-def _identity_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
-                            momentum=0.9, **attrs):
+@register("IdentityAttachKLSparseReg", num_outputs=2,
+          mutate_aux=("moving_rho",))
+def _identity_kl_sparse_reg(data, moving_rho=None, sparseness_target=0.1,
+                            penalty=0.001, momentum=0.9, **attrs):
     """Identity forward; backward adds the KL sparseness penalty
-    d/drho KL(target || rho) with rho = batch mean activation
-    (reference: identity_attach_KL_sparse_reg.cc)."""
+    d/drho KL(target || rho) with rho tracked as a momentum moving
+    average across batches in the aux state, like the reference's
+    aux rho buffer (identity_attach_KL_sparse_reg.cc)."""
+    if moving_rho is None:
+        moving_rho = jnp.zeros(data.shape[1:], data.dtype)
+    batch_rho = jnp.mean(data, axis=0)
+    new_rho = momentum * moving_rho + (1.0 - momentum) * batch_rho
 
     @jax.custom_vjp
-    def f(x):
+    def f(x, rho):
         return x
 
-    def fwd(x):
-        rho = jnp.clip(jnp.mean(x, axis=0), 1e-6, 1.0 - 1e-6)
-        return x, (rho, x.shape[0])
+    def fwd(x, rho):
+        return x, (jnp.clip(rho, 1e-6, 1.0 - 1e-6), x.shape[0])
 
     def bwd(res, g):
         rho, n = res
         t = sparseness_target
         kl_grad = penalty * (-t / rho + (1.0 - t) / (1.0 - rho))
-        return (g + kl_grad[None] / n,)
+        return (g + kl_grad[None] / n, jnp.zeros_like(rho))
 
     f.defvjp(fwd, bwd)
-    return f(data)
+    return (f(data, lax.stop_gradient(new_rho)),
+            lax.stop_gradient(new_rho))
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +175,6 @@ def _image_normalize(data, mean=(0.0,), std=(1.0,), **attrs):
     image_random-inl.h Normalize)."""
     mean = jnp.asarray(np.atleast_1d(np.asarray(mean, np.float32)))
     std = jnp.asarray(np.atleast_1d(np.asarray(std, np.float32)))
-    shape = (-1,) + (1,) * (data.ndim - (1 if data.ndim == 3 else 2) - 1)
     if data.ndim == 3:          # CHW
         return (data - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
     return (data - mean.reshape(1, -1, 1, 1)) / std.reshape(1, -1, 1, 1)
@@ -176,31 +183,44 @@ def _image_normalize(data, mean=(0.0,), std=(1.0,), **attrs):
 @register("_contrib_PSROIPooling")
 def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
                    pooled_size=7, group_size=0, **attrs):
-    """Position-sensitive ROI pooling (reference: psroi_pooling.cc) —
-    the no-offset case of DeformablePSROIPooling."""
-    from .contrib import _deformable_psroi_pooling
-    gs = int(group_size) or int(pooled_size)
-    return _deformable_psroi_pooling(
-        data, rois, None, spatial_scale=spatial_scale,
-        output_dim=output_dim, group_size=gs, pooled_size=pooled_size,
-        part_size=int(pooled_size), sample_per_part=1, no_trans=True)
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cc):
+    out[od, ph, pw] averages ALL feature-map pixels inside bin (ph, pw)
+    of channel (od * gs + gh) * gs + gw — exact masked-mean
+    formulation (static shapes; no per-bin sampling approximation)."""
+    P = int(pooled_size)
+    GS = int(group_size) or P
+    OD = int(output_dim)
+    B, C, H, W = data.shape
+    scale = float(spatial_scale)
+    grp_h = np.minimum(np.arange(P) * GS // P, GS - 1)
+    grp_w = np.minimum(np.arange(P) * GS // P, GS - 1)
+    chan = jnp.asarray(
+        (np.arange(OD)[:, None, None] * GS + grp_h[None, :, None]) * GS
+        + grp_w[None, None, :])                           # (OD, P, P)
 
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        bh = jnp.maximum(y2 - y1, 0.1) / P
+        bw = jnp.maximum(x2 - x1, 0.1) / P
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+        ph = jnp.arange(P, dtype=jnp.float32)
+        ymask = ((ys[None, :] >= jnp.floor(y1 + ph[:, None] * bh)) &
+                 (ys[None, :] < jnp.ceil(y1 + (ph[:, None] + 1) * bh)))
+        xmask = ((xs[None, :] >= jnp.floor(x1 + ph[:, None] * bw)) &
+                 (xs[None, :] < jnp.ceil(x1 + (ph[:, None] + 1) * bw)))
+        m = (ymask[:, None, :, None] & xmask[None, :, None, :]
+             ).astype(data.dtype)                         # (P, P, H, W)
+        fmap = data[bidx][chan]                           # (OD, P, P, H, W)
+        num = jnp.sum(fmap * m[None], axis=(3, 4))
+        den = jnp.maximum(jnp.sum(m, axis=(2, 3)), 1.0)
+        return num / den[None]
 
-@register("ftml_update", num_outputs=4,
-          mutate_aux=("d", "v", "z"))
-def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
-                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
-                 clip_grad=-1.0, **attrs):
-    """FTML fused update (reference: optimizer_op.cc FTMLUpdate)."""
-    g = grad * rescale_grad + wd * weight
-    g = jnp.where(clip_grad >= 0, jnp.clip(g, -clip_grad, clip_grad), g)
-    v_new = beta2 * v + (1.0 - beta2) * g * g
-    d_new = (1.0 - beta1 ** t) / lr * (
-        jnp.sqrt(v_new / (1.0 - beta2 ** t)) + epsilon)
-    sigma = d_new - beta1 * d
-    z_new = beta1 * z + (1.0 - beta1) * g - sigma * weight
-    w_new = -z_new / d_new
-    return w_new, d_new, v_new, z_new
+    return jax.vmap(one)(rois)
 
 
 @register("_contrib_SparseEmbedding")

@@ -195,3 +195,19 @@ def adam_rowsparse(weight, mean, var, vals, idx, **kw):
     return _rs_jit(_adam_rowsparse)(weight, mean, var, vals, idx, kw["lr"],
                                     kw["beta1"], kw["beta2"], kw["epsilon"],
                                     kw["wd"], kw["rescale"], kw["clip"])
+
+
+@register("ftml_update", num_outputs=4, mutate_aux=("d", "v", "z"))
+def _ftml_update(weight, grad, d, v, z, lr=0.01, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0, **attrs):
+    """FTML fused update (reference: optimizer_op.cc FTMLUpdate); like
+    every update here, the gradient is clipped BEFORE weight decay."""
+    g = _prep_grad(grad, rescale_grad, clip_grad, wd, weight)
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    d_new = (1.0 - beta1 ** t) / lr * (
+        jnp.sqrt(v_new / (1.0 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    w_new = -z_new / d_new
+    return w_new, d_new, v_new, z_new

@@ -125,12 +125,17 @@ def test_kl_sparse_reg_grad():
     x.attach_grad()
     with autograd.record():
         y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
-                                         penalty=0.1)
+                                         penalty=0.1, momentum=0.0)
         loss = y.sum()
     loss.backward()
     g = x.grad.asnumpy()
     # rho=0.5: kl grad = 0.1 * (-0.2/0.5 + 0.8/0.5) = 0.12, split over n=4
     assert np.allclose(g, 1.0 + 0.12 / 4, atol=1e-5)
+    # momentum moving average: rho after one batch is (1-m)*batch_rho,
+    # written back into the aux array (mutate_aux)
+    rho = nd.zeros((3,))
+    nd.IdentityAttachKLSparseReg(x, rho, momentum=0.9)
+    assert np.allclose(rho.asnumpy(), 0.05, atol=1e-6)
 
 
 def test_sparse_embedding_alias():
