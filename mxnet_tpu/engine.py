@@ -25,7 +25,26 @@ import contextlib
 import threading
 
 __all__ = ["bulk", "set_bulk_size", "record_exception", "check_raise",
-           "clear_exception"]
+           "clear_exception", "naive", "naive_scope_active"]
+
+_NAIVE_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def naive():
+    """Deterministic serial execution scope: every imperative op blocks
+    until complete (the reference's NaiveEngine oracle,
+    src/engine/naive_engine.cc; also selectable process-wide via
+    MXNET_ENGINE_TYPE=NaiveEngine)."""
+    _NAIVE_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _NAIVE_DEPTH[0] -= 1
+
+
+def naive_scope_active():
+    return _NAIVE_DEPTH[0] > 0
 
 _BULK_SIZE = [0]
 

@@ -24,6 +24,22 @@ from . import random as _random
 from .base import MXNetError
 from .ops.registry import get_op, coerce_attrs, OpDef
 
+_NAIVE_CACHE = []
+
+
+def _engine_naive():
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (the reference's
+    deterministic serial engine, engine.cc:32-48) or an engine.naive
+    scope is active — each op then runs to completion synchronously."""
+    from . import engine as _engine
+    if _engine.naive_scope_active():
+        return True
+    if not _NAIVE_CACHE:
+        from . import config as _config
+        _NAIVE_CACHE.append(
+            _config.get("MXNET_ENGINE_TYPE") == "NaiveEngine")
+    return _NAIVE_CACHE[0]
+
 _INT_KINDS = ("i", "u", "b")
 
 
@@ -61,6 +77,14 @@ def invoke(op, nd_inputs, attrs=None, out=None):
 
     single = not isinstance(outputs, tuple)
     outs = [outputs] if single else list(outputs)
+
+    if _engine_naive():
+        # deterministic serial oracle (reference NaiveEngine,
+        # src/engine/naive_engine.cc): every op completes — and any
+        # device error surfaces — before invoke returns
+        for o in outs:
+            if hasattr(o, "block_until_ready"):
+                o.block_until_ready()
 
     # write mutate_aux results back into the trailing aux inputs
     n_aux = len(op.mutate_aux)

@@ -87,3 +87,24 @@ def test_image_record_iter_error_reaches_waitall(tmp_path):
         it.next()
     nd.waitall()  # consumed by next(); no double delivery
     it.close()
+
+
+def test_naive_engine_scope_matches_async():
+    """The deterministic serial oracle (reference NaiveEngine) computes
+    identical results to the default async path."""
+    rng = np.random.RandomState(5)
+    x = rng.rand(4, 8).astype(np.float32)
+    w = rng.rand(3, 8).astype(np.float32)
+
+    def run():
+        a = nd.array(x)
+        b = nd.array(w)
+        y = nd.FullyConnected(a, b, num_hidden=3, no_bias=True)
+        return nd.softmax(y).asnumpy()
+
+    async_out = run()
+    with engine.naive():
+        assert engine.naive_scope_active()
+        naive_out = run()
+    assert not engine.naive_scope_active()
+    assert np.array_equal(async_out, naive_out)
