@@ -49,7 +49,7 @@ def _make_op_func(name, opdef):
         return invoke(opdef, nd_inputs, attrs, out=out)
 
     op_func.__name__ = name
-    op_func.__doc__ = opdef.doc
+    op_func.__doc__ = opdef.gen_doc()
     return op_func
 
 

@@ -57,6 +57,42 @@ class OpDef:
             return self.num_outputs(attrs)
         return self.num_outputs
 
+    def gen_doc(self):
+        """Render the op's parameter table from its fn signature — the
+        native stand-in for dmlc::Parameter's declarative field docs
+        (__FIELDS__ rendered into every op docstring in the reference;
+        dmlc-core parameter.h).  Cached after first render."""
+        if getattr(self, "_doc_cache", None) is not None:
+            return self._doc_cache
+        import inspect
+        lines = [self.doc.strip() or "%s operator." % self.name, "",
+                 "Parameters", "----------"]
+        try:
+            params = inspect.signature(self.fn).parameters.values()
+        except (TypeError, ValueError):  # pragma: no cover
+            params = []
+        for p in params:
+            if p.kind == inspect.Parameter.VAR_KEYWORD:
+                continue
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                lines.append("*%s : NDArray/Symbol (variadic input)"
+                             % p.name)
+            elif p.default is inspect.Parameter.empty:
+                kind = ("aux state" if p.name in self.mutate_aux
+                        else "required input")
+                lines.append("%s : NDArray/Symbol (%s)" % (p.name, kind))
+            else:
+                lines.append("%s : optional, default=%r"
+                             % (p.name, p.default))
+        if not callable(self.num_outputs) and self.num_outputs > 1:
+            lines.append("")
+            lines.append("Outputs: %d (%s aux write-back)"
+                         % (self.num_outputs,
+                            "%d" % len(self.mutate_aux)
+                            if self.mutate_aux else "no"))
+        self._doc_cache = "\n".join(lines)
+        return self._doc_cache
+
     def __repr__(self):
         return "OpDef(%s)" % self.name
 

@@ -75,7 +75,7 @@ def _make_sym_func(op_name, opdef):
         return _make_symbol_call(op_name, (pos, sym_kwargs), attrs, name=name)
 
     sym_func.__name__ = op_name
-    sym_func.__doc__ = opdef.doc
+    sym_func.__doc__ = opdef.gen_doc()
     return sym_func
 
 
