@@ -1,0 +1,38 @@
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo import vision as models
+from mxnet_tpu.parallel import pure_block_apply
+from mxnet_tpu import random as mxrandom
+
+B = 256
+net = models.resnet50_v1(classes=1000)
+net.initialize(mx.init.Xavier())
+net(mx.nd.ones((1, 3, 224, 224)))
+params = {k: p.data()._data.astype(jnp.bfloat16) for k, p in net.collect_params().items()}
+apply_fn = pure_block_apply(net, list(params), is_train=True)
+key = mxrandom.next_key()
+x = jnp.asarray(np.random.rand(B, 3, 224, 224), jnp.bfloat16)
+y = jnp.asarray(np.random.randint(0, 1000, B))
+
+def loss_fn(p, x, y):
+    logits = apply_fn(p, key, x)
+    logits = logits.astype(jnp.float32)
+    return -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(B), y])
+
+fwd = jax.jit(loss_fn)
+grad = jax.jit(lambda p, x, y: jax.value_and_grad(loss_fn)(p, x, y))
+
+def timeit(fn, *a, n=10, tag=""):
+    r = fn(*a); jax.block_until_ready(r)
+    t0 = time.time()
+    for _ in range(n):
+        r = fn(*a)
+    jax.block_until_ready(r)
+    dt = (time.time() - t0) / n
+    print("%s: %.1f ms  (%.0f img/s)" % (tag, dt * 1e3, B / dt))
+    return dt
+
+timeit(fwd, params, x, y, tag="fwd only")
+timeit(grad, params, x, y, tag="fwd+bwd")

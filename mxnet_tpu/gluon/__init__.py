@@ -9,3 +9,4 @@ from . import model_zoo  # noqa: F401
 from . import loss  # noqa: F401
 from . import utils  # noqa: F401
 from .utils import split_and_load, split_data, clip_global_norm  # noqa: F401
+from . import contrib  # noqa: F401

@@ -1,0 +1,2 @@
+"""Experimental gluon blocks (reference: python/mxnet/gluon/contrib/)."""
+from . import rnn  # noqa: F401

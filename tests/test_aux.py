@@ -124,3 +124,35 @@ def test_rtc_pallas_module():
 def test_get_logger():
     logger = mx.log.get_logger("test_mxtpu", level=mx.log.INFO)
     logger.info("hello")
+
+
+def test_check_consistency_cross_dtype():
+    """The cross-backend oracle (reference check_consistency: CPU vs GPU;
+    here f32 vs f64 contexts on the same graph)."""
+    import numpy as np
+    from mxnet_tpu.test_utils import check_consistency
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    ctx_list = [
+        {"ctx": mx.cpu(), "data": (3, 5), "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (3, 5), "type_dict": {"data": np.float32}},
+    ]
+    outs = check_consistency(net, ctx_list)
+    assert len(outs) == 2
+    assert np.allclose(outs[0][0], outs[1][0])
+
+
+def test_check_consistency_detects_divergence():
+    import numpy as np
+    import pytest as _pytest
+    from mxnet_tpu.test_utils import check_consistency
+    data = mx.sym.Variable("data")
+    net = mx.sym.exp(data * 20)  # amplifies dtype differences
+    ctx_list = [
+        {"ctx": mx.cpu(), "data": (2, 3), "type_dict": {"data": np.float32}},
+        {"ctx": mx.cpu(), "data": (2, 3), "type_dict": {"data": np.float16}},
+    ]
+    # f16 exp(20x) overflows/diverges wildly from f32 -> must be caught
+    with _pytest.raises(AssertionError):
+        check_consistency(net, ctx_list, scale=2.0)

@@ -135,3 +135,42 @@ def test_rnn_vs_cell_consistency():
     outputs, _ = cell.unroll(T, x, layout="TNC")
     out_cell = np.stack([o.asnumpy() for o in outputs])
     assert_almost_equal(out_layer, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_variational_dropout_cell_mask_constant_across_steps():
+    """The same dropout mask applies at every unrolled step (reference:
+    gluon/contrib VariationalDropoutCell)."""
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+    base = gluon.rnn.LSTMCell(8, input_size=4)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = [nd.ones((2, 4)) for _ in range(3)]
+    states = cell.begin_state(batch_size=2)
+    with mx.autograd.record(train_mode=True):
+        masked = []
+        for t in range(3):
+            out, states = cell(x[t], states)
+            masked.append(cell._input_mask.asnumpy())
+    assert np.array_equal(masked[0], masked[1])
+    assert np.array_equal(masked[1], masked[2])
+    # a fresh sequence (reset) draws a new mask
+    cell.reset()
+    states = cell.begin_state(batch_size=2)
+    with mx.autograd.record(train_mode=True):
+        cell(x[0], states)
+    assert not np.array_equal(masked[0], cell._input_mask.asnumpy())
+
+
+def test_conv2d_lstm_cell():
+    from mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+    cell = Conv2DLSTMCell(input_shape=(3, 8, 8), hidden_channels=6)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 6, 8, 8)
+    assert new_states[0].shape == (2, 6, 8, 8)
+    assert new_states[1].shape == (2, 6, 8, 8)
+    # a second step from the produced state stays finite
+    out2, _ = cell(x, new_states)
+    assert np.isfinite(out2.asnumpy()).all()

@@ -262,3 +262,35 @@ def test_image_record_iter_throughput(tmp_path):
     assert n == 256
     assert n / dt > 200, "loader too slow: %.1f img/s" % (n / dt)
     it.close()
+
+
+def test_image_det_record_iter(tmp_path):
+    """Detection records with packed multi-object labels stream out as
+    (B, max_objects, 5) padded with -1 (reference:
+    iter_image_det_recordio.cc label contract)."""
+    pytest.importorskip("PIL")
+    fname = str(tmp_path / "det.rec")
+    rec = mx.recordio.MXRecordIO(fname, "w")
+    rng = np.random.RandomState(0)
+    counts = [1, 3, 2, 1, 2, 3]
+    for i, n_obj in enumerate(counts):
+        img = rng.randint(0, 255, (32, 32, 3), dtype=np.uint8)
+        objs = []
+        for j in range(n_obj):
+            objs.extend([float(j % 2), 0.1 * j, 0.1, 0.5 + 0.1 * j, 0.6])
+        header = [4.0, 5.0, 0.0, 0.0] + objs   # header_w=4, obj_w=5
+        rec.write(mx.recordio.pack_img(
+            mx.recordio.IRHeader(0, header, i, 0), img, img_fmt=".png"))
+    rec.close()
+    it = mx.io.ImageDetRecordIter(path_imgrec=fname, data_shape=(3, 28, 28),
+                                  batch_size=3, label_shape=(3, 5))
+    batches = list(it)
+    assert len(batches) == 2
+    lab = batches[0].label[0].asnumpy()
+    assert lab.shape == (3, 3, 5)
+    # record 0 has 1 object: rows 1,2 padded with -1
+    assert lab[0, 0, 0] == 0.0 and np.all(lab[0, 1:] == -1.0)
+    # record 1 has 3 objects, classes 0,1,0
+    assert lab[1, :, 0].tolist() == [0.0, 1.0, 0.0]
+    assert it.provide_label[0].shape == (3, 3, 5)
+    it.close()
