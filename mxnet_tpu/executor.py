@@ -85,6 +85,14 @@ class Executor:
         fn_eval = build_graph_fn(symbol, self.arg_names, self.aux_names, False)
         diff_idx = tuple(self._diff_idx)
 
+        from . import config as _config
+        if _config.get("MXNET_BACKWARD_DO_MIRROR"):
+            # gradient checkpointing: recompute activations in backward
+            # instead of keeping them live — the reference's mirror pass
+            # (graph_executor.cc:277-291) as jax.checkpoint over the
+            # traced forward
+            fn_train = jax.checkpoint(fn_train, static_argnums=())
+
         # mixed-precision policy (compute_dtype='bfloat16'): fp32 master
         # args cast to bf16 at graph entry (labels / excluded names kept);
         # vjp through the cast hands fp32 grads to the optimizer.  The

@@ -132,10 +132,9 @@ def cast_storage(arr, stype):
 def retain(arr, indices):
     """Reference: sparse_retain op — keep only given rows."""
     from .ndarray import NDArray as ND
+    from ..ops.misc import retain_rows
     idx = indices._data if isinstance(indices, ND) else jnp.asarray(indices)
-    mask = jnp.zeros(arr.shape[0], bool).at[idx.astype(jnp.int32)].set(True)
-    dense = jnp.where(mask.reshape((-1,) + (1,) * (arr.ndim - 1)), arr._data, 0)
-    return RowSparseNDArray(dense)
+    return RowSparseNDArray(retain_rows(arr._data, idx))
 
 
 def zeros(stype, shape, ctx=None, dtype=None, **kwargs):

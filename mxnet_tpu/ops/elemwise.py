@@ -119,6 +119,16 @@ _binary("_power", jnp.power, aliases=("_Power", "broadcast_power", "pow"))
 _binary("_maximum", jnp.maximum, aliases=("broadcast_maximum",))
 _binary("_minimum", jnp.minimum, aliases=("broadcast_minimum",))
 _binary("_hypot", jnp.hypot, aliases=("broadcast_hypot",))
+# gradient-accumulation add (reference: elemwise_binary_op_basic.cc
+# _grad_add — same kernel as elemwise_add, kept as a distinct name so
+# saved symbol JSON containing it deserializes)
+_binary("_grad_add", jnp.add)
+# _scatter_* variants (reference: elemwise_binary_scalar_op with
+# FComputeEx — applied only to the STORED rows of a row_sparse input).
+# The graph-level kernel is dense; the stored-rows-only semantics for
+# RowSparseNDArray inputs is restored by the nd-level overrides in
+# ndarray/__init__.py, which mask the result to the stored rows.
+_binary("_scatter_elemwise_div", jnp.divide)
 
 
 def _cmp(name, f, aliases=()):
@@ -170,6 +180,16 @@ _scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
 _scalar_op("_logical_and_scalar", lambda x, s: jnp.logical_and(x, s).astype(x.dtype))
 _scalar_op("_logical_or_scalar", lambda x, s: jnp.logical_or(x, s).astype(x.dtype))
 _scalar_op("_logical_xor_scalar", lambda x, s: jnp.logical_xor(x, s).astype(x.dtype))
+_scalar_op("_hypot_scalar", jnp.hypot, aliases=("_HypotScalar",))
+_scalar_op("_scatter_plus_scalar", jnp.add)
+_scalar_op("_scatter_minus_scalar", jnp.subtract)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(x, alpha=0.2, beta=0.5, **attrs):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc
+    hard_sigmoid — piecewise-linear sigmoid approximation."""
+    return jnp.clip(float(alpha) * x + float(beta), 0.0, 1.0)
 
 
 @register("smooth_l1")

@@ -223,6 +223,32 @@ def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=1,
     return jax.vmap(one)(rois)
 
 
+def retain_rows(data, indices):
+    """Zero every row of ``data`` not named in ``indices`` — the one
+    shared row-mask kernel behind sparse_retain (here) and the
+    NDArray-level RowSparseNDArray.retain (ndarray/sparse.py)."""
+    idx = indices.astype(jnp.int32)
+    mask = jnp.zeros((data.shape[0],), bool).at[idx].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_sparse_retain", aliases=("sparse_retain",))
+def _sparse_retain_op(data, indices, **attrs):
+    """Reference: src/operator/tensor/sparse_retain-inl.h — keep only the
+    rows named in ``indices``, zero the rest.  Dense-backed equivalent of
+    the row_sparse kernel; one XLA scatter."""
+    return retain_rows(data, indices)
+
+
+@register("cast_storage")
+def _cast_storage_op(data, stype="default", **attrs):
+    """Reference: src/operator/tensor/cast_storage-inl.h.  At the XLA
+    value level all storage types share the dense backing, so the graph
+    op is the identity; the NDArray-level ``nd.cast_storage`` wraps the
+    result in the requested sparse class (ndarray/sparse.py)."""
+    return data
+
+
 @register("_contrib_SparseEmbedding")
 def _sparse_embedding(data, weight, input_dim=0, output_dim=0, **attrs):
     """Embedding whose gradient is row-sparse in spirit (reference:

@@ -71,6 +71,19 @@ def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     return new_w, new_mean, new_var
 
 
+@register("_sparse_adagrad_update", num_outputs=2, mutate_aux=("history",))
+def _sparse_adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                           **attrs):
+    """Reference: src/operator/contrib/optimizer_op.cc AdagradUpdate
+    (row_sparse).  With the dense-backed sparse model every row is
+    stored, so the dense kernel matches; the row-touched-only fast path
+    is the rowsparse variant below."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient, wd, weight)
+    new_hist = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_hist) + epsilon), new_hist
+
+
 @register("rmsprop_update", num_outputs=2, mutate_aux=("n",))
 def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.9, epsilon=1e-8,
                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,

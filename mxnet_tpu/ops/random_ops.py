@@ -44,17 +44,18 @@ def _gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
     return beta * jax.random.gamma(__rng__, alpha, _shape(shape), dtype_np(dtype))
 
 
-@register("_random_exponential", aliases=("random_exponential",), needs_rng=True)
+@register("_random_exponential", aliases=("random_exponential", "exponential"), needs_rng=True)
 def _exponential(lam=1.0, shape=(), dtype="float32", ctx=None, __rng__=None, **attrs):
     return jax.random.exponential(__rng__, _shape(shape), dtype_np(dtype)) / lam
 
 
-@register("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+@register("_random_poisson", aliases=("random_poisson", "poisson"), needs_rng=True)
 def _poisson(lam=1.0, shape=(), dtype="float32", ctx=None, __rng__=None, **attrs):
     return jax.random.poisson(__rng__, lam, _shape(shape)).astype(dtype_np(dtype))
 
 
-@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+@register("_random_negative_binomial",
+          aliases=("random_negative_binomial", "negative_binomial"),
           needs_rng=True)
 def _neg_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None,
                   __rng__=None, **attrs):
@@ -64,7 +65,8 @@ def _neg_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None,
 
 
 @register("_random_generalized_negative_binomial",
-          aliases=("random_generalized_negative_binomial",), needs_rng=True)
+          aliases=("random_generalized_negative_binomial",
+                   "generalized_negative_binomial"), needs_rng=True)
 def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None,
                       __rng__=None, **attrs):
     k1, k2 = jax.random.split(__rng__)
@@ -80,7 +82,7 @@ def _randint(low=0, high=1, shape=(), dtype="int32", ctx=None, __rng__=None, **a
 
 
 # -- per-element-parameter sampling (reference: multisample_op.h) -----------
-@register("_sample_uniform", needs_rng=True)
+@register("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
 def _sample_uniform(low, high, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     out_shape = low.shape + s
@@ -90,14 +92,14 @@ def _sample_uniform(low, high, shape=(), dtype="float32", __rng__=None, **attrs)
     return low_b + u * (high_b - low_b)
 
 
-@register("_sample_normal", needs_rng=True)
+@register("_sample_normal", aliases=("sample_normal",), needs_rng=True)
 def _sample_normal(mu, sigma, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     z = jax.random.normal(__rng__, mu.shape + s, dtype_np(dtype))
     return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(sigma.shape + (1,) * len(s))
 
 
-@register("_sample_gamma", needs_rng=True)
+@register("_sample_gamma", aliases=("sample_gamma",), needs_rng=True)
 def _sample_gamma(alpha, beta, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     a = alpha.reshape(alpha.shape + (1,) * len(s))
@@ -105,21 +107,22 @@ def _sample_gamma(alpha, beta, shape=(), dtype="float32", __rng__=None, **attrs)
     return g * beta.reshape(beta.shape + (1,) * len(s))
 
 
-@register("_sample_exponential", needs_rng=True)
+@register("_sample_exponential", aliases=("sample_exponential",), needs_rng=True)
 def _sample_exponential(lam, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     e = jax.random.exponential(__rng__, lam.shape + s, dtype_np(dtype))
     return e / lam.reshape(lam.shape + (1,) * len(s))
 
 
-@register("_sample_poisson", needs_rng=True)
+@register("_sample_poisson", aliases=("sample_poisson",), needs_rng=True)
 def _sample_poisson(lam, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     lam_b = jnp.broadcast_to(lam.reshape(lam.shape + (1,) * len(s)), lam.shape + s)
     return jax.random.poisson(__rng__, lam_b).astype(dtype_np(dtype))
 
 
-@register("_sample_negative_binomial", needs_rng=True)
+@register("_sample_negative_binomial",
+          aliases=("sample_negative_binomial",), needs_rng=True)
 def _sample_negative_binomial(k, p, shape=(), dtype="float32", __rng__=None, **attrs):
     s = _shape(shape)
     k1, k2 = jax.random.split(__rng__)
@@ -129,7 +132,8 @@ def _sample_negative_binomial(k, p, shape=(), dtype="float32", __rng__=None, **a
     return jax.random.poisson(k2, lam).astype(dtype_np(dtype))
 
 
-@register("_sample_generalized_negative_binomial", needs_rng=True)
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",), needs_rng=True)
 def _sample_gen_negative_binomial(mu, alpha, shape=(), dtype="float32",
                                   __rng__=None, **attrs):
     s = _shape(shape)

@@ -43,6 +43,14 @@ _reduce("max", jnp.max, aliases=("max_axis",))
 _reduce("min", jnp.min, aliases=("min_axis",))
 
 
+@register("_square_sum", aliases=("square_sum",))
+def _square_sum(x, axis=None, keepdims=False, exclude=False, **attrs):
+    """Reference: src/operator/tensor/square_sum-inl.h — sum of squares,
+    the fused kernel backing sparse L2 regularization; one XLA fusion here."""
+    ax = _norm_axis(axis, x.ndim, exclude)
+    return jnp.sum(jnp.square(x), axis=ax, keepdims=bool(keepdims))
+
+
 @register("norm")
 def _norm(x, ord=2, axis=None, keepdims=False, **attrs):
     ax = _norm_axis(axis, x.ndim)

@@ -156,3 +156,38 @@ def test_check_consistency_detects_divergence():
     # f16 exp(20x) overflows/diverges wildly from f32 -> must be caught
     with _pytest.raises(AssertionError):
         check_consistency(net, ctx_list, scale=2.0)
+
+
+def test_backward_do_mirror_numerics(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR (reference graph_executor.cc:277 mirror
+    pass -> jax.checkpoint) must not change results."""
+    import numpy as np
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 6).astype(np.float32)
+
+    def run():
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, label, name="softmax")
+        exe = net.simple_bind(data=(4, 6), softmax_label=(4,))
+        for k in exe.arg_dict:
+            if k not in ("data", "softmax_label"):
+                exe.arg_dict[k]._data = mx.nd.array(
+                    np.random.RandomState(hash(k) % 2**31)
+                    .rand(*exe.arg_dict[k].shape).astype(np.float32) * 0.1
+                )._data
+        exe.forward(is_train=True, data=x,
+                    softmax_label=np.array([0, 1, 2, 0], np.float32))
+        exe.backward()
+        return (exe.outputs[0].asnumpy(),
+                exe.grad_dict["fc1_weight"].asnumpy())
+
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    base_out, base_grad = run()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    mir_out, mir_grad = run()
+    assert np.allclose(base_out, mir_out, atol=1e-6)
+    assert np.allclose(base_grad, mir_grad, atol=1e-6)

@@ -143,3 +143,121 @@ def test_sparse_embedding_alias():
     idx = nd.array(np.array([0, 3], np.float32))
     out = nd.contrib.SparseEmbedding(idx, w, input_dim=4, output_dim=3)
     assert np.array_equal(out.asnumpy(), w.asnumpy()[[0, 3]])
+
+
+def test_hard_sigmoid():
+    # reference: elemwise_unary_op_basic.cc hard_sigmoid
+    x = nd.array(np.array([-10.0, -1.0, 0.0, 1.0, 10.0], np.float32))
+    out = nd.hard_sigmoid(x, alpha=0.2, beta=0.5)
+    assert np.allclose(out.asnumpy(),
+                       np.clip(0.2 * x.asnumpy() + 0.5, 0, 1))
+
+
+def test_square_sum():
+    # reference: tensor/square_sum-inl.h
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    out = nd.square_sum(nd.array(x), axis=1, keepdims=True)
+    assert np.allclose(out.asnumpy(), (x ** 2).sum(axis=1, keepdims=True),
+                       atol=1e-6)
+    assert np.allclose(nd._square_sum(nd.array(x)).asnumpy(), (x ** 2).sum(),
+                       atol=1e-5)
+
+
+def test_sparse_retain_op():
+    # reference: tensor/sparse_retain-inl.h
+    x = np.arange(12, dtype=np.float32).reshape(4, 3) + 1
+    out = nd.sparse_retain(nd.array(x), nd.array(np.array([1, 3], np.int64)))
+    expect = np.zeros_like(x)
+    expect[[1, 3]] = x[[1, 3]]
+    assert np.array_equal(out.asnumpy(), expect)
+    # row_sparse in -> row_sparse out
+    rs = nd.array(x).tostype("row_sparse")
+    r = nd.sparse_retain(rs, nd.array(np.array([0], np.int64)))
+    assert r.stype == "row_sparse"
+    assert np.array_equal(np.asarray(r.indices.asnumpy()), [0])
+
+
+def test_cast_storage_op():
+    # reference: tensor/cast_storage-inl.h
+    x = np.array([[1.0, 0.0], [0.0, 0.0], [0.0, 3.0]], np.float32)
+    rs = nd.cast_storage(nd.array(x), "row_sparse")
+    assert rs.stype == "row_sparse"
+    assert np.array_equal(rs.indices.asnumpy(), [0, 2])
+    back = nd.cast_storage(rs, "default")
+    assert back.stype == "default" and np.array_equal(back.asnumpy(), x)
+    # symbolic path: value-level identity
+    s = mx.sym.cast_storage(mx.sym.Variable("d"), stype="row_sparse")
+    exe = s.simple_bind(d=(3, 2))
+    exe.forward(is_train=False, d=x)
+    assert np.array_equal(exe.outputs[0].asnumpy(), x)
+
+
+def test_scatter_and_scalar_variants():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    assert np.allclose(nd._scatter_plus_scalar(nd.array(x), scalar=2.0).asnumpy(),
+                       x + 2)
+    assert np.allclose(nd._scatter_minus_scalar(nd.array(x), scalar=1.0).asnumpy(),
+                       x - 1)
+    assert np.allclose(
+        nd._scatter_elemwise_div(nd.array(x), nd.array(x)).asnumpy(),
+        np.ones_like(x))
+    assert np.allclose(nd._hypot_scalar(nd.array(np.array([3.0], np.float32)),
+                                        scalar=4.0).asnumpy(), [5.0])
+    assert np.allclose(nd._grad_add(nd.array(x), nd.array(x)).asnumpy(), 2 * x)
+    # row_sparse input: op applies only to STORED rows (FComputeEx contract)
+    rs = nd.array(np.array([[1.0, 1.0], [0.0, 0.0]], np.float32)).tostype(
+        "row_sparse")
+    out = nd._scatter_plus_scalar(rs, scalar=2.0)
+    assert np.array_equal(out.asnumpy(), [[3.0, 3.0], [0.0, 0.0]])
+
+
+def test_sample_distribution_ops():
+    # reference: random/multisample_op.h — per-row distribution params
+    mx.random.seed(7)
+    low = nd.array(np.array([0.0, 10.0], np.float32))
+    high = nd.array(np.array([1.0, 20.0], np.float32))
+    u = nd.sample_uniform(low, high, shape=(500,)).asnumpy()
+    assert u.shape == (2, 500)
+    assert (u[0] >= 0).all() and (u[0] <= 1).all()
+    assert (u[1] >= 10).all() and (u[1] <= 20).all()
+    mu = nd.array(np.array([0.0, 50.0], np.float32))
+    sig = nd.array(np.array([1.0, 2.0], np.float32))
+    z = nd.sample_normal(mu, sig, shape=(2000,)).asnumpy()
+    assert abs(z[0].mean()) < 0.2 and abs(z[1].mean() - 50) < 0.5
+    lam = nd.array(np.array([1.0, 20.0], np.float32))
+    p = nd.sample_poisson(lam, shape=(2000,)).asnumpy()
+    assert abs(p[0].mean() - 1.0) < 0.2 and abs(p[1].mean() - 20.0) < 1.0
+    g = nd.sample_gamma(nd.array(np.array([2.0], np.float32)),
+                        nd.array(np.array([3.0], np.float32)),
+                        shape=(3000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.5
+    e = nd.sample_exponential(lam, shape=(2000,)).asnumpy()
+    assert abs(e[0].mean() - 1.0) < 0.2
+    nb = nd.sample_negative_binomial(
+        nd.array(np.array([3.0], np.float32)),
+        nd.array(np.array([0.5], np.float32)), shape=(2000,)).asnumpy()
+    assert abs(nb.mean() - 3.0) < 0.5  # mean = k(1-p)/p = 3
+    gnb = nd.sample_generalized_negative_binomial(
+        nd.array(np.array([4.0], np.float32)),
+        nd.array(np.array([0.25], np.float32)), shape=(2000,)).asnumpy()
+    assert abs(gnb.mean() - 4.0) < 0.6
+    # legacy scalar-parameter aliases
+    assert nd.poisson(lam=2.0, shape=(5,)).shape == (5,)
+    assert nd.exponential(lam=1.0, shape=(5,)).shape == (5,)
+    assert nd.negative_binomial(k=2, p=0.5, shape=(5,)).shape == (5,)
+    assert nd.generalized_negative_binomial(mu=2.0, alpha=0.5,
+                                            shape=(5,)).shape == (5,)
+
+
+def test_sparse_adagrad_update():
+    # reference: contrib/optimizer_op.cc AdagradUpdate row_sparse
+    w = nd.ones((3, 2))
+    g = nd.array(np.array([[1.0, 1.0], [0.0, 0.0], [2.0, 2.0]], np.float32))
+    h = nd.zeros((3, 2))
+    out = nd._sparse_adagrad_update(w, g, h, lr=0.1, epsilon=1e-7)
+    neww = out[0] if isinstance(out, (list, tuple)) else out
+    expect_h = g.asnumpy() ** 2
+    expect_w = 1.0 - 0.1 * g.asnumpy() / (np.sqrt(expect_h) + 1e-7)
+    expect_w[1] = 1.0  # zero grad row untouched
+    assert np.allclose(neww.asnumpy(), expect_w, atol=1e-5)
+    assert np.allclose(h.asnumpy(), expect_h, atol=1e-6)

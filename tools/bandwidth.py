@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""bandwidth — kvstore push/pull throughput benchmark.
+
+Equivalent of the reference's kvstore bandwidth benchmark
+(``tools/bandwidth/measure.py``): time init/push/pull over arrays of a
+model-like size distribution and report GB/s per direction.  Under
+kvstore=tpu the push+pull pair is the fused on-device update; under
+dist_* it includes the cross-process all-reduce.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.abspath(os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description="kvstore bandwidth benchmark")
+    p.add_argument("--kv-store", type=str, default="tpu")
+    p.add_argument("--num-layers", type=int, default=20,
+                   help="number of parameter tensors")
+    p.add_argument("--size", type=int, default=int(4e6),
+                   help="elements per tensor")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--optimizer", type=str, default=None,
+                   help="set to e.g. sgd for update-on-kvstore timing")
+    args = p.parse_args()
+
+    kv = mx.kv.create(args.kv_store)
+    if args.optimizer:
+        kv.set_optimizer(mx.optimizer.create(args.optimizer))
+    rng = np.random.RandomState(0)
+    shapes = [(args.size,) for _ in range(args.num_layers)]
+    arrays = [nd.array(rng.rand(*s).astype(np.float32)) for s in shapes]
+    grads = [nd.array(rng.rand(*s).astype(np.float32)) for s in shapes]
+    outs = [nd.zeros(s) for s in shapes]
+    for i, a in enumerate(arrays):
+        kv.init(i, a)
+    total_bytes = sum(4 * args.size for _ in shapes)
+
+    # warmup (compiles the fused update under kvstore=tpu)
+    for i in range(args.num_layers):
+        kv.push(i, grads[i])
+    for i in range(args.num_layers):
+        kv.pull(i, out=outs[i])
+    nd.waitall()
+
+    t0 = time.time()
+    for _ in range(args.iters):
+        for i in range(args.num_layers):
+            kv.push(i, grads[i])
+        for i in range(args.num_layers):
+            kv.pull(i, out=outs[i])
+    for o in outs:
+        o.wait_to_read()
+    dt = (time.time() - t0) / args.iters
+    gb = total_bytes / 1e9
+    print("kvstore=%s  layers=%d x %.1fM floats" %
+          (kv.type, args.num_layers, args.size / 1e6))
+    print("push+pull round: %.1f ms   effective %.2f GB/s per direction"
+          % (dt * 1e3, gb / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""diagnose — print platform/runtime information for bug reports.
+
+Equivalent of the reference's environment-diagnostic script
+(``tools/diagnose.py``): platform, python, relevant packages, device
+inventory, and the framework's registered environment variables.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+_HERE = os.path.abspath(os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def check_platform():
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_packages():
+    print("----------Environment----------")
+    for pkg in ("numpy", "jax", "jaxlib", "flax", "optax"):
+        try:
+            mod = __import__(pkg)
+            print("%-12s : %s" % (pkg, getattr(mod, "__version__", "?")))
+        except ImportError:
+            print("%-12s : not installed" % pkg)
+
+
+def check_devices():
+    print("----------Device Info----------")
+    try:
+        import jax
+        for d in jax.devices():
+            print("device       :", d)
+    except Exception as exc:
+        print("jax devices unavailable:", exc)
+
+
+def check_framework():
+    print("----------Framework Info----------")
+    import mxnet_tpu as mx
+    print("mxnet_tpu    :", mx.__version__)
+    from mxnet_tpu import native
+    print("native core  :", "loaded" if native.get_lib() else "unavailable")
+    from mxnet_tpu import config
+    unknown = config.check_unknown()
+    if unknown:
+        print("UNKNOWN MXNET_* env vars (typos?):", ", ".join(unknown))
+    set_vars = [k for k in os.environ if k.startswith(("MXNET_", "DMLC_"))]
+    for k in sorted(set_vars):
+        print("%-36s = %s" % (k, os.environ[k]))
+
+
+if __name__ == "__main__":
+    check_platform()
+    check_python()
+    check_packages()
+    check_devices()
+    check_framework()
