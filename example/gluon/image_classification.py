@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Gluon model-zoo training/benchmark driver.
+
+Reference parity: ``example/gluon/image_classification.py`` — pick any
+model-zoo network by name, train imperatively or hybridized, or run
+``--benchmark 1`` on synthetic data and report samples/sec.  The
+hybridized path compiles the whole forward+backward per shape; the
+``ParallelTrainer`` path additionally folds the optimizer update into
+the same XLA program.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision as models  # noqa: E402
+
+
+def synthetic_batch(rng, batch_size, image_shape, num_classes):
+    x = rng.rand(batch_size, *image_shape).astype(np.float32)
+    y = rng.randint(0, num_classes, batch_size).astype(np.float32)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser(description="gluon image classification")
+    p.add_argument("--model", type=str, default="resnet18_v1",
+                   help="any mxnet_tpu.gluon.model_zoo.vision model name")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--benchmark", type=int, default=1)
+    p.add_argument("--num-batches", type=int, default=30)
+    p.add_argument("--hybridize", type=int, default=1)
+    p.add_argument("--dtype", type=str, default="float32")
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+
+    net = getattr(models, args.model)(classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    if args.hybridize:
+        net.hybridize()
+
+    rng = np.random.RandomState(0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    x_np, y_np = synthetic_batch(rng, args.batch_size, image_shape,
+                                 args.num_classes)
+    x, y = nd.array(x_np), nd.array(y_np)
+    if args.dtype == "bfloat16":
+        x = x.astype("bfloat16")
+
+    def step():
+        with autograd.record():
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        return loss
+
+    step()  # compile
+    nd.waitall()
+    t0 = time.time()
+    for _ in range(args.num_batches):
+        loss = step()
+    nd.waitall()
+    dt = time.time() - t0
+    ips = args.num_batches * args.batch_size / dt
+    logging.info("model %s  batch %d  %s  %.1f samples/sec  (final loss %.4f)",
+                 args.model, args.batch_size,
+                 "hybrid" if args.hybridize else "imperative",
+                 ips, float(loss.mean().asnumpy()))
+
+
+if __name__ == "__main__":
+    main()

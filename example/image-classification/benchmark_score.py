@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Inference throughput sweep across networks and batch sizes.
+
+Reference parity: ``example/image-classification/benchmark_score.py`` —
+score each symbol with synthetic data over a batch-size sweep and print
+images/sec.  The whole forward is one jitted XLA program per (network,
+batch) pair; the first call per pair pays compilation.
+"""
+import argparse
+import importlib
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def get_symbol(network, num_layers):
+    mod = importlib.import_module("symbols." + network)
+    kwargs = {"num_classes": 1000}
+    if num_layers:
+        kwargs["num_layers"] = num_layers
+        kwargs["image_shape"] = "3,224,224"
+    return mod.get_symbol(**kwargs)
+
+
+def score(sym, batch_size, image_shape, num_batches, dry_run=3):
+    data_shape = (batch_size,) + image_shape
+    exe = sym.simple_bind(data=data_shape, softmax_label=(batch_size,),
+                          grad_req="null")
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v._data = mx.nd.array(rng.rand(*v.shape).astype(np.float32)
+                                  * 0.01)._data
+    x = rng.rand(*data_shape).astype(np.float32)
+    for _ in range(dry_run):
+        exe.forward(is_train=False, data=x)
+    exe.outputs[0].wait_to_read()
+    t0 = time.time()
+    for _ in range(num_batches):
+        exe.forward(is_train=False, data=x)
+    exe.outputs[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - t0)
+
+
+def main():
+    p = argparse.ArgumentParser(description="inference benchmark")
+    p.add_argument("--networks", type=str,
+                   default="mlp,lenet,resnet-18,resnet-50,alexnet,mobilenet")
+    p.add_argument("--batch-sizes", type=str, default="1,32,64,128")
+    p.add_argument("--num-batches", type=int, default=10)
+    p.add_argument("--image-shape", type=str, default="3,224,224")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    image_shape = tuple(int(d) for d in args.image_shape.split(","))
+
+    for spec in args.networks.split(","):
+        if "-" in spec:
+            network, layers = spec.rsplit("-", 1)
+            layers = int(layers)
+        else:
+            network, layers = spec, 0
+        sym = get_symbol(network, layers)
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            shape = image_shape if network not in ("mlp",) else (784,)
+            try:
+                ips = score(sym, b, shape, args.num_batches)
+                logging.info("network: %-12s batch %4d  %10.1f img/s",
+                             spec, b, ips)
+            except Exception as exc:
+                logging.warning("network %s batch %d failed: %s",
+                                spec, b, exc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""AlexNet symbol (reference parity:
+example/image-classification/symbols/alexnet.py — Krizhevsky 2012, with
+BatchNorm replacing the original LRN, as the reference's dist-scaling
+benchmark configuration does)."""
+import mxnet_tpu as mx
+
+
+def get_symbol(num_classes=1000, dtype="float32", **kwargs):
+    data = mx.sym.Variable("data")
+    # stage 1
+    net = mx.sym.Convolution(data, num_filter=96, kernel=(11, 11),
+                             stride=(4, 4), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.LRN(net, alpha=0.0001, beta=0.75, knorm=2, nsize=5)
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    # stage 2
+    net = mx.sym.Convolution(net, num_filter=256, kernel=(5, 5), pad=(2, 2),
+                             name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.LRN(net, alpha=0.0001, beta=0.75, knorm=2, nsize=5)
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    # stage 3
+    net = mx.sym.Convolution(net, num_filter=384, kernel=(3, 3), pad=(1, 1),
+                             name="conv3")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, num_filter=384, kernel=(3, 3), pad=(1, 1),
+                             name="conv4")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, num_filter=256, kernel=(3, 3), pad=(1, 1),
+                             name="conv5")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pool_type="max")
+    # classifier
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4096, name="fc6")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=4096, name="fc7")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")

@@ -1,0 +1,40 @@
+"""MobileNet v1 symbol (reference parity:
+example/image-classification/symbols/mobilenet.py — Howard 2017
+depthwise-separable convolutions via ``num_group``)."""
+import mxnet_tpu as mx
+
+
+def conv_bn(data, num_filter, kernel, stride, pad, num_group=1, name=None):
+    conv = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                              stride=stride, pad=pad, num_group=num_group,
+                              no_bias=True, name="%s_conv" % name)
+    bn = mx.sym.BatchNorm(conv, fix_gamma=False, name="%s_bn" % name)
+    return mx.sym.Activation(bn, act_type="relu", name="%s_relu" % name)
+
+
+def dw_block(data, dw_channels, channels, stride, name):
+    """depthwise 3x3 + pointwise 1x1"""
+    dw = conv_bn(data, dw_channels, (3, 3), stride, (1, 1),
+                 num_group=dw_channels, name="%s_dw" % name)
+    return conv_bn(dw, channels, (1, 1), (1, 1), (0, 0), name="%s_pw" % name)
+
+
+def get_symbol(num_classes=1000, multiplier=1.0, **kwargs):
+    def ch(c):
+        return max(8, int(c * multiplier))
+
+    data = mx.sym.Variable("data")
+    net = conv_bn(data, ch(32), (3, 3), (2, 2), (1, 1), name="conv1")
+    cfg = [(ch(32), ch(64), 1), (ch(64), ch(128), 2), (ch(128), ch(128), 1),
+           (ch(128), ch(256), 2), (ch(256), ch(256), 1),
+           (ch(256), ch(512), 2)] + \
+          [(ch(512), ch(512), 1)] * 5 + \
+          [(ch(512), ch(1024), 2), (ch(1024), ch(1024), 1)]
+    for i, (dw_c, c, s) in enumerate(cfg):
+        net = dw_block(net, dw_c, c, (s, s), name="block%d" % i)
+    net = mx.sym.Pooling(net, global_pool=True, kernel=(1, 1),
+                         pool_type="avg", name="global_pool")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")

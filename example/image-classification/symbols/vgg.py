@@ -1,0 +1,38 @@
+"""VGG 11/13/16/19 symbol (reference parity:
+example/image-classification/symbols/vgg.py — Simonyan & Zisserman
+2014; ``--num-layers`` selects the variant)."""
+import mxnet_tpu as mx
+
+VGG_SPEC = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
+    if num_layers not in VGG_SPEC:
+        raise ValueError("vgg depth must be one of %s" % list(VGG_SPEC))
+    layers, filters = VGG_SPEC[num_layers]
+    net = mx.sym.Variable("data")
+    for i, (num, filt) in enumerate(zip(layers, filters)):
+        for j in range(num):
+            net = mx.sym.Convolution(net, num_filter=filt, kernel=(3, 3),
+                                     pad=(1, 1),
+                                     name="conv%d_%d" % (i + 1, j + 1))
+            if batch_norm:
+                net = mx.sym.BatchNorm(net, name="bn%d_%d" % (i + 1, j + 1))
+            net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4096, name="fc6")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=4096, name="fc7")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")

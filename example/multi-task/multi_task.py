@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Multi-task training: one backbone, two output heads, joint loss.
+
+Reference parity: ``example/multi-task/example_multi_task.py`` — a
+Group symbol with two SoftmaxOutputs, a Module with two labels, and a
+per-task accuracy metric.
+
+Task A: classify the digit (10-way).  Task B: parity of the digit
+(2-way).  Both supervised from the same synthetic image.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def make_data(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.rand(n, 256).astype(np.float32) * 0.1
+    for i in range(n):
+        x[i, y[i] * 25:(y[i] + 1) * 25] += 0.9
+    return x, y.astype(np.float32), (y % 2).astype(np.float32)
+
+
+class MultiTaskIter(mx.io.DataIter):
+    """Wraps NDArrayIter to provide two labels."""
+
+    def __init__(self, x, y_digit, y_parity, batch_size):
+        super().__init__(batch_size)
+        self._it = mx.io.NDArrayIter(
+            {"data": x}, {"digit_label": y_digit, "parity_label": y_parity},
+            batch_size, shuffle=True)
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+
+def build_symbol():
+    data = mx.sym.Variable("data")
+    body = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    body = mx.sym.Activation(body, act_type="relu")
+    digit = mx.sym.FullyConnected(body, num_hidden=10, name="fc_digit")
+    digit = mx.sym.SoftmaxOutput(digit, mx.sym.Variable("digit_label"),
+                                 name="digit")
+    parity = mx.sym.FullyConnected(body, num_hidden=2, name="fc_parity")
+    parity = mx.sym.SoftmaxOutput(parity, mx.sym.Variable("parity_label"),
+                                  name="parity")
+    return mx.sym.Group([digit, parity])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Per-task accuracy (reference example's Multi_Accuracy)."""
+
+    def __init__(self, num=2):
+        self.num = num
+        super().__init__("multi-accuracy")
+
+    def reset(self):
+        self.num_inst = [0] * self.num
+        self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            label = labels[i].asnumpy().astype(int).ravel()
+            self.sum_metric[i] += (pred == label).sum()
+            self.num_inst[i] += len(label)
+
+    def get(self):
+        accs = [s / max(n, 1) for s, n in zip(self.sum_metric,
+                                              self.num_inst)]
+        return (["digit-acc", "parity-acc"], accs)
+
+
+def main():
+    p = argparse.ArgumentParser(description="multi-task example")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--num-epochs", type=int, default=8)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    x, y_digit, y_parity = make_data()
+    it = MultiTaskIter(x, y_digit, y_parity, args.batch_size)
+
+    mod = mx.mod.Module(build_symbol(),
+                        label_names=("digit_label", "parity_label"))
+    metric = MultiAccuracy()
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.init.Xavier(), eval_metric=metric)
+
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    names, accs = metric.get()
+    for nm, a in zip(names, accs):
+        logging.info("%s: %.4f", nm, a)
+    assert min(accs) > 0.9, "multi-task model failed to learn: %s" % (accs,)
+
+
+if __name__ == "__main__":
+    main()

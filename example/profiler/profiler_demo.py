@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Profile a training loop and dump a chrome://tracing JSON.
+
+Reference parity: ``example/profiler/profiler_ndarray.py`` /
+``profiler_executor.py`` — set_config, set_state('run'/'stop'),
+instrumented Domains/Tasks/Markers, dump to a trace file viewable in
+chrome://tracing or Perfetto.
+"""
+import argparse
+import json
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import profiler  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description="profiler demo")
+    p.add_argument("--file", type=str, default="/tmp/mxnet_tpu_profile.json")
+    p.add_argument("--iters", type=int, default=20)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    profiler.set_config(filename=args.file, profile_symbolic=True,
+                        profile_imperative=True, aggregate_stats=True)
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    exe = net.simple_bind(data=(32, 64), softmax_label=(32,))
+    rng = np.random.RandomState(0)
+    for k, v in exe.arg_dict.items():
+        if k not in ("data", "softmax_label"):
+            v._data = mx.nd.array(rng.rand(*v.shape).astype(np.float32)
+                                  * 0.1)._data
+    x = rng.rand(32, 64).astype(np.float32)
+    y = (rng.rand(32) * 10).astype(np.float32)
+
+    domain = profiler.Domain("training")
+    profiler.set_state("run")
+    for i in range(args.iters):
+        task = profiler.Task(domain, "step%d" % i)
+        task.start()
+        exe.forward(is_train=True, data=x, softmax_label=y)
+        exe.backward()
+        mx.nd.waitall()
+        task.stop()
+        profiler.Marker(domain, "step_done").mark()
+    profiler.set_state("stop")
+    profiler.dump()
+
+    with open(args.file) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", trace)
+    logging.info("dumped %d trace events to %s", len(events), args.file)
+    assert len(events) >= args.iters, "expected at least one event per step"
+    names = sorted({e.get("name") for e in events if isinstance(e, dict)})
+    logging.info("event kinds: %s", ", ".join(str(n) for n in names[:12]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""INT8 post-training quantization walkthrough.
+
+Reference parity: ``example/quantization/imagenet_gen_qsym.py`` — train
+(or load) an fp32 model, calibrate activation ranges on sample batches,
+emit a quantized symbol + params, and compare fp32 vs int8 accuracy.
+
+Runs fully offline: trains a small convnet on a synthetic shapes
+problem, then quantizes with each calibration mode.  On TPU the int8
+graph lowers to XLA int8 dot/conv with fused re-quantization.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.contrib.quantization import quantize_model  # noqa: E402
+
+
+def make_dataset(n=2048, seed=0):
+    """3-class problem: horizontal bar / vertical bar / blob."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 16, 16).astype(np.float32) * 0.2
+    y = rng.randint(0, 3, n)
+    for i in range(n):
+        if y[i] == 0:
+            x[i, 0, 8, :] += 1.0
+        elif y[i] == 1:
+            x[i, 0, :, 8] += 1.0
+        else:
+            x[i, 0, 6:10, 6:10] += 0.8
+    return x, y.astype(np.float32)
+
+
+def build_symbol(num_classes=3):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3), pad=(1, 1),
+                             name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg", kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def evaluate(sym, arg_params, aux_params, it, batch_size):
+    mod = mx.mod.Module(sym)
+    it.reset()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.set_params(arg_params, aux_params, allow_missing=True)
+    metric = mx.metric.Accuracy()
+    mod.score(it, metric)
+    return metric.get()[1]
+
+
+def main():
+    p = argparse.ArgumentParser(description="int8 quantization example")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--num-epochs", type=int, default=5)
+    p.add_argument("--calib-mode", type=str, default="entropy",
+                   choices=["none", "naive", "entropy"])
+    p.add_argument("--num-calib-batches", type=int, default=4)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    x, y = make_dataset()
+    split = len(x) * 3 // 4
+    train_it = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                                 shuffle=True, label_name="softmax_label")
+    val_it = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size,
+                               label_name="softmax_label")
+
+    sym = build_symbol()
+    mod = mx.mod.Module(sym)
+    mod.fit(train_it, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    arg_params, aux_params = mod.get_params()
+
+    fp32_acc = evaluate(sym, arg_params, aux_params, val_it, args.batch_size)
+    logging.info("fp32 accuracy: %.4f", fp32_acc)
+
+    val_it.reset()
+    qsym, qarg, qaux = quantize_model(
+        sym, arg_params, aux_params,
+        excluded_sym_names=["fc"],       # keep the classifier fp32
+        calib_mode=args.calib_mode, calib_data=val_it,
+        num_calib_examples=args.num_calib_batches * args.batch_size)
+    int8_acc = evaluate(qsym, qarg, qaux, val_it, args.batch_size)
+    logging.info("int8 accuracy (%s calibration): %.4f",
+                 args.calib_mode, int8_acc)
+    logging.info("accuracy drop: %.4f", fp32_acc - int8_acc)
+    return fp32_acc, int8_acc
+
+
+if __name__ == "__main__":
+    main()

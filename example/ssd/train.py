@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Compact SSD single-shot detector, trained end to end on synthetic boxes.
+
+Reference parity: ``example/ssd/train.py`` + ``symbol/symbol_builder.py``
+— a conv backbone with one multibox head per scale, MultiBoxPrior
+anchors, MultiBoxTarget assignment, joint softmax + SmoothL1 loss, and
+MultiBoxDetection + NMS decode at inference.
+
+Offline dataset: images containing one bright axis-aligned rectangle;
+the task is to localize it (single foreground class).  Training runs
+imperatively under autograd with the whole step jit-compiled through
+hybridize-style shape caching; detection quality is reported as mean
+IoU between the top detection and the ground-truth box.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+IMG = 32
+
+
+def make_batch(rng, batch_size):
+    """Images with one random bright rectangle; label (B,1,5) rows
+    [cls, x1, y1, x2, y2] in [0,1] corner units."""
+    x = rng.rand(batch_size, 1, IMG, IMG).astype(np.float32) * 0.1
+    labels = np.zeros((batch_size, 1, 5), np.float32)
+    for i in range(batch_size):
+        w = rng.randint(8, 20)
+        h = rng.randint(8, 20)
+        x0 = rng.randint(0, IMG - w)
+        y0 = rng.randint(0, IMG - h)
+        x[i, 0, y0:y0 + h, x0:x0 + w] += 1.0
+        labels[i, 0] = [0, x0 / IMG, y0 / IMG, (x0 + w) / IMG, (y0 + h) / IMG]
+    return x, labels
+
+
+class SSDNet(mx.gluon.Block):
+    """Backbone + per-scale class/loc heads (1 fg class + background)."""
+
+    def __init__(self, num_classes=2, num_anchors=3, **kw):
+        super().__init__(**kw)
+        self.num_classes = num_classes
+        self.num_anchors = num_anchors
+        with self.name_scope():
+            self.body = nn.Sequential()
+            self.body.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+                          nn.MaxPool2D(2),
+                          nn.Conv2D(32, 3, padding=1, activation="relu"),
+                          nn.MaxPool2D(2))       # 8x8 feature map
+            self.cls_head = nn.Conv2D(num_anchors * num_classes, 3, padding=1)
+            self.loc_head = nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+    def forward(self, x):
+        feat = self.body(x)
+        anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.3, 0.6),
+                                           ratios=(1.0, 2.0), clip=True)
+        B = x.shape[0]
+        # heads emit (B, A*C, H, W); MultiBoxPrior orders anchors
+        # (h, w, a), so move channels last before flattening, then put
+        # classes first: (B, C, N) with N = H*W*A
+        cls_pred = self.cls_head(feat).transpose((0, 2, 3, 1)).reshape(
+            (B, -1, self.num_classes)).transpose((0, 2, 1))
+        loc_pred = self.loc_head(feat).transpose((0, 2, 3, 1)).reshape(
+            (B, -1))                               # (B, N*4)
+        return anchors, cls_pred, loc_pred
+
+
+def train(args):
+    rng = np.random.RandomState(0)
+    net = SSDNet()
+    net.initialize(mx.init.Xavier())
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": args.lr, "momentum": 0.9})
+    cls_loss = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for it in range(args.num_iters):
+        x_np, lab_np = make_batch(rng, args.batch_size)
+        x = nd.array(x_np)
+        label = nd.array(lab_np)
+        with autograd.record():
+            anchors, cls_pred, loc_pred = net(x)
+            loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+                anchors, label, cls_pred, overlap_threshold=0.5,
+                negative_mining_ratio=3.0)
+            # anchors marked ignore_label (-1) by negative mining must not
+            # contribute to the class loss (reference trains through
+            # MultiBoxTarget's sampled subset only)
+            keep = cls_t >= 0
+            sample_weight = keep.astype("float32").expand_dims(axis=-1)
+            n_kept = nd.maximum(keep.astype("float32").sum(),
+                                nd.ones((1,)))
+            # SoftmaxCrossEntropyLoss averages over ALL anchors per image;
+            # rescale so the loss is the mean over KEPT anchors only
+            n_anchors = float(cls_t.shape[1])
+            l_cls = cls_loss(cls_pred.transpose((0, 2, 1)),
+                             nd.maximum(cls_t, nd.zeros_like(cls_t)),
+                             sample_weight).sum() * n_anchors / n_kept
+            # loc loss normalized by positive-anchor count, like the
+            # reference's valid_count normalization
+            n_pos = nd.maximum(loc_mask.sum() / 4.0, nd.ones((1,)))
+            l_loc = nd.smooth_l1((loc_pred - loc_t) * loc_mask,
+                                 scalar=1.0).sum() / n_pos
+            loss = l_cls + l_loc
+        loss.backward()
+        trainer.step(1)
+        if it % args.disp == 0:
+            logging.info("iter %3d  loss %.4f (cls %.4f loc %.4f)",
+                         it, float(loss.asnumpy().sum()),
+                         float(l_cls.asnumpy().sum()),
+                         float(l_loc.asnumpy().sum()))
+    return net
+
+
+def iou(a, b):
+    x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+    x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0.0, x2 - x1) * max(0.0, y2 - y1)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def evaluate(net, n=64, seed=1):
+    rng = np.random.RandomState(seed)
+    x_np, lab_np = make_batch(rng, n)
+    anchors, cls_pred, loc_pred = net(nd.array(x_np))
+    cls_prob = nd.softmax(cls_pred, axis=1)
+    dets = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                        nms_threshold=0.45).asnumpy()
+    ious = []
+    for i in range(n):
+        rows = dets[i]
+        rows = rows[rows[:, 0] >= 0]
+        if not len(rows):
+            ious.append(0.0)
+            continue
+        best = rows[rows[:, 1].argmax()]
+        ious.append(iou(best[2:6], lab_np[i, 0, 1:5]))
+    return float(np.mean(ious))
+
+
+def main():
+    p = argparse.ArgumentParser(description="compact SSD example")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=250)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--disp", type=int, default=25)
+    p.add_argument("--min-iou", type=float, default=0.5,
+                   help="required mean IoU at eval")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = train(args)
+    miou = evaluate(net)
+    logging.info("mean IoU of top detection vs ground truth: %.3f", miou)
+    assert miou > args.min_iou, "detector failed to learn (mIoU=%.3f)" % miou
+    return miou
+
+
+if __name__ == "__main__":
+    main()

@@ -99,15 +99,19 @@ def predict_mode():
 # tape
 # ---------------------------------------------------------------------------
 class _TapeEntry:
-    __slots__ = ("vjp_fn", "in_keys", "out_avals")
+    __slots__ = ("vjp_fn", "in_keys", "out_avals", "out_refs")
 
-    def __init__(self, vjp_fn, in_keys, out_avals):
+    def __init__(self, vjp_fn, in_keys, out_avals, out_refs):
         self.vjp_fn = vjp_fn
         # routing keys snapshotted at record time (in-place rebinds later
         # must not re-route cotangents): ("s", entry_idx, pos) for an op
         # output, ("l", leaf NDArray) for a tracked leaf, None for constants
         self.in_keys = in_keys
         self.out_avals = out_avals
+        # weakrefs to output NDArrays so a LATER attach_grad on an
+        # intermediate (torch retain_grad-style, reference mark_variables)
+        # receives its cotangent during the sweep
+        self.out_refs = out_refs
 
 
 def _tape():
@@ -124,8 +128,11 @@ def _input_key(x):
 
 
 def record_entry(vjp_fn, inputs, outputs, out_avals):
+    import weakref
+
     in_keys = [_input_key(x) for x in inputs]
-    entry = _TapeEntry(vjp_fn, in_keys, list(out_avals))
+    entry = _TapeEntry(vjp_fn, in_keys, list(out_avals),
+                       [weakref.ref(o) for o in outputs])
     tape = _tape()
     idx = len(tape)
     tape.append(entry)
@@ -144,9 +151,11 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         var._grad_req = req
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
-    """Reverse sweep (reference: python/mxnet/autograd.py:243,
-    Imperative::Backward imperative.cc:358)."""
+def _reverse_sweep(heads, head_grads, retain_graph):
+    """Shared reverse sweep over the tape; returns the accumulated leaf
+    cotangents as ``{id(leaf): [leaf, ct]}`` without committing them
+    (reference: Imperative::Backward imperative.cc:358 builds the grad
+    graph once; both ``backward`` and ``grad`` consume it)."""
     import jax.numpy as jnp
 
     if not isinstance(heads, (list, tuple)):
@@ -194,6 +203,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 g = jnp.zeros(aval.shape, aval.dtype)
             else:
                 touched = True
+                # marked intermediate output (attach_grad after the op
+                # ran): deposit its cotangent like a leaf
+                out_nd = entry.out_refs[pos]()
+                if out_nd is not None and getattr(out_nd, "_ag_leaf", False) \
+                        and getattr(out_nd, "_grad", None) is not None:
+                    _route(("l", out_nd), g)
             out_cts.append(g)
         if not touched:
             continue
@@ -203,13 +218,62 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if g is None or (hasattr(g, "dtype") and g.dtype == float0):
                 continue
             _route(key, g)
+    if not retain_graph:
+        tape.clear()
+    return leaf_cts
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Reverse sweep committing into the leaves' attached grad buffers
+    (reference: python/mxnet/autograd.py:243)."""
+    leaf_cts = _reverse_sweep(heads, head_grads, retain_graph)
     for leaf, g in leaf_cts.values():
         if leaf._grad_req == "add":
             leaf._grad._data = leaf._grad._data + g
         else:
             leaf._grad._data = g.astype(leaf._grad._data.dtype)
-    if not retain_graph:
-        tape.clear()
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of ``heads`` w.r.t. ``variables`` as new arrays,
+    WITHOUT touching the variables' ``.grad`` buffers (reference:
+    python/mxnet/autograd.py:270).
+
+    ``create_graph=True`` (higher-order differentiation through the
+    imperative tape) is not supported in this build — compose
+    ``jax.grad`` over a pure function, or use the symbolic executor,
+    for higher-order derivatives."""
+    from .ndarray.ndarray import NDArray, _wrap
+
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True is not supported by the tape; use jax.grad "
+            "composition or the symbolic executor for higher-order grads")
+    single = not isinstance(variables, (list, tuple))
+    var_list = [variables] if single else list(variables)
+    for v in var_list:
+        if not isinstance(v, NDArray):
+            raise MXNetError("variables must be NDArrays")
+        if not getattr(v, "_ag_leaf", False) or \
+                getattr(v, "_grad", None) is None:
+            raise MXNetError(
+                "cannot differentiate with respect to a variable that is "
+                "not marked for gradient; call attach_grad() (or "
+                "mark_variables) on it BEFORE the recorded computation")
+    if retain_graph is None:
+        retain_graph = False
+    leaf_cts = _reverse_sweep(heads, head_grads, retain_graph)
+    outs = []
+    for v in var_list:
+        hit = leaf_cts.get(id(v))
+        if hit is None:
+            raise MXNetError(
+                "a requested variable is not reachable from the heads in "
+                "the recorded graph (reference: Imperative::Backward "
+                "raises for unreachable gradient nodes)")
+        outs.append(_wrap(hit[1]))
+    return outs[0] if single else outs
 
 
 def get_symbol(x):  # pragma: no cover - graph export of recorded tape

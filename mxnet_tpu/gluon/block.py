@@ -345,43 +345,113 @@ class HybridBlock(Block):
             "in_units/in_channels." % self.name)
 
     # -- jitted execution ----------------------------------------------------
+    def _subtree_hybrid_blocks(self):
+        """All HybridBlock descendants including self, depth first."""
+        found = []
+
+        def walk(b):
+            if isinstance(b, HybridBlock):
+                found.append(b)
+            for c in b._children.values():
+                walk(c)
+        walk(self)
+        return found
+
     def _call_jitted(self, *inputs, **params):
+        """One XLA program for the whole subtree (the reference's CachedOp,
+        cached_op.cc — here: jit of the inlined hybrid_forward).
+
+        EVERY parameter of the subtree (not just this block's own) enters
+        the program as a traced input, so gradients flow to nested
+        children, and any parameter the traced body mutates (BatchNorm
+        running stats and other aux states) leaves the program as an
+        extra output that is committed back after execution — explicit
+        state threading instead of the reference's in-place aux writes."""
         import jax
 
         flat_in, in_fmt = _flatten(list(inputs), "input")
-        param_names = sorted(params)
-        param_arrays = [params[k] for k in param_names]
+        all_params = self.collect_params()
+        pnames = list(all_params.keys())
+        try:
+            pdatas = [all_params[n].data() for n in pnames]
+        except DeferredInitializationError:
+            # one eager pass materializes deferred child shapes.  It runs
+            # in PREDICT mode with the subtree deactivated: train mode
+            # would double-update BatchNorm running stats (this pass +
+            # the jitted run), and active children would burn throwaway
+            # per-child compilations.
+            subtree = self._subtree_hybrid_blocks()
+            prev_active = [b._active for b in subtree]
+            for b in subtree:
+                b._active = False
+            try:
+                with autograd.pause(train_mode=False):
+                    self.hybrid_forward(ndarray, *inputs, **params)
+            finally:
+                for b, a in zip(subtree, prev_active):
+                    b._active = a
+            pdatas = [all_params[n].data() for n in pnames]
+        pobjs = [all_params[n] for n in pnames]
+        # this block's own registered params, located inside the subtree
+        # list by identity so hybrid_forward kwargs use the traced values
+        own_idx = {}
+        for short, p in self._reg_params.items():
+            for i, q in enumerate(pobjs):
+                if q is p:
+                    own_idx[short] = i
+                    break
         is_train = autograd.is_training()
         key_sig = (tuple((tuple(a.shape), str(a.dtype)) for a in flat_in
                          if a is not None),
-                   tuple((tuple(p.shape), str(p.dtype)) for p in param_arrays),
+                   tuple((tuple(p.shape), str(p.dtype)) for p in pdatas),
                    is_train, tuple(in_fmt) if isinstance(in_fmt, list) else in_fmt)
         entry = self._jit_cache.get(key_sig)
         if entry is None:
             block = self
-            entry = {"out_fmt": None}
+            entry = {"out_fmt": None, "mutated": None}
 
             def raw_fn(rng_key, *arrays):
                 n_in = len(flat_in)
                 ins = [NDArray(a) if a is not None else None
                        for a in arrays[:n_in]]
-                ps = {k: NDArray(a) for k, a in
-                      zip(param_names, arrays[n_in:])}
+                traced_nds = [NDArray(a) for a in arrays[n_in:]]
                 regrouped, _ = _regroup(ins, in_fmt)
                 if not isinstance(regrouped, list):
                     regrouped = [regrouped]
-                with autograd.pause(train_mode=is_train), \
-                        _mxrandom.trace_key_scope(rng_key):
-                    out = block.hybrid_forward(ndarray, *regrouped, **ps)
+                # inline the whole subtree: children run their eager path
+                # under this trace, reading params through _trace_data
+                subtree = block._subtree_hybrid_blocks()
+                prev_active = [b._active for b in subtree]
+                for b in subtree:
+                    b._active = False
+                for p, tnd in zip(pobjs, traced_nds):
+                    p._trace_data = tnd
+                ps = {short: traced_nds[i] for short, i in own_idx.items()}
+                try:
+                    with autograd.pause(train_mode=is_train), \
+                            _mxrandom.trace_key_scope(rng_key):
+                        out = block.hybrid_forward(ndarray, *regrouped, **ps)
+                finally:
+                    for p in pobjs:
+                        p._trace_data = None
+                    for b, a in zip(subtree, prev_active):
+                        b._active = a
                 flat_out, out_fmt = _flatten(out, "output")
                 entry["out_fmt"] = out_fmt  # recorded at trace time
-                return tuple(o._data for o in flat_out)
+                # params whose bound stand-in was rebound by an in-place
+                # aux write (mutate_aux ops) are threaded out as outputs
+                mutated = [i for i, (a, tnd) in
+                           enumerate(zip(arrays[n_in:], traced_nds))
+                           if tnd._data is not a]
+                entry["mutated"] = mutated
+                return tuple(o._data for o in flat_out) + \
+                    tuple(traced_nds[i]._data for i in mutated)
 
             entry["fn"] = jax.jit(raw_fn)
             self._jit_cache[key_sig] = entry
 
         rng_key = _mxrandom.next_key()
-        arrays = list(flat_in) + param_arrays
+        arrays = list(flat_in) + pdatas
 
         def wrapper(*datas, _fn=entry["fn"], _key=rng_key):
             return _fn(_key, *datas)
@@ -389,6 +459,12 @@ class HybridBlock(Block):
         outs = invoke_fn(wrapper, arrays)
         if not isinstance(outs, list):
             outs = [outs]
+        mutated = entry["mutated"] or []
+        if mutated:
+            n_main = len(outs) - len(mutated)
+            for j, i in enumerate(mutated):
+                pdatas[i]._data = outs[n_main + j]._data
+            outs = outs[:n_main]
         out_fmt = entry["out_fmt"]
         if out_fmt is None:
             out_fmt = 0 if len(outs) == 1 else [0] * len(outs)

@@ -22,9 +22,10 @@ from .. import recordio
 from ..base import MXNetError
 from ..ndarray import NDArray
 
-__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
-           "random_crop", "center_crop", "color_normalize",
-           "random_size_crop", "Augmenter", "SequentialAug", "ResizeAug",
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "scale_down",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "Augmenter", "SequentialAug",
+           "RandomOrderAug", "ResizeAug",
            "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
            "CenterCropAug", "HorizontalFlipAug", "CastAug",
            "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
@@ -85,6 +86,18 @@ def imresize(src, w, h, interp=1):
     """Resize to (w, h) (reference: image.py imresize)."""
     a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
     return ndarray.array(_np_resize(a, w, h), dtype=a.dtype)
+
+
+def scale_down(src_size, size):
+    """Scale (w, h) down to fit within src_size, keeping aspect ratio
+    (reference: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
 
 
 def resize_short(src, size, interp=2):
@@ -192,6 +205,23 @@ class SequentialAug(Augmenter):
 
     def __call__(self, src):
         for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in a random order (reference: image.py
+    RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        import random as _pyrandom
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for aug in ts:
             src = aug(src)
         return src
 

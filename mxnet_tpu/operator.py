@@ -139,3 +139,51 @@ def _invoke_custom(op_type, inputs, kwargs):
     if len(out_data) == 1:
         return out_data[0]
     return out_data
+
+
+class PythonOp:
+    """Legacy v0.x custom-op base (reference: operator.py PythonOp —
+    deprecated there in favor of CustomOp; kept for surface parity).
+    Use :class:`CustomOp` + :class:`CustomOpProp` instead."""
+
+    def __init__(self, need_top_grad=True):
+        import warnings
+        warnings.warn("PythonOp is deprecated; subclass mx.operator.CustomOp "
+                      "and register a CustomOpProp", DeprecationWarning)
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise MXNetError(
+            "the legacy PythonOp symbolic path is not implemented in this "
+            "build; port the op to mx.operator.CustomOp/CustomOpProp "
+            "(reference: operator.py:426,472)")
+
+    def forward(self, in_data, out_data):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy custom op (reference: operator.py NumpyOp)."""
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray custom op (reference: operator.py NDArrayOp)."""

@@ -189,10 +189,15 @@ class ParallelTrainer:
 
         state_shardings = jax.tree_util.tree_map(
             lambda _: None, self._opt_state)  # let GSPMD propagate
+        # out_shardings must pin new_params to the SAME canonical specs as
+        # in_shardings: the step's outputs feed the next step's args, and
+        # without the pin GSPMD may emit e.g. a tp-sharded bias, which the
+        # next call then rejects as an in_sharding mismatch.
         self._jit_step = jax.jit(
             step,
             in_shardings=(param_shardings, state_shardings, batch_sharding,
                           batch_sharding, None),
+            out_shardings=(param_shardings, state_shardings, None),
             donate_argnums=(0, 1) if self._donate else ())
 
         def evaluate(params, x, key):

@@ -105,3 +105,24 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
     return _make_symbol_call("_arange", [], {
         "start": start, "stop": stop, "step": step, "repeat": repeat,
         "dtype": dtype})
+
+
+# -- module-level arithmetic helpers (reference: symbol.py defines
+# maximum/minimum/pow/... dispatching Symbol-vs-scalar).  Dispatch
+# delegates to Symbol._binop, the one implementation. -----------------------
+def _module_binop(array_op, scalar_op, rscalar_op=None):
+    def helper(lhs, rhs):
+        if isinstance(lhs, Symbol):
+            return lhs._binop(rhs, array_op, scalar_op)
+        if isinstance(rhs, Symbol):
+            # scalar on the left: mirrored scalar op when not commutative
+            return rhs._binop(lhs, array_op, rscalar_op or scalar_op,
+                              reverse=True)
+        raise TypeError("at least one operand must be a Symbol")
+    helper.__name__ = array_op.lstrip("_")
+    return helper
+
+
+maximum = _module_binop("_maximum", "_maximum_scalar")
+minimum = _module_binop("_minimum", "_minimum_scalar")
+hypot = _module_binop("_hypot", "_hypot_scalar")

@@ -1,0 +1,187 @@
+"""Long-tail API parity: module-level helpers and legacy surfaces that
+reference scripts import (python/mxnet/{ndarray,symbol,autograd,
+initializer,optimizer,io,image,operator,test_utils}.py top-level names).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu import test_utils as tu
+
+
+def test_module_level_arith_helpers():
+    x = nd.array(np.array([1.0, 5.0, 3.0], np.float32))
+    assert np.array_equal(nd.maximum(x, 2.0).asnumpy(), [2, 5, 3])
+    assert np.array_equal(nd.maximum(2.0, x).asnumpy(), [2, 5, 3])
+    assert np.array_equal(nd.minimum(x, 2.0).asnumpy(), [1, 2, 2])
+    assert np.allclose(nd.divide(6.0, x).asnumpy(), [6, 1.2, 2])
+    assert np.array_equal(nd.subtract(1.0, x).asnumpy(), [0, -4, -2])
+    assert np.array_equal(nd.greater(2.0, x).asnumpy(), [1, 0, 0])
+    assert np.array_equal(nd.lesser(2.0, x).asnumpy(), [0, 1, 1])
+    assert np.array_equal(nd.add(x, x).asnumpy(), [2, 10, 6])
+    assert np.array_equal(nd.multiply(x, 2.0).asnumpy(), [2, 10, 6])
+    assert np.array_equal(nd.power(x, 2.0).asnumpy(), [1, 25, 9])
+    assert np.array_equal(
+        nd.logical_and(x, nd.zeros_like(x)).asnumpy(), [0, 0, 0])
+    with pytest.raises(TypeError):
+        nd.maximum(1.0, 2.0)
+
+
+def test_symbol_level_arith_helpers():
+    a = mx.sym.Variable("a")
+    exe = mx.sym.maximum(a, 2.0).simple_bind(a=(3,))
+    exe.forward(is_train=False, a=np.array([1.0, 5.0, 3.0], np.float32))
+    assert np.array_equal(exe.outputs[0].asnumpy(), [2, 5, 3])
+    exe2 = mx.sym.minimum(a, mx.sym.Variable("b")).simple_bind(a=(2,), b=(2,))
+    exe2.forward(is_train=False, a=np.array([1.0, 9.0], np.float32),
+                 b=np.array([4.0, 4.0], np.float32))
+    assert np.array_equal(exe2.outputs[0].asnumpy(), [1, 4])
+
+
+def test_autograd_grad():
+    """Reference: autograd.py:270 mx.autograd.grad."""
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    w = nd.array(np.array([2.0], np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = (x * x * w).sum()
+    gx, gw = autograd.grad(y, [x, w])
+    assert np.allclose(gx.asnumpy(), 2 * np.array([1, 2, 3]) * 2.0)
+    assert np.allclose(gw.asnumpy(), [14.0])
+    # .grad buffers must NOT be written
+    assert float(abs(x.grad.asnumpy()).sum()) == 0
+    # unmarked variable -> error, never silent zeros
+    u = nd.ones((3,))
+    with autograd.record():
+        y = (x * u).sum()
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad(y, u)
+    # marked but unreachable variable -> error (reference raises too)
+    z = nd.ones((2,))
+    z.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad(y, z)
+    with autograd.record():
+        y = (x * x).sum()
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad(y, x, create_graph=True)
+
+
+def test_autograd_grad_intermediate():
+    """attach_grad on an op OUTPUT (torch retain_grad-style, reference
+    mark_variables on intermediates) must receive its cotangent."""
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    a.attach_grad()
+    with autograd.record():
+        t = a * 2
+        t.attach_grad()
+        z = (t * 3).sum()
+    gt = autograd.grad(z, t, retain_graph=True)
+    assert np.allclose(gt.asnumpy(), [3.0, 3.0])
+    z.backward()
+    assert np.allclose(t.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_fused_rnn_initializer():
+    """Reference: initializer.py FusedRNN — forget-gate bias in BOTH
+    bi and bh slices, weights initialized per packed 2-D matrix (so
+    Xavier's fan computation sees real shapes)."""
+    init = mx.init.FusedRNN(mx.init.Uniform(0.1), num_hidden=4,
+                            num_layers=2, mode="lstm", forget_bias=2.0)
+    n = 4 * 4 * 3 + 3 * (4 * 4 * 4) + 2 * 2 * 16
+    arr = nd.zeros((n,))
+    init("lstm_parameters_weight", arr)
+    blob = arr.asnumpy()
+    bias = blob[-64:]
+    assert np.allclose(bias[4:8], 2.0)        # bi forget slice, layer 0
+    assert np.allclose(bias[16 + 4:16 + 8], 2.0)  # bh forget slice
+    assert np.allclose(bias[0:4], 0.0)
+    assert np.allclose(bias[32 + 4:32 + 8], 2.0)  # layer 1 bi
+    assert abs(blob[: n - 64]).max() > 0
+    # Xavier (2-D-only) must work through the packed blob
+    xinit = mx.init.FusedRNN(mx.init.Xavier(), num_hidden=4,
+                             num_layers=1, mode="lstm")
+    n1 = 4 * 4 * 3 + 4 * 4 * 4 + 2 * 16
+    arr1 = nd.zeros((n1,))
+    xinit("lstm_parameters_weight", arr1)
+    assert abs(arr1.asnumpy()[: n1 - 32]).max() > 0
+
+
+def test_ccsgd_alias():
+    opt = mx.optimizer.create("ccsgd", learning_rate=0.1)
+    assert isinstance(opt, mx.optimizer.SGD)
+
+
+def test_mxdataiter_shim():
+    inner = mx.io.NDArrayIter(np.zeros((8, 3), np.float32),
+                              np.zeros(8, np.float32), 4)
+    it = mx.io.MXDataIter(inner)
+    assert it.next().data[0].shape == (4, 3)
+    it.reset()
+    with pytest.raises(mx.base.MXNetError):
+        mx.io.MXDataIter()
+
+
+def test_image_scale_down_and_random_order_aug():
+    assert mx.image.scale_down((60, 40), (80, 70)) == (45, 40)
+    assert mx.image.scale_down((100, 100), (50, 50)) == (50, 50)
+    calls = []
+
+    class A(mx.image.Augmenter):
+        def __init__(self, tag):
+            super().__init__()
+            self.tag = tag
+
+        def __call__(self, src):
+            calls.append(self.tag)
+            return src
+
+    aug = mx.image.RandomOrderAug([A(1), A(2), A(3)])
+    aug(nd.zeros((4, 4, 3)))
+    assert sorted(calls) == [1, 2, 3]
+
+
+def test_legacy_op_shims():
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        op = mx.operator.NumpyOp()
+    assert op.list_arguments() == ["data"]
+    with pytest.raises(mx.base.MXNetError):
+        op()
+
+
+def test_test_utils_long_tail():
+    assert tu.np_reduce(np.ones((2, 3, 4)), 1, True, np.sum).shape == (2, 1, 4)
+    loc, _ = tu.find_max_violation(np.array([1.0, 2.0]),
+                                   np.array([1.0, 2.1]))
+    assert loc == (1,)
+    assert tu.almost_equal_ignore_nan(np.array([np.nan, 1.0]),
+                                      np.array([np.nan, 1.0]))
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    out = tu.simple_forward(mx.sym.Variable("a") * 2,
+                            a=np.ones((2, 2), np.float32))
+    assert (out == 2).all()
+    a = nd.ones((3,))
+    assert tu.same_array(a, a)
+    assert not tu.same_array(nd.ones((3,)), nd.ones((3,)))
+    it = mx.io.NDArrayIter(np.zeros((8, 3), np.float32),
+                           np.zeros(8, np.float32), 4)
+    dummy = tu.DummyIter(it)
+    assert dummy.next() is dummy.next()
+    rng = np.random.RandomState(0)
+    buckets, probs = tu.gen_buckets_probs_with_ppf(lambda q: q, 5)
+    tu.verify_generator(lambda n: rng.uniform(size=n), buckets, probs,
+                        nsamples=50000, nrepeat=2)
+    assert tu.mean_check(lambda n: rng.normal(0, 1, n), 0, 1,
+                         nsamples=50000)
+    assert tu.var_check(lambda n: rng.normal(0, 1, n), 1, nsamples=50000)
+    assert tu.check_speed(mx.sym.Variable("a") + 1,
+                          {"a": np.ones((4, 4), np.float32)}, N=2) >= 0
+    assert tu.list_gpus() == []
+    with pytest.raises(mx.base.MXNetError):
+        tu.download("http://example.com/file.bin", fname="/tmp/никогда")

@@ -73,6 +73,9 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     for rank in range(2):
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
+        # CPU worker: keep the TPU-tunnel plugin out (single shared relay
+        # connection can wedge concurrent interpreters)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update({
             "DMLC_ROLE": "worker",
             "DMLC_PS_ROOT_URI": "127.0.0.1",

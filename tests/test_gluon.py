@@ -314,3 +314,92 @@ def test_hybridize_with_dropout_differs_across_calls():
         y1 = net(x).asnumpy()
         y2 = net(x).asnumpy()
     assert not np.allclose(y1, y2), "dropout mask must differ across calls"
+
+
+def test_hybridize_nested_block_grads():
+    """Composite HybridBlocks (model-zoo style) must propagate gradients
+    to CHILD parameters under hybridize — the subtree jit takes every
+    nested parameter as a program input (reference: CachedOp includes
+    all graph inputs, cached_op.cc)."""
+    import numpy as np
+
+    class Custom(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.features = nn.HybridSequential()
+                self.features.add(nn.Dense(8, in_units=4, activation="relu"))
+                self.output = nn.Dense(3, in_units=8)
+
+        def hybrid_forward(self, F, x):
+            return self.output(self.features(x))
+
+    net = Custom()
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    y = nd.array(np.array([0.0, 1.0], np.float32))
+    out_eager = net(x).asnumpy()
+    net.hybridize()
+    out_hyb = net(x).asnumpy()
+    assert np.allclose(out_eager, out_hyb, atol=1e-6)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with mx.autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    g = net.features[0].weight.grad()
+    assert float(abs(g.asnumpy()).sum()) > 0, \
+        "child-parameter gradient lost under hybridize"
+
+
+def test_hybridize_batchnorm_aux_updates():
+    """BatchNorm running stats must update during hybridized train-mode
+    forwards: mutated aux params are threaded out of the jitted program
+    and committed back (reference: stateful aux writes in CachedOp)."""
+    import numpy as np
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    bn.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(8, 4, 5, 5)
+                 .astype(np.float32) * 3 + 1)
+    before = bn.running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        bn(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after), \
+        "running_mean frozen under hybridize"
+    # eval mode must NOT move the stats
+    frozen = bn.running_mean.data().asnumpy().copy()
+    bn(x)
+    assert np.allclose(frozen, bn.running_mean.data().asnumpy())
+
+
+def test_hybridize_deferred_init_single_bn_update():
+    """The deferred-shape materialization pass inside the subtree jit
+    must not touch BatchNorm running stats: exactly ONE momentum update
+    per recorded train-mode forward."""
+    import numpy as np
+
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.conv = nn.Conv2D(4, 3, padding=1, in_channels=2)
+                self.bn = nn.BatchNorm()  # deferred in_channels
+
+        def hybrid_forward(self, F, x):
+            return self.bn(self.conv(x))
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(4, 2, 5, 5)
+                 .astype(np.float32))
+    with mx.autograd.record():
+        out = net(x)
+    conv_out = net.conv(x).asnumpy()
+    batch_mean = conv_out.mean(axis=(0, 2, 3))
+    rm = net.bn.running_mean.data().asnumpy()
+    # one update with momentum 0.9: rm = 0.1 * batch_mean
+    assert np.allclose(rm, 0.1 * batch_mean, atol=1e-5), \
+        "running_mean saw %s updates" % (rm / np.where(
+            batch_mean == 0, 1, batch_mean))

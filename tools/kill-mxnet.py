@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""kill-mxnet — terminate distributed training processes on this host.
+
+Reference parity: ``tools/kill-mxnet.py`` — after an aborted
+distributed run, stray scheduler/server/worker processes can hold the
+rendezvous port.  This sweeps processes whose command line references
+the training script (or the framework's distributed bootstrap) and
+signals them.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def find_procs(pattern):
+    """(pid, cmdline) for processes whose command line contains pattern."""
+    procs = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == os.getpid():
+            continue
+        try:
+            with open("/proc/%s/cmdline" % pid, "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if pattern in cmd and "kill-mxnet" not in cmd:
+            procs.append((int(pid), cmd.strip()))
+    return procs
+
+
+def main():
+    p = argparse.ArgumentParser(description="kill distributed training procs")
+    p.add_argument("pattern", nargs="?", default="mxnet_tpu",
+                   help="substring of the command line to match")
+    p.add_argument("--signal", type=int, default=signal.SIGTERM)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+
+    procs = find_procs(args.pattern)
+    if not procs:
+        print("no processes matching %r" % args.pattern)
+        return 0
+    for pid, cmd in procs:
+        print("%s pid %d: %s" % ("would kill" if args.dry_run else "killing",
+                                 pid, cmd[:120]))
+        if not args.dry_run:
+            try:
+                os.kill(pid, args.signal)
+            except OSError as exc:
+                print("  failed: %s" % exc)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
