@@ -75,11 +75,10 @@ class Executor:
         self._is_loss_graph = bool(symbol._flat_outputs()) and all(
             (not n.is_variable) and n.op.name in _LOSS_OPS
             for (n, _i) in symbol._flat_outputs())
-        # seeded off the global mx.random chain so runs reproduce under
-        # mx.random.seed(n) (see random.py docstring)
+        # keys come from the global host-side counter chain so runs
+        # reproduce under mx.random.seed(n) (see random.py docstring)
         from . import random as _mxrandom
-        self._rng_key = _mxrandom.next_key()
-        self._last_key = self._rng_key
+        self._last_key = _mxrandom.next_key()
 
         fn_train = build_graph_fn(symbol, self.arg_names, self.aux_names, True)
         fn_eval = build_graph_fn(symbol, self.arg_names, self.aux_names, False)
@@ -189,7 +188,12 @@ class Executor:
         fn_train, _cast = self._fn_train, self._cast_fn
         one = self._fused_update[2]
 
-        def fbu(diff, rest, aux, key, seeds, states, lrs, wds):
+        def fbu(diff, rest, aux, key_data, seeds, states, lrs, wds):
+            # the key chain crosses the program boundary as RAW uint32
+            # data: the tunnel backend mishandles extended-dtype (typed
+            # PRNG key) arrays fed back as inputs
+            key = _jax.random.wrap_key_data(key_data, impl="threefry2x32")
+
             def f(diff_args):
                 full = list(rest)
                 for j, i in enumerate(diff_idx):
@@ -209,7 +213,13 @@ class Executor:
             # grads are consumed in-program (XLA frees them); they are not
             # outputs — saves an HBM round-trip per step.  backward() is a
             # no-op in fused mode (grad_dict intentionally not populated).
-            return list(outs), new_diff, new_states, new_aux
+            # The RNG key advances INSIDE the program so back-to-back
+            # steps need no host work at all: step i+1 consumes the key
+            # step i emitted (device-closed chain — the tunnel backend
+            # rejects new host transfers while a program is in flight).
+            new_key = _jax.random.fold_in(key, 1)
+            return (list(outs), new_diff, new_states, new_aux,
+                    _jax.random.key_data(new_key))
 
         # donate weights + optimizer state (exclusively owned: the arg
         # NDArrays are rebound to the outputs right after the call)
@@ -234,11 +244,28 @@ class Executor:
             wds.append(wd)
         lrs = np.asarray(lrs, np.float32)
         wds = np.asarray(wds, np.float32)
+        # device-resident lr/wd cache, refreshed only when the schedule
+        # moves — a fresh host transfer per step would serialize against
+        # the in-flight step on the tunnel backend
+        cached = getattr(self, "_lr_wd_cache", None)
+        if cached is None or not (np.array_equal(cached[0], lrs)
+                                  and np.array_equal(cached[1], wds)):
+            self._lr_wd_cache = (lrs, wds, jnp.asarray(lrs), jnp.asarray(wds))
+        lrs_dev, wds_dev = self._lr_wd_cache[2], self._lr_wd_cache[3]
+        # key chain: consume the device key-DATA the previous step
+        # emitted; first call seeds from the host counter chain
+        key_dev = getattr(self, "_fused_key", None)
+        if key_dev is None:
+            from . import random as _mxrandom
+            key_dev = _mxrandom.next_key_data()
         seeds = self._default_seeds(args, aux, key)
         if self._jit_fbu is None:
             self._jit_fbu = self._build_fbu()
-        outs, new_diff, new_states, new_aux = self._jit_fbu(
-            diff, rest, aux, key, seeds, self._fused_state, lrs, wds)
+        self._replay_key_data = key_dev  # for backward(out_grads) replay
+        outs, new_diff, new_states, new_aux, new_key = self._jit_fbu(
+            diff, rest, aux, key_dev, seeds, self._fused_state, lrs_dev,
+            wds_dev)
+        self._fused_key = new_key
         self._fused_state = new_states
         for j, i in enumerate(self._diff_idx):
             self.arg_dict[self.arg_names[i]]._data = new_diff[j]
@@ -331,7 +358,12 @@ class Executor:
         return self._outputs
 
     def _next_key(self):
-        self._rng_key, sub = jax.random.split(self._rng_key)
+        # host-side counter chain, like random.next_key(): a device-side
+        # split would dispatch a tiny kernel per step, serializing
+        # against the in-flight train step (the axon tunnel backend
+        # rejects it outright while one is queued)
+        from . import random as _mxrandom
+        sub = _mxrandom.next_key()
         self._last_key = sub
         return sub
 
@@ -358,15 +390,23 @@ class Executor:
                 tgt._data = v._data.astype(tgt.dtype) if v.dtype != tgt.dtype else v._data
             else:
                 tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)
-        args, aux, key = self._args(), self._aux(), self._next_key()
+        args, aux = self._args(), self._aux()
         if is_train and self._fused_update is not None:
+            # steady-state fused steps consume the device-resident key
+            # the previous step emitted — don't mint (device_put) a new
+            # one per call; the tunnel backend rejects transfers while a
+            # step is in flight
+            key = (self._last_key if getattr(self, "_fused_key", None)
+                   is not None else self._next_key())
             outs, new_aux = self._forward_fused(args, aux, key)
         elif is_train and self._diff_idx and self._is_loss_graph:
+            key = self._next_key()
             seeds = self._default_seeds(args, aux, key)
             outs, grads, new_aux = self._jit_fb(args, aux, key, seeds)
             self._cached_grads = grads
             self._updates_applied = False
         else:
+            key = self._next_key()
             outs, new_aux = (self._jit_fwd_train(args, aux, key) if is_train
                              else self._jit_fwd_eval(args, aux, key))
             self._cached_grads = None
@@ -407,8 +447,16 @@ class Executor:
             seeds = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                      for g in out_grads]
             # reuse the key of the preceding forward so stochastic ops
-            # (dropout) see the same mask the user observed
-            args, aux, key = self._args(), self._aux(), self._last_key
+            # (dropout) see the same mask the user observed.  In fused
+            # mode the key advances on-device — _replay_key_data tracks
+            # the key data the last fused step actually consumed.
+            replay = getattr(self, "_replay_key_data", None)
+            if replay is not None:
+                key = jax.random.wrap_key_data(jnp.asarray(replay),
+                                               impl="threefry2x32")
+            else:
+                key = self._last_key
+            args, aux = self._args(), self._aux()
             _, grads, _ = self._jit_fb(args, aux, key, seeds)
         else:
             if self._cached_grads is None:

@@ -3,27 +3,38 @@
 Reference: ``python/mxnet/random.py`` (mx.random.seed) backed by per-device
 RNG resources (src/common/random_generator.h, ResourceManager kRandom).
 
-TPU-native: one counter-based threefry key, split per draw.  Eager random
-ops consume keys from here; jitted executors thread keys functionally
-(each Executor/CachedOp holds its own key chain seeded from this state),
-so results are reproducible under ``mx.random.seed(n)`` in both modes.
+TPU-native: a host-side (seed, counter) chain whose bits ARE the
+threefry key — deriving a key never dispatches a device program (see
+next_key).  Eager random ops consume keys from here; executors draw
+per-step keys from the same chain (the fused train step then advances
+its key on-device); results are reproducible under ``mx.random.seed(n)``
+in both modes.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 
-_STATE = {"key": None, "seed": 0, "count": 0}
+_STATE = {"seed": 0, "count": 0}
 
 
 def seed(seed_state=0, ctx="all"):
     """Reference: python/mxnet/random.py:28 (mx.random.seed)."""
     _STATE["seed"] = int(seed_state)
-    _STATE["key"] = jax.random.key(int(seed_state))
     _STATE["count"] = 0
 
 
 def next_key():
-    """Split a fresh subkey off the global chain (runtime internal).
+    """A fresh subkey off the global chain (runtime internal).
+
+    The chain is COUNTER-BASED ON HOST: the key bits are (seed, count)
+    assembled in numpy and reinterpreted via ``wrap_key_data`` — no
+    device program runs.  Deriving keys with ``jax.random.split`` would
+    dispatch a tiny kernel per step, which serializes against an
+    in-flight train step (and the axon tunnel backend rejects it
+    outright while one is queued).  Threefry guarantees independent
+    streams for distinct key bits, so uniqueness == independence.
 
     Inside a jit trace (hybridized blocks), keys must derive from the
     traced key argument — a concrete key would bake one fixed mask into
@@ -32,11 +43,21 @@ def next_key():
         base, counter = _TRACE_KEYS[-1]
         _TRACE_KEYS[-1] = (base, counter + 1)
         return jax.random.fold_in(base, counter)
-    if _STATE["key"] is None:
-        seed(0)
-    _STATE["key"], sub = jax.random.split(_STATE["key"])
+    return jax.random.wrap_key_data(jax.numpy.asarray(next_key_data()),
+                                    impl="threefry2x32")
+
+
+def next_key_data():
+    """Like next_key but returns the RAW uint32[2] threefry key bits as
+    host numpy — for programs that wrap the key inside the jit boundary
+    (executor fused step: typed key arrays don't survive the tunnel
+    backend's output→input round-trip)."""
     _STATE["count"] += 1
-    return sub
+    seed = _STATE["seed"]
+    # mix the high seed bits down so 64-bit seeds keep their entropy in
+    # the 32-bit word (seed=2**32 must differ from seed=0)
+    mixed = (seed ^ (seed >> 32)) & 0xFFFFFFFF
+    return np.array([mixed, _STATE["count"]], np.uint32)
 
 
 _TRACE_KEYS = []
