@@ -122,3 +122,63 @@ def decode_jpeg_batch(payloads, out_hw, resize_short=0, rand_crop=False,
         status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), int(nthreads))
     failed = np.nonzero(status)[0].tolist()
     return out, failed
+
+
+# -- c_predict_api (deployment C ABI) ---------------------------------------
+_PRED_SRC = os.path.join(_HERE, "c_predict_api.cpp")
+_PRED_SO = os.path.join(_HERE, "libmxnet_predict.so")
+_PRED_LOCK = threading.Lock()
+_PRED_LIB = None
+_PRED_TRIED = False
+
+
+def _build_predict_api():
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O2", "-fPIC", "-shared", _PRED_SRC,
+           "-I" + inc, "-o", _PRED_SO + ".tmp"]
+    # linking libpython is only needed for non-Python host programs;
+    # undefined CPython symbols resolve from the running interpreter
+    # when loaded via ctypes
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_PRED_SO + ".tmp", _PRED_SO)
+
+
+def get_predict_lib():
+    """The c_predict_api shared library (reference: c_predict_api.h ABI),
+    built on demand; None when no toolchain is available."""
+    global _PRED_LIB, _PRED_TRIED
+    if _PRED_LIB is not None or _PRED_TRIED:
+        return _PRED_LIB
+    with _PRED_LOCK:
+        if _PRED_LIB is not None or _PRED_TRIED:
+            return _PRED_LIB
+        _PRED_TRIED = True
+        try:
+            from .. import config as _config
+            if _config.get("MXNET_NATIVE_DISABLE"):
+                return _PRED_LIB
+            if (not os.path.exists(_PRED_SO)
+                    or os.path.getmtime(_PRED_SO) < os.path.getmtime(_PRED_SRC)):
+                _build_predict_api()
+            lib = ctypes.CDLL(_PRED_SO, mode=ctypes.RTLD_GLOBAL)
+            u = ctypes.c_uint
+            up = ctypes.POINTER(u)
+            lib.MXGetLastError.restype = ctypes.c_char_p
+            lib.MXPredCreate.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, u, ctypes.POINTER(ctypes.c_char_p), up, up,
+                ctypes.POINTER(ctypes.c_void_p)]
+            lib.MXPredSetInput.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_float), u]
+            lib.MXPredForward.argtypes = [ctypes.c_void_p]
+            lib.MXPredGetOutputShape.argtypes = [
+                ctypes.c_void_p, u, ctypes.POINTER(up), up]
+            lib.MXPredGetOutput.argtypes = [
+                ctypes.c_void_p, u, ctypes.POINTER(ctypes.c_float), u]
+            lib.MXPredFree.argtypes = [ctypes.c_void_p]
+            _PRED_LIB = lib
+        except Exception:
+            _PRED_LIB = None
+    return _PRED_LIB

@@ -1,0 +1,281 @@
+// c_predict_api — C ABI for standalone inference.
+//
+// Reference contract: include/mxnet/c_predict_api.h (MXPredCreate:77,
+// GetOutputShape:120, SetInput:177, Forward:191, GetOutput:213,
+// Free:228; every call returns int, 0 = success, last error through
+// MXGetLastError).  The reference backed this with the full C++ graph
+// executor; the TPU-native deployment unit is a jitted XLA program, so
+// this library drives mxnet_tpu.predictor through the embedded CPython
+// runtime — same ABI, same buffer-in/buffer-out data flow, usable from
+// any C/C++ host program linked against libpython.
+//
+// Build (see native/__init__.py build_predict_api):
+//   g++ -O2 -fPIC -shared c_predict_api.cpp -o libmxnet_predict.so \
+//       $(python3-config --includes --ldflags --embed)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct PredHandle {
+  PyObject* predictor;               // mxnet_tpu.predictor.Predictor
+  std::vector<uint32_t> out_shape;   // scratch for MXPredGetOutputShape
+};
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Capture the pending Python exception into the last-error slot.
+void capture_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Initialize the interpreter once when this library is the host; when
+// loaded INTO a Python process (ctypes), the interpreter already runs
+// and only GIL acquisition is needed.
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // release the GIL taken by initialization so PyGILState_Ensure
+    // works from any caller thread
+    PyEval_SaveThread();
+  }
+}
+
+class GIL {
+ public:
+  GIL() { ensure_python(); state_ = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* predictor_module() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  }
+  return mod;
+}
+
+// {input_key: (d0, d1, ...)} from the C ABI's CSR-style shape arrays.
+PyObject* build_shapes_dict(unsigned num_input_nodes, const char** input_keys,
+                            const unsigned* input_shape_indptr,
+                            const unsigned* input_shape_data) {
+  PyObject* shapes = PyDict_New();
+  for (unsigned i = 0; i < num_input_nodes; ++i) {
+    unsigned lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (unsigned j = lo; j < hi; ++j) {
+      PyTuple_SET_ITEM(shp, j - lo,
+                       PyLong_FromUnsignedLong(input_shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], shp);
+    Py_DECREF(shp);
+  }
+  return shapes;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* PredictorHandle;
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           unsigned num_input_nodes,
+                           const char** input_keys,
+                           const unsigned* input_shape_indptr,
+                           const unsigned* input_shape_data,
+                           unsigned num_output_nodes,
+                           const char** output_keys,
+                           PredictorHandle* out) {
+  GIL gil;
+  PyObject* mod = predictor_module();
+  if (mod == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PyObject* shapes = build_shapes_dict(num_input_nodes, input_keys,
+                                       input_shape_indptr, input_shape_data);
+  PyObject* outputs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(outputs);
+    outputs = PyList_New(num_output_nodes);
+    for (unsigned i = 0; i < num_output_nodes; ++i) {
+      PyList_SET_ITEM(outputs, i, PyUnicode_FromString(output_keys[i]));
+    }
+  }
+  PyObject* params =
+      PyBytes_FromStringAndSize(static_cast<const char*>(param_bytes),
+                                param_size);
+  PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+  PyObject* kwargs = PyDict_New();
+  PyDict_SetItemString(kwargs, "output_names", outputs);
+  PyObject* dev = PyUnicode_FromString(dev_type == 1 ? "cpu" : "tpu");
+  PyObject* args = Py_BuildValue("(sOOOi)", symbol_json_str, params, shapes,
+                                 dev, dev_id);
+  PyObject* pred = (cls != nullptr && args != nullptr)
+                       ? PyObject_Call(cls, args, kwargs)
+                       : nullptr;
+  Py_XDECREF(args);
+  Py_XDECREF(dev);
+  Py_XDECREF(kwargs);
+  Py_XDECREF(cls);
+  Py_DECREF(params);
+  Py_DECREF(outputs);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PredHandle* h = new PredHandle();
+  h->predictor = pred;
+  *out = h;
+  return 0;
+}
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data, PredictorHandle* out) {
+  return MXPredCreatePartialOut(symbol_json_str, param_bytes, param_size,
+                                dev_type, dev_id, num_input_nodes, input_keys,
+                                input_shape_indptr, input_shape_data, 0,
+                                nullptr, out);
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, unsigned size) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* r = PyObject_CallMethod(h->predictor, "set_input_bytes", "sO",
+                                    key, buf);
+  Py_DECREF(buf);
+  if (r == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* r = PyObject_CallMethod(h->predictor, "forward", nullptr);
+  if (r == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
+                         unsigned** shape_data, unsigned* shape_ndim) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* shp = PyObject_CallMethod(h->predictor, "get_output_shape", "I",
+                                      index);
+  if (shp == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  h->out_shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->out_shape[i] = static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+  }
+  Py_DECREF(shp);
+  *shape_data = h->out_shape.data();
+  *shape_ndim = static_cast<unsigned>(n);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, unsigned index, float* data,
+                    unsigned size) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* buf = PyObject_CallMethod(h->predictor, "get_output_bytes", "I",
+                                      index);
+  if (buf == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  char* src = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(buf, &src, &len) != 0 ||
+      static_cast<Py_ssize_t>(size * sizeof(float)) != len) {
+    Py_DECREF(buf);
+    set_error("output size mismatch (expected " + std::to_string(len / 4) +
+              " floats)");
+    return -1;
+  }
+  std::memcpy(data, src, len);
+  Py_DECREF(buf);
+  return 0;
+}
+
+int MXPredReshape(unsigned num_input_nodes, const char** input_keys,
+                  const unsigned* input_shape_indptr,
+                  const unsigned* input_shape_data, PredictorHandle handle,
+                  PredictorHandle* out) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* shapes = build_shapes_dict(num_input_nodes, input_keys,
+                                       input_shape_indptr, input_shape_data);
+  PyObject* pred = PyObject_CallMethod(h->predictor, "reshape", "O", shapes);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  PredHandle* nh = new PredHandle();
+  nh->predictor = pred;
+  *out = nh;
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GIL gil;
+  PredHandle* h = static_cast<PredHandle*>(handle);
+  PyObject* r = PyObject_CallMethod(h->predictor, "free", nullptr);
+  Py_XDECREF(r);
+  PyErr_Clear();
+  Py_DECREF(h->predictor);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

@@ -665,20 +665,32 @@ def save(fname, data):
             f.write(b)
 
 
-def load(fname):
-    """Load NDArrays (reference: mx.nd.load, c_api.cc:279)."""
-    with open(fname, "rb") as f:
-        magic = struct.unpack("<Q", f.read(8))[0]
-        if magic != 0x112:
-            raise MXNetError("invalid NDArray container (magic %x)" % magic)
-        struct.unpack("<Q", f.read(8))
-        n = struct.unpack("<Q", f.read(8))[0]
-        arrays = [_read_ndarray(f) for _ in range(n)]
-        m = struct.unpack("<Q", f.read(8))[0]
-        names = []
-        for _ in range(m):
-            ln = struct.unpack("<Q", f.read(8))[0]
-            names.append(f.read(ln).decode())
+def _load_stream(f):
+    magic = struct.unpack("<Q", f.read(8))[0]
+    if magic != 0x112:
+        raise MXNetError("invalid NDArray container (magic %x)" % magic)
+    struct.unpack("<Q", f.read(8))
+    n = struct.unpack("<Q", f.read(8))[0]
+    arrays = [_read_ndarray(f) for _ in range(n)]
+    m = struct.unpack("<Q", f.read(8))[0]
+    names = []
+    for _ in range(m):
+        ln = struct.unpack("<Q", f.read(8))[0]
+        names.append(f.read(ln).decode())
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+def load(fname):
+    """Load NDArrays (reference: mx.nd.load, c_api.cc:279)."""
+    with open(fname, "rb") as f:
+        return _load_stream(f)
+
+
+def load_buffer(buf):
+    """Load NDArrays from an in-memory container (the byte layout the
+    reference's c_predict_api receives as param_bytes,
+    c_predict_api.cc MXPredCreate)."""
+    import io
+    return _load_stream(io.BytesIO(buf))

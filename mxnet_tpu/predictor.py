@@ -31,8 +31,14 @@ class Predictor:
 
     def __init__(self, symbol_file, param_file, input_shapes,
                  dev_type="tpu", dev_id=0, output_names=None):
-        from .symbol import load as load_symbol
-        sym = load_symbol(symbol_file)
+        from .symbol import load as load_symbol, load_json
+        # c_predict_api contract: the symbol may arrive as the JSON text
+        # itself and the params as the raw container bytes
+        # (c_predict_api.cc MXPredCreate receives buffers, not paths)
+        if isinstance(symbol_file, str) and symbol_file.lstrip()[:1] == "{":
+            sym = load_json(symbol_file)
+        else:
+            sym = load_symbol(symbol_file)
         if output_names:
             outs = sym.get_internals()
             names = outs.list_outputs()
@@ -65,6 +71,18 @@ class Predictor:
         self._inputs[name] = data if isinstance(data, nd.NDArray) \
             else nd.array(np.asarray(data, np.float32))
 
+    def set_input_bytes(self, name, buf):
+        """Raw float32 buffer input — the native c_predict_api data path
+        (native/c_predict_api.cpp MXPredSetInput)."""
+        shape = self._exe.arg_dict[name].shape
+        arr = np.frombuffer(buf, np.float32).reshape(shape)
+        self.set_input(name, arr)
+
+    def get_output_bytes(self, index=0):
+        """Raw float32 output buffer (MXPredGetOutput's copy source)."""
+        return self.get_output(index).asnumpy().astype(
+            np.float32, copy=False).tobytes()
+
     def forward(self, **inputs):
         """MXPredForward (reference: c_predict_api.h:191)."""
         for k, v in inputs.items():
@@ -86,13 +104,17 @@ class Predictor:
         return len(self._sym.list_outputs())
 
     def get_output_shape(self, index=0):
-        """MXPredGetOutputShape (reference: c_predict_api.h:120)."""
+        """MXPredGetOutputShape (reference: c_predict_api.h:120).
+
+        Before any forward, the shape comes from symbol inference — it
+        must NOT run the graph (the canonical C call order sizes the
+        output buffer between SetInput and Forward, and a hidden run
+        would clobber the user's inputs)."""
         if self._outputs is not None:
             return tuple(self._outputs[index].shape)
-        self.forward(**{n: nd.zeros(s) for n, s in zip(
-            self._input_names, [self._exe.arg_dict[n].shape
-                                for n in self._input_names])})
-        return tuple(self._outputs[index].shape)
+        known = {n: self._exe.arg_dict[n].shape for n in self._input_names}
+        _, out_shapes, _ = self._sym.infer_shape(**known)
+        return tuple(out_shapes[index])
 
     def reshape(self, input_shapes):
         """MXPredReshape — rebind with new input shapes sharing params."""
@@ -119,8 +141,15 @@ class Predictor:
 
 def _load_params(param_file):
     """Split a saved param file into arg/aux dicts (prefix convention of
-    model.save_checkpoint: 'arg:name' / 'aux:name')."""
-    loaded = nd.load(param_file)
+    model.save_checkpoint: 'arg:name' / 'aux:name').  Accepts a path or
+    the raw container bytes (c_predict_api param_bytes)."""
+    loaded = (nd.load_buffer(param_file)
+              if isinstance(param_file, (bytes, bytearray, memoryview))
+              else nd.load(param_file))
+    if not isinstance(loaded, dict):
+        raise MXNetError(
+            "param container must map names to arrays (save it from a "
+            "dict, e.g. {'arg:fc_weight': ...}); got a nameless list")
     arg_params, aux_params = {}, {}
     for k, v in loaded.items():
         if k.startswith("arg:"):
