@@ -1,0 +1,50 @@
+"""Metric update throughput benchmark.
+
+Reference: ``tests/python/unittest/test_metric_perf.py`` — measures
+EvalMetric.update cost at training batch rates.  On TPU the device-side
+lazy accumulation (metric.py Accuracy NDArray path) must not force a
+per-batch host sync; this benchmark shows updates/sec with and without
+an interleaved get().
+
+Usage: python metric_perf.py [--batch 256] [--classes 1000]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def bench(metric_name, batch, classes, n, sync_every):
+    kwargs = {"top_k": 5} if metric_name == "top_k_accuracy" else {}
+    m = mx.metric.create(metric_name, **kwargs)
+    preds = nd.array(np.random.rand(batch, classes).astype(np.float32))
+    labels = nd.array(np.random.randint(0, classes, batch).astype(np.float32))
+    m.update([labels], [preds])  # warm
+    m.reset()
+    t0 = time.time()
+    for i in range(n):
+        m.update([labels], [preds])
+        if sync_every and (i + 1) % sync_every == 0:
+            m.get()
+    m.get()
+    dt = time.time() - t0
+    print("%-16s batch=%d sync_every=%-4s %8.0f updates/s"
+          % (metric_name, batch, sync_every or "end", n / dt))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("-n", type=int, default=200)
+    args = ap.parse_args()
+    for name in ("acc", "top_k_accuracy", "mse"):
+        bench(name, args.batch, args.classes, args.n, sync_every=0)
+        bench(name, args.batch, args.classes, args.n, sync_every=20)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""INT8 quantized-op benchmark.
+
+Reference: ``benchmark/python/quantization/benchmark_op.py`` — compares
+quantized conv/FC against the float path.  Here the int8 ops ride the
+MXU's int8 matmul path (mxnet_tpu/ops/quantization.py); the benchmark
+reports the achieved speedup and the quantize/dequantize overhead.
+
+Usage: python benchmark_op.py [--batch 64] [--repeat 20]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _time(fn, repeat):
+    fn().wait_to_read()
+    t0 = time.time()
+    out = None
+    for _ in range(repeat):
+        out = fn()
+    out.wait_to_read()
+    return (time.time() - t0) / repeat
+
+
+def bench_fc(batch, in_dim, out_dim, repeat):
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(batch, in_dim).astype(np.float32))
+    w = nd.array(rng.randn(out_dim, in_dim).astype(np.float32))
+    b = nd.array(rng.randn(out_dim).astype(np.float32))
+    qx, xmin, xmax = nd.contrib.quantize_v2(x)
+    qw, wmin, wmax = nd.contrib.quantize_v2(w)
+
+    t_f = _time(lambda: nd.FullyConnected(x, w, b, num_hidden=out_dim),
+                repeat)
+    t_q = _time(lambda: nd.contrib.quantized_fully_connected(
+        qx, qw, xmin, xmax, wmin, wmax, num_hidden=out_dim)[0], repeat)
+    gflop = 2.0 * batch * in_dim * out_dim / 1e9
+    print("FC %dx%d->%d: fp32 %7.3f ms (%6.1f GFLOP/s)  int8 %7.3f ms "
+          "(%6.1f GOP/s)  speedup %.2fx"
+          % (batch, in_dim, out_dim, t_f * 1e3, gflop / t_f, t_q * 1e3,
+             gflop / t_q, t_f / t_q))
+
+
+def bench_conv(batch, channels, size, repeat):
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(batch, channels, size, size).astype(np.float32))
+    w = nd.array(rng.randn(channels, channels, 3, 3).astype(np.float32))
+    qx, xmin, xmax = nd.contrib.quantize_v2(x)
+    qw, wmin, wmax = nd.contrib.quantize_v2(w)
+
+    t_f = _time(lambda: nd.Convolution(
+        x, w, no_bias=True, kernel=(3, 3), pad=(1, 1),
+        num_filter=channels), repeat)
+    t_q = _time(lambda: nd.contrib.quantized_conv(
+        qx, qw, xmin, xmax, wmin, wmax, kernel=(3, 3), pad=(1, 1),
+        num_filter=channels)[0], repeat)
+    gflop = 2.0 * batch * channels * channels * 9 * size * size / 1e9
+    print("Conv b%d c%d %dx%d: fp32 %7.3f ms (%6.1f GFLOP/s)  int8 "
+          "%7.3f ms (%6.1f GOP/s)  speedup %.2fx"
+          % (batch, channels, size, size, t_f * 1e3, gflop / t_f,
+             t_q * 1e3, gflop / t_q, t_f / t_q))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--repeat", type=int, default=20)
+    args = ap.parse_args()
+    print("device:", mx.current_context())
+    bench_fc(args.batch, 1024, 1024, args.repeat)
+    bench_fc(args.batch, 4096, 4096, args.repeat)
+    bench_conv(args.batch, 64, 56, args.repeat)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""End-to-end sparse linear-classification benchmark.
+
+Reference: ``benchmark/python/sparse/sparse_end2end.py`` — times epochs
+of a wide sparse linear model where only the embedding rows touched by
+a batch are updated.  Exercises Embedding(sparse_grad=True) + the
+row_sparse optimizer path (lazy row updates,
+mxnet_tpu/ndarray/sparse.py) end to end through gluon.Trainer.
+
+Usage: python sparse_end2end.py [--features 100000] [--batches 50]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--features", type=int, default=100000)
+    ap.add_argument("--nnz", type=int, default=64,
+                    help="non-zero features per sample")
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--dense", action="store_true",
+                    help="use a dense-gradient embedding for comparison")
+    args = ap.parse_args()
+
+    net = gluon.nn.Embedding(args.features, 1,
+                             sparse_grad=not args.dense)
+    net.initialize(mx.init.Normal(0.01))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    rng = np.random.RandomState(5)
+
+    def batch():
+        idx = rng.randint(0, args.features, (args.batch_size, args.nnz))
+        val = rng.rand(args.batch_size, args.nnz).astype(np.float32)
+        y = (rng.rand(args.batch_size) > 0.5).astype(np.float32)
+        return nd.array(idx.astype(np.float32)), nd.array(val), nd.array(y)
+
+    def step(idx, val, y):
+        with autograd.record():
+            w_rows = net(idx).reshape((args.batch_size, args.nnz))
+            logits = (w_rows * val).sum(axis=1)
+            loss = loss_fn(logits, y).mean()
+        loss.backward()
+        trainer.step(1)
+        return loss
+
+    step(*batch())  # warm / compile
+    t0 = time.time()
+    samples = 0
+    loss = None
+    for _ in range(args.batches):
+        loss = step(*batch())
+        samples += args.batch_size
+    loss.wait_to_read()
+    dt = time.time() - t0
+    print("%s linear: %d samples in %.2f s -> %.0f samples/s"
+          % ("dense" if args.dense else "sparse", samples, dt,
+             samples / dt))
+
+
+if __name__ == "__main__":
+    main()

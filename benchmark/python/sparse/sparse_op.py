@@ -1,0 +1,77 @@
+"""Sparse operator micro-benchmarks.
+
+Reference: ``benchmark/python/sparse/sparse_op.py`` and ``dot.py`` —
+times csr dot / row_sparse elementwise against the dense equivalents
+at several densities.  The TPU build's sparse compute lowers to
+gather/segment-sum XLA programs (mxnet_tpu/ndarray/sparse.py), so this
+benchmark is the honest record of where sparsity pays off vs. padding
+into the dense MXU path.
+
+Usage: python sparse_op.py [--rows 65536] [--cols 512] [--repeat 10]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _time(fn, repeat):
+    fn().wait_to_read()  # warm / compile
+    t0 = time.time()
+    out = None
+    for _ in range(repeat):
+        out = fn()
+    out.wait_to_read()
+    return (time.time() - t0) / repeat
+
+
+def bench_dot(rows, cols, density, repeat):
+    rng = np.random.RandomState(7)
+    mask = rng.rand(rows, cols) < density
+    a = (rng.randn(rows, cols) * mask).astype(np.float32)
+    b = rng.randn(cols, 64).astype(np.float32)
+    a_csr = sparse.csr_matrix(a)
+    a_dense = nd.array(a)
+    b_nd = nd.array(b)
+    t_sp = _time(lambda: sparse.dot(a_csr, b_nd), repeat)
+    t_dn = _time(lambda: nd.dot(a_dense, b_nd), repeat)
+    gflop = 2.0 * rows * cols * 64 / 1e9
+    print("csr dot  density=%.3f: sparse %7.3f ms (%6.1f GFLOP/s)  "
+          "dense %7.3f ms (%6.1f GFLOP/s)"
+          % (density, t_sp * 1e3, gflop * density / t_sp,
+             t_dn * 1e3, gflop / t_dn))
+
+
+def bench_rsp_elemwise(rows, cols, density, repeat):
+    rng = np.random.RandomState(3)
+    nnz_rows = max(1, int(rows * density))
+    idx = np.sort(rng.choice(rows, nnz_rows, replace=False))
+    vals = rng.randn(nnz_rows, cols).astype(np.float32)
+    rsp = sparse.row_sparse_array((nd.array(vals), nd.array(idx)),
+                                  shape=(rows, cols))
+    dense = nd.array(rsp.asnumpy())
+    t_sp = _time(lambda: rsp * 2.0, repeat)
+    t_dn = _time(lambda: dense * 2.0, repeat)
+    print("rsp scale density=%.3f: sparse %7.3f ms   dense %7.3f ms"
+          % (density, t_sp * 1e3, t_dn * 1e3))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=16384)
+    ap.add_argument("--cols", type=int, default=512)
+    ap.add_argument("--repeat", type=int, default=10)
+    args = ap.parse_args()
+    print("device:", mx.current_context())
+    for density in (0.01, 0.05, 0.25):
+        bench_dot(args.rows, args.cols, density, args.repeat)
+    for density in (0.01, 0.05, 0.25):
+        bench_rsp_elemwise(args.rows, args.cols, density, args.repeat)
+
+
+if __name__ == "__main__":
+    main()

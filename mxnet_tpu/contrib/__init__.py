@@ -2,6 +2,7 @@
 subsystems: quantization, text embeddings, tensorboard bridge, onnx
 importer, contrib op namespaces, DataLoaderIter.
 """
+from . import autograd  # noqa: F401
 from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import tensorboard  # noqa: F401

@@ -1,0 +1,96 @@
+"""Legacy contrib autograd API.
+
+Reference: ``python/mxnet/contrib/autograd.py`` — the pre-1.0
+experimental autograd surface (``set_is_training``/``train_section``/
+``test_section``/``grad_and_loss``/``grad``) that older example code
+imports as ``from mxnet.contrib import autograd``.  Thin adapters over
+the first-class :mod:`mxnet_tpu.autograd` tape; recording is implied by
+the training-state scopes, as in the reference (where one flag covered
+both).
+"""
+from contextlib import contextmanager
+
+from .. import autograd as _ag
+from .. import ndarray as _nd
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set training mode + recording; returns the previous training
+    state (reference: contrib/autograd.py:32)."""
+    prev = _ag.is_training()
+    _ag.set_training(bool(is_train))
+    _ag.set_recording(bool(is_train))
+    return prev
+
+
+@contextmanager
+def train_section():
+    """Scope where gradients are recorded in training mode
+    (reference: contrib/autograd.py:74)."""
+    with _ag.record(train_mode=True):
+        yield
+
+
+@contextmanager
+def test_section():
+    """Scope where recording stops and ops run in inference mode
+    (reference: contrib/autograd.py:88 — the old contrib API had ONE
+    flag covering both training mode and recording, so a test_section
+    nested in a train_section excludes its ops from the tape)."""
+    with _ag.pause(train_mode=False):
+        yield
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: contrib/autograd.py:102."""
+    _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Reference: contrib/autograd.py:123."""
+    _ag.backward(outputs, head_grads=out_grads, retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Deprecated alias of :func:`backward`
+    (reference: contrib/autograd.py:158)."""
+    backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap ``func`` into one returning ``(gradients, loss)``
+    (reference: contrib/autograd.py:163)."""
+
+    def wrapped(*args):
+        assert all(isinstance(a, NDArray) for a in args), \
+            "grad_and_loss requires NDArray arguments"
+        idx = argnum
+        if idx is None:
+            idx = list(range(len(args)))
+        elif isinstance(idx, int):
+            idx = [idx]
+        wrt = [args[i] for i in idx]
+        grads = [_nd.zeros_like(a) for a in wrt]
+        mark_variables(wrt, grads)
+        with train_section():
+            outputs = func(*args)
+        backward([outputs] if isinstance(outputs, NDArray) else outputs)
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Gradient-only version of :func:`grad_and_loss`
+    (reference: contrib/autograd.py:195)."""
+    fn = grad_and_loss(func, argnum)
+
+    def wrapped(*args):
+        return fn(*args)[0]
+
+    return wrapped

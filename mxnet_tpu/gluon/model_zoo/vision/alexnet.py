@@ -46,5 +46,6 @@ def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     """Reference: alexnet.py:77."""
     net = AlexNet(**kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", ctx=ctx, root=root)
     return net

@@ -160,5 +160,6 @@ def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     """Reference: inception.py inception_v3."""
     net = Inception3(**kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", ctx=ctx, root=root)
     return net

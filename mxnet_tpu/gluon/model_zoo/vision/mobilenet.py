@@ -129,11 +129,21 @@ class MobileNetV2(HybridBlock):
         return x
 
 
+def _version_suffix(multiplier):
+    """'1.0'/'0.5'-style suffix used in released weight file names."""
+    suffix = "%.2f" % multiplier
+    if suffix in ("1.00", "0.50"):
+        suffix = suffix[:-1]
+    return suffix
+
+
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     """Reference: mobilenet.py get_mobilenet."""
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenet%s" % _version_suffix(multiplier),
+                        ctx=ctx, root=root)
     return net
 
 
@@ -142,7 +152,9 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
     """Reference: mobilenet.py get_mobilenet_v2."""
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "mobilenetv2_%s" % _version_suffix(multiplier),
+                        ctx=ctx, root=root)
     return net
 
 

@@ -97,7 +97,8 @@ def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
     """Reference: squeezenet.py get_squeezenet."""
     net = SqueezeNet(version, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "squeezenet%s" % version, ctx=ctx, root=root)
     return net
 
 

@@ -60,7 +60,10 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        raise ValueError("pretrained weights unavailable (no network egress)")
+        from ..model_store import load_pretrained
+        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, "vgg%d%s" % (num_layers, batch_norm_suffix),
+                        ctx=ctx, root=root)
     return net
 
 

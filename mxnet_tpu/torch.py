@@ -1,0 +1,143 @@
+"""Torch function bridge: run PyTorch ops on NDArrays.
+
+Reference: ``python/mxnet/torch.py`` — the legacy plugin that exposed
+lua-torch tensor math as ``mx.th.*`` functions over NDArrays (functions
+codegen'd from ``MXFuncDescribe``/``MXFuncGetInfo``, plugin kernels in
+``plugin/torch/torch_function.h``).
+
+The TPU-native equivalent bridges to **PyTorch** through DLPack instead
+of luajit FFI: any ``torch.*`` callable becomes an ``mx.th.*`` callable
+that accepts/returns :class:`NDArray`.  Conversion is zero-copy on CPU
+(``torch.from_dlpack`` on the jax buffer); accelerator-resident arrays
+take a host round-trip, since torch in this build is CPU-only — same
+asymmetry as the reference, whose torch plugin was CPU-only unless
+built with ``USE_CUDA``.
+
+    import mxnet_tpu as mx
+    y = mx.th.sigmoid(x)            # x: mx.nd.NDArray -> NDArray
+    u, s, v = mx.th.linalg.svd(m)   # nested namespaces work too
+
+Explicit converters ``to_torch``/``from_torch`` are exported for users
+who want to hold torch tensors directly.
+"""
+import functools
+import importlib
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array as _mx_array
+
+__all__ = ["to_torch", "from_torch", "TorchModule"]
+
+
+def _torch():
+    try:
+        return importlib.import_module("torch")
+    except ImportError:
+        raise MXNetError(
+            "The torch bridge requires pytorch; it is not importable in "
+            "this environment.")
+
+
+def to_torch(arr, zero_copy=False):
+    """NDArray -> torch.Tensor.
+
+    Copies by default: jax buffers are immutable by contract, and torch
+    in-place ops (``abs_``, ``add_``, ``out=``) on a shared buffer would
+    corrupt the source NDArray behind jax's back.  Pass
+    ``zero_copy=True`` only when the tensor is consumed read-only; the
+    DLPack share then avoids the copy on CPU.
+    """
+    torch = _torch()
+    if not isinstance(arr, NDArray):
+        raise TypeError("to_torch expects an NDArray, got %s" % type(arr))
+    data = arr._data
+    if zero_copy:
+        try:
+            # jax CPU buffers export DLPack directly; torch reads in place
+            return torch.from_dlpack(data)
+        except Exception:
+            pass
+    return torch.from_numpy(_np.array(data))
+
+
+def from_torch(tensor, zero_copy=True):
+    """torch.Tensor -> NDArray.
+
+    DLPack import keeps the buffer shared when jax can consume it;
+    otherwise falls back to a numpy copy (e.g. non-contiguous tensors).
+    """
+    torch = _torch()
+    if not torch.is_tensor(tensor):
+        raise TypeError("from_torch expects a torch.Tensor, got %s"
+                        % type(tensor))
+    if zero_copy and tensor.is_contiguous():
+        try:
+            import jax.numpy as jnp
+            return NDArray(jnp.from_dlpack(tensor))
+        except Exception:
+            pass
+    return _mx_array(tensor.detach().cpu().numpy())
+
+
+def _wrap_result(res):
+    torch = _torch()
+    if torch.is_tensor(res):
+        return from_torch(res)
+    if isinstance(res, (list, tuple)):
+        wrapped = [_wrap_result(r) for r in res]
+        return type(res)(wrapped) if not hasattr(res, "_fields") \
+            else tuple(wrapped)
+    return res
+
+
+def _unwrap_arg(arg):
+    if isinstance(arg, NDArray):
+        return to_torch(arg)
+    if isinstance(arg, (list, tuple)):
+        return type(arg)(_unwrap_arg(a) for a in arg)
+    return arg
+
+
+class TorchModule:
+    """Attribute-dispatching proxy over a torch (sub)module.
+
+    ``mx.th`` is ``TorchModule("torch")``; attribute access returns
+    either a nested :class:`TorchModule` (for submodules like
+    ``torch.linalg``) or a wrapped callable converting NDArray args to
+    torch tensors and torch results back to NDArrays.
+    """
+
+    def __init__(self, path="torch"):
+        self._path = path
+
+    def __repr__(self):
+        return "<TorchModule %s>" % self._path
+
+    def __dir__(self):
+        mod = importlib.import_module(self._path)
+        return dir(mod)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        torch = _torch()
+        mod = importlib.import_module(self._path)
+        try:
+            obj = getattr(mod, name)
+        except AttributeError:
+            raise AttributeError("torch has no attribute %r" % name)
+        import types
+        if isinstance(obj, types.ModuleType):
+            return TorchModule(self._path + "." + name)
+        if not callable(obj):
+            return obj
+
+        @functools.wraps(obj)
+        def wrapped(*args, **kwargs):
+            targs = [_unwrap_arg(a) for a in args]
+            tkwargs = {k: _unwrap_arg(v) for k, v in kwargs.items()}
+            with torch.no_grad():
+                return _wrap_result(obj(*targs, **tkwargs))
+        return wrapped

@@ -227,3 +227,53 @@ def test_dataloader_iter():
     assert len(batches) == 3
     it.reset()
     assert len(list(it)) == 3
+
+
+# -- legacy contrib.autograd (reference: python/mxnet/contrib/autograd.py) --
+
+def test_contrib_autograd_grad_and_loss():
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x, w):
+        return ((x * w) ** 2).sum()
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    w = nd.array(np.array([3.0, 4.0], np.float32))
+    grads, loss = cag.grad_and_loss(f)(x, w)
+    xv, wv = x.asnumpy(), w.asnumpy()
+    assert np.allclose(loss.asnumpy(), ((xv * wv) ** 2).sum())
+    assert np.allclose(grads[0].asnumpy(), 2 * xv * wv * wv)
+    assert np.allclose(grads[1].asnumpy(), 2 * wv * xv * xv)
+    # argnum selects a single wrt
+    g_only = cag.grad(f, argnum=1)(x, w)
+    assert np.allclose(g_only[0].asnumpy(), 2 * wv * xv * xv)
+
+
+def test_contrib_autograd_sections_and_state():
+    import numpy as np
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import autograd as cag
+
+    assert not ag.is_recording()
+    with cag.train_section():
+        assert ag.is_recording() and ag.is_training()
+        # the old contrib API had ONE flag: a test_section excludes its
+        # ops from the tape as well as switching to inference mode
+        with cag.test_section():
+            assert not ag.is_recording() and not ag.is_training()
+    assert not ag.is_recording()
+    prev = cag.set_is_training(True)
+    assert ag.is_training() and ag.is_recording()
+    cag.set_is_training(prev)
+
+    # mark_variables + backward + compute_gradient alias
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    g = nd.zeros_like(x)
+    cag.mark_variables([x], [g])
+    with cag.train_section():
+        y = x * x
+    cag.compute_gradient([y])
+    assert np.allclose(g.asnumpy(), 2 * x.asnumpy())

@@ -1,0 +1,93 @@
+"""Pretrained model store: local-first resolution of .params files.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py (sha1-pinned
+weight cache) wired into every vision constructor's pretrained=True
+path (e.g. resnet.py get_resnet).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+
+def test_get_model_file_from_staged_repo(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    cache = tmp_path / "cache"
+    repo.mkdir()
+    # stage weights under the bare-name convention
+    net = vision.get_model("mobilenet0.25", classes=10)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 3, 32, 32)))
+    net.save_params(str(repo / "mobilenet0.25.params"))
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(repo))
+    path = model_store.get_model_file("mobilenet0.25", root=str(cache))
+    assert os.path.exists(path)
+    assert path.startswith(str(cache))
+    # second resolution hits the cache (remove the repo to prove it)
+    os.remove(str(repo / "mobilenet0.25.params"))
+    path2 = model_store.get_model_file("mobilenet0.25", root=str(cache))
+    assert path2 == path
+
+
+def test_pretrained_constructor_roundtrip(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    src = vision.get_model("mobilenet0.25", classes=1000)
+    src.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.rand(1, 3, 32, 32).astype(np.float32))
+    ref_out = src(x).asnumpy()
+    src.save_params(str(repo / "mobilenet0.25.params"))
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(repo))
+    net = vision.get_model("mobilenet0.25", pretrained=True,
+                           root=str(tmp_path / "cache"))
+    out = net(x).asnumpy()
+    assert np.allclose(out, ref_out, atol=1e-5)
+
+
+def test_missing_weights_raise_clear_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(tmp_path))
+    with pytest.raises(MXNetError, match="resnet18_v1"):
+        model_store.get_model_file("resnet18_v1",
+                                   root=str(tmp_path / "cache"))
+    with pytest.raises(ValueError, match="staged or pinned"):
+        model_store.get_model_file("not_a_model",
+                                   root=str(tmp_path / "cache"))
+
+
+def test_purge(tmp_path):
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    (cache / "foo.params").write_bytes(b"x")
+    model_store.purge(root=str(cache))
+    assert not list(cache.glob("*.params"))
+
+
+def test_unpinned_model_staged_with_hash_name(tmp_path, monkeypatch):
+    """mobilenetv2 weights postdate the pinned table but must resolve
+    when staged under the upstream <name>-<hash8>.params convention."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    net = vision.get_model("mobilenetv2_0.25", classes=10)
+    net.initialize(mx.init.Xavier())
+    net(mx.nd.ones((1, 3, 32, 32)))
+    net.save_params(str(repo / "mobilenetv2_0.25-deadbeef.params"))
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(repo))
+    path = model_store.get_model_file("mobilenetv2_0.25",
+                                      root=str(tmp_path / "cache"))
+    assert path.endswith("mobilenetv2_0.25-deadbeef.params")
+
+
+def test_corrupt_staged_pinned_file_rejected(tmp_path, monkeypatch):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    # short-hash name for a pinned model with wrong contents
+    bad = repo / ("resnet18_v1-%s.params" % model_store.short_hash("resnet18_v1"))
+    bad.write_bytes(b"not real weights")
+    monkeypatch.setenv("MXNET_GLUON_REPO", str(repo))
+    with pytest.raises(MXNetError, match="sha1"):
+        model_store.get_model_file("resnet18_v1",
+                                   root=str(tmp_path / "cache"))

@@ -1,0 +1,69 @@
+"""Torch bridge (mx.th): PyTorch ops over NDArrays via DLPack.
+
+Reference: python/mxnet/torch.py (lua-torch plugin exposing mx.th.*
+functions on NDArrays; plugin/torch/torch_function.h).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+torch = pytest.importorskip("torch")
+
+
+def test_roundtrip_conversion():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = mx.torch.to_torch(x)
+    assert torch.is_tensor(t) and t.shape == (2, 3)
+    back = mx.torch.from_torch(t)
+    assert isinstance(back, nd.NDArray)
+    assert np.array_equal(back.asnumpy(), x.asnumpy())
+
+
+def test_th_elementwise_and_reduction():
+    x = nd.array(np.array([[1.0, -2.0], [3.0, -4.0]], np.float32))
+    y = mx.th.abs(x)
+    assert isinstance(y, nd.NDArray)
+    assert np.array_equal(y.asnumpy(), np.abs(x.asnumpy()))
+    s = mx.th.sigmoid(x)
+    assert np.allclose(s.asnumpy(), 1 / (1 + np.exp(-x.asnumpy())),
+                       atol=1e-6)
+    m = mx.th.mm(x, mx.th.t(x))
+    assert np.allclose(m.asnumpy(), x.asnumpy() @ x.asnumpy().T, atol=1e-5)
+
+
+def test_th_nested_namespace():
+    a = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    m = nd.array(a @ a.T + 4 * np.eye(4, dtype=np.float32))
+    chol = mx.th.linalg.cholesky(m)
+    assert isinstance(chol, nd.NDArray)
+    assert np.allclose(chol.asnumpy() @ chol.asnumpy().T, m.asnumpy(),
+                       atol=1e-4)
+
+
+def test_th_multi_output():
+    x = nd.array(np.random.RandomState(1).rand(3, 3).astype(np.float32))
+    res = mx.th.sort(x, 1)
+    vals = res[0] if isinstance(res, tuple) else res.values
+    assert np.allclose(np.sort(x.asnumpy(), axis=1),
+                       vals.asnumpy() if hasattr(vals, "asnumpy")
+                       else np.asarray(vals))
+
+
+def test_th_errors():
+    with pytest.raises(AttributeError):
+        mx.th.definitely_not_a_torch_function
+    with pytest.raises(TypeError):
+        mx.torch.to_torch(np.zeros(3))
+
+
+def test_to_torch_copies_by_default():
+    # in-place torch ops must NOT corrupt the jax-owned source buffer
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    t = mx.torch.to_torch(x)
+    t.abs_()
+    assert np.array_equal(x.asnumpy(), [1.0, -2.0, 3.0])
+    # th.* wrapped in-place variants operate on the copy too
+    mx.th.abs_(x)
+    assert np.array_equal(x.asnumpy(), [1.0, -2.0, 3.0])
