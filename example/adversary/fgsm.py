@@ -1,0 +1,116 @@
+"""Fast Gradient Sign Method adversarial examples.
+
+Reference: ``example/adversary/adversary_generation.ipynb`` — train a
+small classifier, then perturb inputs along the sign of the input
+gradient and watch accuracy collapse.  TPU-native: the input gradient
+comes from ``attach_grad()`` on the data batch inside an autograd
+scope — one jitted forward+backward where the data is a differentiable
+leaf (the reference marked data with grad_req via simple_bind).
+
+Usage: python fgsm.py [--epochs 2] [--epsilon 0.15]
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def load_data(n=8192):
+    try:
+        ds = gluon.data.vision.MNIST(train=True)
+        x = ds._data.asnumpy().astype(np.float32).reshape((-1, 1, 28, 28)) \
+            / 255.0
+        y = ds._label.astype(np.float32)
+        return x[:n], y[:n], False
+    except Exception:
+        # synthetic 4-class oriented-bar images
+        rng = np.random.RandomState(0)
+        y = rng.randint(0, 4, n).astype(np.float32)
+        x = np.zeros((n, 1, 28, 28), np.float32)
+        for i, c in enumerate(y.astype(int)):
+            a = np.deg2rad(45 * c)
+            for t in np.linspace(-10, 10, 60):
+                r = int(round(14 + t * np.sin(a)))
+                col = int(round(14 + t * np.cos(a)))
+                if 0 <= r < 28 and 0 <= col < 28:
+                    x[i, 0, r, col] = 1.0
+        x += 0.05 * rng.rand(n, 1, 28, 28).astype(np.float32)
+        return x, y, True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--epsilon", type=float, default=0.15)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    x, y, synthetic = load_data()
+    if synthetic and args.epsilon < 0.4:
+        # the synthetic bar classes have much larger margins than MNIST;
+        # a single FGSM step needs a bigger budget to cross them
+        logging.info("synthetic data: raising epsilon %.2f -> 0.40",
+                     args.epsilon)
+        args.epsilon = 0.4
+    classes = int(y.max()) + 1
+    n_train = int(0.9 * len(x))
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 5, activation="relu"), nn.MaxPool2D(2),
+            nn.Conv2D(32, 5, activation="relu"), nn.MaxPool2D(2),
+            nn.Flatten(), nn.Dense(64, activation="relu"),
+            nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(n_train)
+        losses = []
+        for s in range(0, n_train - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            xb, yb = nd.array(x[idx]), nd.array(y[idx])
+            with autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            trainer.step(1)
+            losses.append(float(loss.asnumpy()))
+        logging.info("Epoch[%d] loss=%.4f", epoch, np.mean(losses))
+
+    def accuracy(inputs, labels):
+        correct = 0
+        for s in range(0, len(inputs), args.batch_size):
+            pred = net(nd.array(inputs[s:s + args.batch_size])).asnumpy()
+            correct += (pred.argmax(1) == labels[s:s + args.batch_size]).sum()
+        return correct / len(inputs)
+
+    xv, yv = x[n_train:], y[n_train:]
+    clean_acc = accuracy(xv, yv)
+
+    # FGSM: x' = clip(x + eps * sign(dL/dx))
+    adv = []
+    for s in range(0, len(xv), args.batch_size):
+        xb = nd.array(xv[s:s + args.batch_size])
+        yb = nd.array(yv[s:s + args.batch_size])
+        xb.attach_grad()
+        with autograd.record():
+            loss = loss_fn(net(xb), yb).sum()
+        loss.backward()
+        perturbed = xb + args.epsilon * xb.grad.sign()
+        adv.append(np.clip(perturbed.asnumpy(), 0.0, 1.0))
+    adv_acc = accuracy(np.concatenate(adv), yv)
+    assert adv_acc < clean_acc, \
+        "FGSM should reduce accuracy (clean=%.3f adv=%.3f)" \
+        % (clean_acc, adv_acc)
+    print("clean accuracy=%.3f adversarial accuracy=%.3f (epsilon=%.2f)"
+          % (clean_acc, adv_acc, args.epsilon))
+
+
+if __name__ == "__main__":
+    main()

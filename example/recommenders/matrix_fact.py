@@ -1,0 +1,110 @@
+"""Matrix-factorization recommender.
+
+Reference: ``example/recommenders/matrix_fact.py`` — user/item embedding
+factorization trained on rating triples with an RMSE metric.  This
+TPU-native version uses gluon sparse-gradient embeddings (only the rows
+a batch touches are updated — mxnet_tpu/ndarray/sparse.py lazy row
+updates) and a hybridized dot-product scorer, so each step compiles to
+one XLA program with two gathers and an MXU batched dot.
+
+Data: synthetic MovieLens-like triples from a planted low-rank model,
+so the script runs anywhere; RMSE approaching the planted noise floor
+is the success signal.
+
+Usage: python matrix_fact.py [--users 1000] [--items 500] [--epochs 5]
+"""
+import argparse
+import logging
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+class MFBlock(gluon.HybridBlock):
+    """score(u, i) = <U_u, V_i> + b_u + c_i."""
+
+    def __init__(self, num_users, num_items, dim, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user = nn.Embedding(num_users, dim, sparse_grad=True)
+            self.item = nn.Embedding(num_items, dim, sparse_grad=True)
+            self.user_bias = nn.Embedding(num_users, 1, sparse_grad=True)
+            self.item_bias = nn.Embedding(num_items, 1, sparse_grad=True)
+
+    def hybrid_forward(self, F, users, items):
+        u = self.user(users)
+        v = self.item(items)
+        score = (u * v).sum(axis=1)
+        return score + self.user_bias(users).reshape((-1,)) \
+            + self.item_bias(items).reshape((-1,))
+
+
+def synthetic_ratings(num_users, num_items, num_ratings, rank=8, noise=0.1,
+                      seed=0):
+    rng = np.random.RandomState(seed)
+    U = rng.randn(num_users, rank) / math.sqrt(rank)
+    V = rng.randn(num_items, rank) / math.sqrt(rank)
+    users = rng.randint(0, num_users, num_ratings)
+    items = rng.randint(0, num_items, num_ratings)
+    ratings = (U[users] * V[items]).sum(1) + noise * rng.randn(num_ratings)
+    return (users.astype(np.float32), items.astype(np.float32),
+            ratings.astype(np.float32))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=1000)
+    ap.add_argument("--items", type=int, default=500)
+    ap.add_argument("--ratings", type=int, default=20000)
+    ap.add_argument("--dim", type=int, default=16)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    users, items, ratings = synthetic_ratings(args.users, args.items,
+                                              args.ratings)
+    n_train = int(0.9 * args.ratings)
+
+    net = MFBlock(args.users, args.items, args.dim)
+    net.initialize(mx.init.Normal(0.05))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.L2Loss()
+
+    def rmse(lo, hi):
+        se, n = 0.0, 0
+        for s in range(lo, hi, args.batch_size):
+            e = min(s + args.batch_size, hi)
+            pred = net(nd.array(users[s:e]), nd.array(items[s:e]))
+            se += float(((pred.asnumpy() - ratings[s:e]) ** 2).sum())
+            n += e - s
+        return math.sqrt(se / n)
+
+    steps = 0
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(n_train)
+        for s in range(0, n_train - args.batch_size + 1, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            u = nd.array(users[idx])
+            i = nd.array(items[idx])
+            r = nd.array(ratings[idx])
+            with autograd.record():
+                loss = loss_fn(net(u, i), r).mean()
+            loss.backward()
+            trainer.step(1)
+            steps += 1
+        logging.info("Epoch[%d] steps=%d Train-RMSE=%.4f Val-RMSE=%.4f",
+                     epoch, steps, rmse(0, n_train),
+                     rmse(n_train, args.ratings))
+    print("final validation RMSE: %.4f" % rmse(n_train, args.ratings))
+
+
+if __name__ == "__main__":
+    main()
