@@ -1,0 +1,70 @@
+// cpp-package demo: inference via the header-only C++ frontend.
+//
+// Reference parity: cpp-package/example/ (MLP demos over mxnet-cpp).
+// Loads a checkpoint exported from Python (HybridBlock.export /
+// mx.model.save_checkpoint), runs a deterministic ramp input, prints
+// the outputs — the test harness diffs them against the Python
+// executor's numbers.
+//
+// Build (from repo root):
+//   g++ -std=c++14 -O2 -Icpp-package/include \
+//       cpp-package/example/mlp_predict.cc \
+//       -o /tmp/mlp_predict mxnet_tpu/native/libmxnet_predict.so \
+//       $(python3-config --ldflags --embed) \
+//       -Wl,-rpath,$PWD/mxnet_tpu/native
+// Run:
+//   PYTHONPATH=$PWD JAX_PLATFORMS=cpu /tmp/mlp_predict \
+//       toy-symbol.json toy-0000.params 2,5
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "mxnet-cpp/predictor.hpp"
+
+static std::string slurp(const char* path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", path);
+    std::exit(1);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json weights.params N,C[,H,W]\n",
+                 argv[0]);
+    return 1;
+  }
+  std::vector<unsigned> shape;
+  {
+    std::stringstream ss(argv[3]);
+    std::string tok;
+    while (std::getline(ss, tok, ','))
+      shape.push_back(static_cast<unsigned>(std::stoul(tok)));
+  }
+  try {
+    mxnet::cpp::Predictor pred(slurp(argv[1]), slurp(argv[2]),
+                               {{"data", shape}});
+    mxnet::cpp::NDArray input(shape);
+    for (std::size_t i = 0; i < input.Size(); ++i)
+      input.Data()[i] = 0.01f * static_cast<float>(i);
+    pred.SetInput("data", input);
+    pred.Forward();
+    mxnet::cpp::NDArray out = pred.GetOutputArray(0);
+    std::printf("output shape:");
+    for (unsigned d : out.Shape()) std::printf(" %u", d);
+    std::printf("\n");
+    for (float v : out.Data()) std::printf("%.6f ", v);
+    std::printf("\n");
+  } catch (const mxnet::cpp::Error& e) {
+    std::fprintf(stderr, "mxnet error: %s\n", e.what());
+    return 2;
+  }
+  return 0;
+}

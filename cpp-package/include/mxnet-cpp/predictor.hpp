@@ -1,0 +1,181 @@
+// Header-only C++ frontend over the c_predict_api ABI.
+//
+// Reference parity: cpp-package/include/mxnet-cpp/ — the header-only
+// C++ frontend the reference layered over its C API.  The TPU build's
+// native surface is deployment-oriented (standalone inference through
+// libmxnet_predict.so, reference include/mxnet/c_predict_api.h), so
+// this frontend wraps exactly that: RAII Predictor + a host-side
+// NDArray holding shape/float data, with exceptions carrying
+// MXGetLastError().  Training stays in Python — the reference's
+// training-capable cpp-package predates the framework's single-binding
+// design and is intentionally out of scope (SURVEY.md §2.13).
+//
+// Usage:
+//   #include "mxnet-cpp/predictor.hpp"
+//   mxnet::cpp::Predictor pred(symbol_json, param_blob,
+//                              {{"data", {1, 3, 224, 224}}});
+//   pred.SetInput("data", image);      // std::vector<float>
+//   pred.Forward();
+//   std::vector<float> scores = pred.GetOutput(0);
+#ifndef MXNET_CPP_PREDICTOR_HPP_
+#define MXNET_CPP_PREDICTOR_HPP_
+
+#include <cstddef>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef void* MXCppPredictorHandle;
+int MXPredCreate(const char* symbol_json, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 unsigned num_input_nodes, const char** input_keys,
+                 const unsigned* input_shape_indptr,
+                 const unsigned* input_shape_data,
+                 MXCppPredictorHandle* out);
+int MXPredSetInput(MXCppPredictorHandle h, const char* key,
+                   const float* data, unsigned size);
+int MXPredForward(MXCppPredictorHandle h);
+int MXPredGetOutputShape(MXCppPredictorHandle h, unsigned index,
+                         unsigned** shape_data, unsigned* shape_ndim);
+int MXPredGetOutput(MXCppPredictorHandle h, unsigned index, float* data,
+                    unsigned size);
+int MXPredReshape(unsigned num_input_nodes, const char** input_keys,
+                  const unsigned* input_shape_indptr,
+                  const unsigned* input_shape_data,
+                  MXCppPredictorHandle handle, MXCppPredictorHandle* out);
+int MXPredFree(MXCppPredictorHandle h);
+const char* MXGetLastError();
+}
+
+namespace mxnet {
+namespace cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc) {
+  if (rc != 0) {
+    const char* msg = MXGetLastError();
+    throw Error(msg ? msg : "unknown mxnet error");
+  }
+}
+
+// Minimal host tensor: shape + contiguous float data.
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(std::vector<unsigned> shape, std::vector<float> data)
+      : shape_(std::move(shape)), data_(std::move(data)) {
+    if (Size() != data_.size())
+      throw Error("NDArray: data size does not match shape");
+  }
+  explicit NDArray(std::vector<unsigned> shape)
+      : shape_(std::move(shape)), data_(Size(), 0.0f) {}
+
+  std::size_t Size() const {
+    return std::accumulate(shape_.begin(), shape_.end(),
+                           std::size_t(1),
+                           [](std::size_t a, unsigned b) { return a * b; });
+  }
+  const std::vector<unsigned>& Shape() const { return shape_; }
+  const std::vector<float>& Data() const { return data_; }
+  std::vector<float>& Data() { return data_; }
+
+ private:
+  std::vector<unsigned> shape_;
+  std::vector<float> data_;
+};
+
+// RAII predictor over libmxnet_predict.so.
+class Predictor {
+ public:
+  using InputShapes =
+      std::vector<std::pair<std::string, std::vector<unsigned>>>;
+
+  Predictor(const std::string& symbol_json, const std::string& param_bytes,
+            const InputShapes& inputs, int dev_type = 1, int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<unsigned> indptr{0};
+    std::vector<unsigned> shapes;
+    for (const auto& kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      shapes.insert(shapes.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<unsigned>(shapes.size()));
+    }
+    Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
+                       static_cast<int>(param_bytes.size()), dev_type,
+                       dev_id, static_cast<unsigned>(keys.size()),
+                       keys.data(), indptr.data(), shapes.data(), &handle_));
+  }
+
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  Predictor(Predictor&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  ~Predictor() {
+    if (handle_) MXPredFree(handle_);
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    Check(MXPredSetInput(handle_, key.c_str(), data.data(),
+                         static_cast<unsigned>(data.size())));
+  }
+  void SetInput(const std::string& key, const NDArray& array) {
+    SetInput(key, array.Data());
+  }
+
+  void Forward() { Check(MXPredForward(handle_)); }
+
+  std::vector<unsigned> GetOutputShape(unsigned index) const {
+    unsigned* data = nullptr;
+    unsigned ndim = 0;
+    Check(MXPredGetOutputShape(handle_, index, &data, &ndim));
+    return std::vector<unsigned>(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(unsigned index) const {
+    std::vector<unsigned> shape = GetOutputShape(index);
+    std::size_t size = 1;
+    for (unsigned d : shape) size *= d;
+    std::vector<float> out(size);
+    Check(MXPredGetOutput(handle_, index, out.data(),
+                          static_cast<unsigned>(size)));
+    return out;
+  }
+
+  NDArray GetOutputArray(unsigned index) const {
+    return NDArray(GetOutputShape(index), GetOutput(index));
+  }
+
+  // Rebind to new input shapes (bucketing / variable batch); this
+  // predictor keeps working, the returned one uses the new shapes.
+  Predictor Reshape(const InputShapes& inputs) const {
+    std::vector<const char*> keys;
+    std::vector<unsigned> indptr{0};
+    std::vector<unsigned> shapes;
+    for (const auto& kv : inputs) {
+      keys.push_back(kv.first.c_str());
+      shapes.insert(shapes.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<unsigned>(shapes.size()));
+    }
+    MXCppPredictorHandle out = nullptr;
+    Check(MXPredReshape(static_cast<unsigned>(keys.size()), keys.data(),
+                        indptr.data(), shapes.data(), handle_, &out));
+    return Predictor(out);
+  }
+
+ private:
+  explicit Predictor(MXCppPredictorHandle h) : handle_(h) {}
+  MXCppPredictorHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_PREDICTOR_HPP_

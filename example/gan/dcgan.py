@@ -103,8 +103,11 @@ def main():
             real = next(data)
             z = nd.array(rng.randn(args.batch_size, args.nz, 1, 1)
                          .astype(np.float32))
-            # D step: real -> 1, fake -> 0
-            fake = gen(z)
+            # D step: real -> 1, fake -> 0.  The fake forward runs in
+            # train mode (batch BN stats, same distribution the G step
+            # optimizes) but outside record, so no grads flow to G.
+            with autograd.train_mode():
+                fake = gen(z)
             with autograd.record():
                 l_d = (loss_fn(disc(real), ones)
                        + loss_fn(disc(fake), zeros)).mean()
