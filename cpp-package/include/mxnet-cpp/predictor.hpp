@@ -100,13 +100,8 @@ class Predictor {
   Predictor(const std::string& symbol_json, const std::string& param_bytes,
             const InputShapes& inputs, int dev_type = 1, int dev_id = 0) {
     std::vector<const char*> keys;
-    std::vector<unsigned> indptr{0};
-    std::vector<unsigned> shapes;
-    for (const auto& kv : inputs) {
-      keys.push_back(kv.first.c_str());
-      shapes.insert(shapes.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<unsigned>(shapes.size()));
-    }
+    std::vector<unsigned> indptr, shapes;
+    Flatten(inputs, &keys, &indptr, &shapes);
     Check(MXPredCreate(symbol_json.c_str(), param_bytes.data(),
                        static_cast<int>(param_bytes.size()), dev_type,
                        dev_id, static_cast<unsigned>(keys.size()),
@@ -157,13 +152,8 @@ class Predictor {
   // predictor keeps working, the returned one uses the new shapes.
   Predictor Reshape(const InputShapes& inputs) const {
     std::vector<const char*> keys;
-    std::vector<unsigned> indptr{0};
-    std::vector<unsigned> shapes;
-    for (const auto& kv : inputs) {
-      keys.push_back(kv.first.c_str());
-      shapes.insert(shapes.end(), kv.second.begin(), kv.second.end());
-      indptr.push_back(static_cast<unsigned>(shapes.size()));
-    }
+    std::vector<unsigned> indptr, shapes;
+    Flatten(inputs, &keys, &indptr, &shapes);
     MXCppPredictorHandle out = nullptr;
     Check(MXPredReshape(static_cast<unsigned>(keys.size()), keys.data(),
                         indptr.data(), shapes.data(), handle_, &out));
@@ -172,6 +162,20 @@ class Predictor {
 
  private:
   explicit Predictor(MXCppPredictorHandle h) : handle_(h) {}
+
+  // InputShapes -> the C ABI's (keys, CSR indptr, flattened dims)
+  static void Flatten(const InputShapes& inputs,
+                      std::vector<const char*>* keys,
+                      std::vector<unsigned>* indptr,
+                      std::vector<unsigned>* shapes) {
+    indptr->push_back(0);
+    for (const auto& kv : inputs) {
+      keys->push_back(kv.first.c_str());
+      shapes->insert(shapes->end(), kv.second.begin(), kv.second.end());
+      indptr->push_back(static_cast<unsigned>(shapes->size()));
+    }
+  }
+
   MXCppPredictorHandle handle_ = nullptr;
 };
 

@@ -99,9 +99,12 @@ def predict_mode():
 # tape
 # ---------------------------------------------------------------------------
 class _TapeEntry:
-    __slots__ = ("vjp_fn", "in_keys", "out_avals", "out_refs")
+    __slots__ = ("vjp_fn", "in_keys", "out_avals", "out_refs",
+                 "primal_fn", "in_datas", "n_aux", "primal_single")
 
-    def __init__(self, vjp_fn, in_keys, out_avals, out_refs):
+    def __init__(self, vjp_fn, in_keys, out_avals, out_refs,
+                 primal_fn=None, in_datas=None, n_aux=0,
+                 primal_single=False):
         self.vjp_fn = vjp_fn
         # routing keys snapshotted at record time (in-place rebinds later
         # must not re-route cotangents): ("s", entry_idx, pos) for an op
@@ -112,6 +115,13 @@ class _TapeEntry:
         # intermediate (torch retain_grad-style, reference mark_variables)
         # receives its cotangent during the sweep
         self.out_refs = out_refs
+        # create_graph support: the pure primal function + its input
+        # buffers let the backward of this entry be re-expressed as a
+        # differentiable op (grad-of-grad); None for custom Functions
+        self.primal_fn = primal_fn
+        self.in_datas = in_datas
+        self.n_aux = n_aux            # trailing aux outputs stripped from out_avals
+        self.primal_single = primal_single  # primal returned a bare array
 
 
 def _tape():
@@ -127,12 +137,15 @@ def _input_key(x):
     return None
 
 
-def record_entry(vjp_fn, inputs, outputs, out_avals):
+def record_entry(vjp_fn, inputs, outputs, out_avals, primal_fn=None,
+                 in_datas=None, n_aux=0, primal_single=False):
     import weakref
 
     in_keys = [_input_key(x) for x in inputs]
     entry = _TapeEntry(vjp_fn, in_keys, list(out_avals),
-                       [weakref.ref(o) for o in outputs])
+                       [weakref.ref(o) for o in outputs],
+                       primal_fn=primal_fn, in_datas=in_datas,
+                       n_aux=n_aux, primal_single=primal_single)
     tape = _tape()
     idx = len(tape)
     tape.append(entry)
@@ -223,6 +236,126 @@ def _reverse_sweep(heads, head_grads, retain_graph):
     return leaf_cts
 
 
+def _reverse_sweep_create_graph(heads, head_grads):
+    """Differentiable reverse sweep: each entry's backward runs as
+    ``jax.vjp(primal_fn)`` over (primal inputs + cotangents) and is
+    RECORDED as a new tape entry, so the produced gradients support
+    further ``backward``/``grad`` calls (reference: create_graph=True in
+    autograd.py:270, Imperative::Backward's is_record path).
+
+    Cotangents are NDArrays throughout; their accumulation (``+``) also
+    records, so third and higher orders compose."""
+    import weakref
+
+    import jax
+    import jax.numpy as jnp
+    from jax.dtypes import float0
+
+    from .ndarray.ndarray import _wrap
+
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    tape = _tape()
+    n_entries = len(tape)  # grad ops append behind this high-water mark
+    ct = {}
+    leaf_cts = {}
+
+    def _route(key, g_nd):
+        if key is None:
+            return
+        if key[0] == "l":
+            leaf = key[1]
+            slot_l = leaf_cts.get(id(leaf))
+            if slot_l is None:
+                leaf_cts[id(leaf)] = [leaf, g_nd]
+            else:
+                slot_l[1] = slot_l[1] + g_nd
+        else:
+            skey = (key[1], key[2])
+            prev = ct.get(skey)
+            ct[skey] = g_nd if prev is None else prev + g_nd
+
+    for i, h in enumerate(heads):
+        key = _input_key(h)
+        if key is None:
+            raise MXNetError("head array is not connected to the recorded graph")
+        if head_grads is not None and head_grads[i] is not None:
+            g = head_grads[i]
+        else:
+            g = _wrap(jnp.ones_like(h._data))
+        _route(key, g)
+
+    for idx in range(n_entries - 1, -1, -1):
+        entry = tape[idx]
+        out_ct_nds = []
+        touched = False
+        for pos, aval in enumerate(entry.out_avals):
+            g = ct.pop((idx, pos), None)
+            if g is None:
+                g = _wrap(jnp.zeros(aval.shape, aval.dtype))
+            else:
+                touched = True
+                out_nd = entry.out_refs[pos]()
+                if out_nd is not None and getattr(out_nd, "_ag_leaf", False) \
+                        and getattr(out_nd, "_grad", None) is not None:
+                    _route(("l", out_nd), g)
+            out_ct_nds.append(g)
+        if not touched:
+            continue
+        if entry.primal_fn is None:
+            raise MXNetError(
+                "create_graph=True cannot differentiate through a custom "
+                "autograd.Function (its backward is opaque NDArray code); "
+                "express the op with registered operators instead")
+
+        n_in = len(entry.in_datas)
+
+        def gfn(*args, _e=entry, _n=n_in):
+            ins, cts = args[:_n], args[_n:]
+            _, vjp = jax.vjp(_e.primal_fn, *ins)
+            if _e.primal_single:
+                arg = cts[0]
+            else:
+                cts = list(cts)
+                if _e.n_aux:
+                    # aux outputs were stripped from the tape; restore
+                    # zero cotangents for them (shapes via eval_shape)
+                    full_avals = jax.eval_shape(_e.primal_fn, *ins)
+                    for a in list(full_avals)[len(cts):]:
+                        cts.append(jnp.zeros(a.shape, a.dtype))
+                arg = tuple(cts)
+            return tuple(vjp(arg))
+
+        ct_datas = tuple(c._data for c in out_ct_nds)
+        all_in = tuple(entry.in_datas) + ct_datas
+        in_ct_raw, vjp2 = jax.vjp(gfn, *all_in)
+        in_ct_nds = [_wrap(o) for o in in_ct_raw]
+
+        # record the grad op itself (keys: primal inputs snapshotted
+        # from the original entry + the cotangent arrays' live keys)
+        def vjp2_tape(out_cts, _v=vjp2):
+            if not isinstance(out_cts, tuple):
+                out_cts = (out_cts,)
+            return _v(tuple(out_cts))
+
+        keys2 = list(entry.in_keys) + [_input_key(c) for c in out_ct_nds]
+        new_entry = _TapeEntry(
+            vjp2_tape, keys2, list(in_ct_raw),
+            [weakref.ref(o) for o in in_ct_nds],
+            primal_fn=gfn, in_datas=all_in, n_aux=0, primal_single=False)
+        tape.append(new_entry)
+        for pos, o in enumerate(in_ct_nds):
+            o._ag_slot = (len(tape) - 1, pos)
+
+        for key, g_nd, raw in zip(entry.in_keys, in_ct_nds, in_ct_raw):
+            if hasattr(raw, "dtype") and raw.dtype == float0:
+                continue
+            _route(key, g_nd)
+    return leaf_cts
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Reverse sweep committing into the leaves' attached grad buffers
     (reference: python/mxnet/autograd.py:243)."""
@@ -240,16 +373,13 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     WITHOUT touching the variables' ``.grad`` buffers (reference:
     python/mxnet/autograd.py:270).
 
-    ``create_graph=True`` (higher-order differentiation through the
-    imperative tape) is not supported in this build — compose
-    ``jax.grad`` over a pure function, or use the symbolic executor,
-    for higher-order derivatives."""
+    With ``create_graph=True`` the backward pass itself is recorded on
+    the tape (each entry's gradient runs as a jax.vjp of its stored
+    primal), so the returned gradients support further ``backward``/
+    ``grad`` calls — grad-of-grad for gradient penalties, Hessian-vector
+    products, and higher orders."""
     from .ndarray.ndarray import NDArray, _wrap
 
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True is not supported by the tape; use jax.grad "
-            "composition or the symbolic executor for higher-order grads")
     single = not isinstance(variables, (list, tuple))
     var_list = [variables] if single else list(variables)
     for v in var_list:
@@ -262,8 +392,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
                 "not marked for gradient; call attach_grad() (or "
                 "mark_variables) on it BEFORE the recorded computation")
     if retain_graph is None:
-        retain_graph = False
-    leaf_cts = _reverse_sweep(heads, head_grads, retain_graph)
+        retain_graph = create_graph
+    if create_graph:
+        # recording stays on so cotangent accumulation and the grad ops
+        # land on the tape; the tape must survive for the second pass
+        with _RecordingStateScope(True, train_mode):
+            leaf_cts = _reverse_sweep_create_graph(heads, head_grads)
+    else:
+        leaf_cts = _reverse_sweep(heads, head_grads, retain_graph)
     outs = []
     for v in var_list:
         hit = leaf_cts.get(id(v))
@@ -272,7 +408,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
                 "a requested variable is not reachable from the heads in "
                 "the recorded graph (reference: Imperative::Backward "
                 "raises for unreachable gradient nodes)")
-        outs.append(_wrap(hit[1]))
+        outs.append(hit[1] if isinstance(hit[1], NDArray) else _wrap(hit[1]))
     return outs[0] if single else outs
 
 

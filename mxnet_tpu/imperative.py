@@ -113,7 +113,9 @@ def invoke(op, nd_inputs, attrs=None, out=None):
             return _vjp(arg)
 
         autograd.record_entry(
-            tape_vjp, list(nd_inputs), nd_outs, [o._data for o in nd_outs])
+            tape_vjp, list(nd_inputs), nd_outs, [o._data for o in nd_outs],
+            primal_fn=fn, in_datas=tuple(datas), n_aux=n_aux,
+            primal_single=single)
 
     if out is not None:
         targets = out if isinstance(out, (list, tuple)) else [out]
@@ -152,7 +154,9 @@ def invoke_fn(fn, nd_inputs, record_grad=True):
                 out_cts = (out_cts,)
             return _v(tuple(out_cts))
 
-        autograd.record_entry(tape_vjp, list(nd_inputs), nd_outs, outs)
+        autograd.record_entry(tape_vjp, list(nd_inputs), nd_outs, outs,
+                              primal_fn=fn, in_datas=tuple(datas),
+                              primal_single=single)
         return nd_outs[0] if single else nd_outs
     out = fn(*datas)
     if isinstance(out, tuple):

@@ -5,6 +5,7 @@ C API; here validated by compiling the mlp_predict example against the
 header and diffing its outputs against the Python executor.
 """
 import os
+import shutil
 import subprocess
 
 import numpy as np
@@ -18,7 +19,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_cpp_package_predictor(tmp_path):
     if get_predict_lib() is None:
-        pytest.skip("no native toolchain")
+        pytest.skip("no native predict library")
+    if not (shutil.which("g++") and shutil.which("python3-config")):
+        # prebuilt .so without a compiler: nothing to build the demo with
+        pytest.skip("no C++ toolchain to compile the example")
     _, exe, sfile, pfile = _toy_model(tmp_path)
     src = os.path.join(REPO, "cpp-package", "example", "mlp_predict.cc")
     bin_path = str(tmp_path / "mlp_predict")

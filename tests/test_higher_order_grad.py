@@ -1,0 +1,129 @@
+"""Higher-order autograd: grad(create_graph=True).
+
+Reference: python/mxnet/autograd.py:270 (grad with create_graph for
+higher-order differentiation; Imperative::Backward is_record path).
+The tape re-expresses each entry's backward as jax.vjp of its stored
+primal and records it, so gradients are themselves differentiable.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.base import MXNetError
+
+
+def test_second_derivative():
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        gx = autograd.grad(y, x, create_graph=True)
+        z = gx.sum()
+    g2 = autograd.grad(z, x)
+    assert np.allclose(gx.asnumpy(), 3 * xv ** 2)
+    assert np.allclose(g2.asnumpy(), 6 * xv)
+
+
+def test_hessian_vector_product_and_mixed_partial():
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.array([2.0, -1.0, 0.5], np.float32)
+    vv = np.array([1.0, 1.0, 2.0], np.float32)
+    x, w, v = nd.array(xv), nd.array(wv), nd.array(vv)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        f = (x * x * w).sum()
+        gx = autograd.grad(f, x, create_graph=True)
+        hv = (gx * v).sum()
+    hvp = autograd.grad(hv, x, retain_graph=True)
+    mixed = autograd.grad(hv, w)
+    assert np.allclose(hvp.asnumpy(), 2 * wv * vv)
+    assert np.allclose(mixed.asnumpy(), 2 * xv * vv)
+
+
+def test_third_order():
+    xv = np.array([0.5, 2.0], np.float32)
+    x = nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, x, create_graph=True)
+        g2 = autograd.grad(g1.sum(), x, create_graph=True)
+        s = g2.sum()
+    g3 = autograd.grad(s, x)
+    assert np.allclose(g3.asnumpy(), 24 * xv)
+
+
+def test_backward_through_created_graph_commits_param_grads():
+    """WGAN-GP shape: a gradient penalty term trained with backward()."""
+    net = gluon.nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(4, 3).astype(np.float32))
+    x.attach_grad()
+    wparam = net.weight
+    with autograd.record():
+        y = net(x).sum()
+        gx = autograd.grad(y, x, create_graph=True)  # = W broadcast
+        # penalty: (||dy/dx||^2 - 1)^2
+        penalty = ((gx * gx).sum() - 1.0) ** 2
+    penalty.backward()
+    wgrad = wparam.grad().asnumpy()
+    # analytic: gx rows are all W (0.5 each); ||gx||^2 = 4*3*0.25 = 3
+    # d penalty / dW_j = 2*(3-1) * d(4*sum w^2)/dW_j = 4 * 8 * w_j = 16
+    assert np.allclose(wgrad, 16.0, atol=1e-4), wgrad
+
+
+def test_through_nonlinear_network():
+    """Numeric check of d2/dx2 through tanh-MLP against finite diffs."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="tanh", in_units=2),
+            gluon.nn.Dense(1, in_units=8))
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+
+    def second_deriv(xnp):
+        x = nd.array(xnp)
+        x.attach_grad()
+        with autograd.record():
+            y = net(x).sum()
+            gx = autograd.grad(y, x, create_graph=True)
+            s = (gx * gx).sum()
+        return autograd.grad(s, x).asnumpy()
+
+    def s_of(xnp):
+        x = nd.array(xnp)
+        x.attach_grad()
+        with autograd.record():
+            y = net(x).sum()
+            gx = autograd.grad(y, x, create_graph=True)
+        return float((gx.asnumpy() ** 2).sum())
+
+    x0 = np.array([[0.3, -0.7]], np.float32)
+    got = second_deriv(x0)
+    eps = 1e-3
+    fd = np.zeros_like(x0)
+    for i in range(x0.shape[1]):
+        xp, xm = x0.copy(), x0.copy()
+        xp[0, i] += eps
+        xm[0, i] -= eps
+        fd[0, i] = (s_of(xp) - s_of(xm)) / (2 * eps)
+    assert np.allclose(got, fd, rtol=1e-2, atol=1e-3), (got, fd)
+
+
+def test_function_rejects_create_graph():
+    class Square(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return 2 * dy
+
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+        with pytest.raises(MXNetError, match="create_graph"):
+            autograd.grad(y, x, create_graph=True)
