@@ -47,11 +47,13 @@ def _flash_eligible(q, k, causal, q_offset, kv_offset):
 
 
 def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
-                    scale=None, impl="auto"):
+                    scale=None, impl="auto", kv_len=None):
     """Softmax attention on local blocks.
 
     q: (B, Tq, H, D), k/v: (B, Tk, H, D).  Offsets give the global
     positions of the first query/key for causal masking across shards.
+    ``kv_len`` masks out keys whose global position is >= kv_len —
+    the padding mask for sequences padded up to a shard multiple.
 
     impl: "auto" uses the Pallas flash kernel on TPU when offsets are
     aligned and T divides into blocks (O(T) memory instead of the
@@ -59,9 +61,12 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
     """
     d = q.shape[-1]
     k, v = _expand_kv_heads(q, k, v)
-    use_flash = (impl == "flash" or
-                 (impl == "auto" and _flash_eligible(q, k, causal,
-                                                     q_offset, kv_offset)))
+    if kv_len is not None and kv_len >= kv_offset + k.shape[1]:
+        kv_len = None  # no padded keys in this block
+    use_flash = (kv_len is None and
+                 (impl == "flash" or
+                  (impl == "auto" and _flash_eligible(q, k, causal,
+                                                      q_offset, kv_offset))))
     if use_flash:
         from ..ops.pallas_kernels import flash_attention
         b, tq, h, _ = q.shape
@@ -73,13 +78,36 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
         return jnp.transpose(o.reshape(b, h, tq, d), (0, 2, 1, 3))
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    kpos = kv_offset + jnp.arange(k.shape[1])
+    mask = None
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
-        kpos = kv_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
+    if kv_len is not None:
+        valid = (kpos < kv_len)[None, :]
+        mask = valid if mask is None else mask & valid
+    if mask is not None:
         logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
+    # rows with no valid key (padded queries under a pure padding mask)
+    # would softmax over -inf only; zero them instead of NaN
+    if kv_len is not None:
+        probs = jnp.where(jnp.isnan(probs), 0.0, probs)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _pad_to_shards(q, k, v, sp):
+    """Pad the time axis up to a multiple of ``sp``.
+
+    Returns (q, k, v, kv_len) where kv_len is the real key count when
+    padding was added (the shard bodies mask keys past it) or None when
+    the length already divides evenly."""
+    t = q.shape[1]
+    pad = (-t) % sp
+    if pad == 0:
+        return q, k, v, None
+    widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+    return (jnp.pad(q, widths), jnp.pad(k, widths), jnp.pad(v, widths), t)
 
 
 def _expand_kv_heads(q, k, v):
@@ -95,8 +123,9 @@ def _expand_kv_heads(q, k, v):
     return (jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2))
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
-    """Per-device body under shard_map: rotate K/V around the ring."""
+def _ring_attention_local(q, k, v, axis_name, causal, scale, kv_len=None):
+    """Per-device body under shard_map: rotate K/V around the ring.
+    ``kv_len`` masks keys at global positions >= kv_len (tail padding)."""
     k, v = _expand_kv_heads(q, k, v)
     axis_size = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -110,10 +139,15 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
         kk, vv, src = kv_and_src
         kv_offset = src * t_local
         logits = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
+        kpos = kv_offset + jnp.arange(t_local)
+        mask = None
         if causal:
             qpos = q_offset + jnp.arange(t_local)
-            kpos = kv_offset + jnp.arange(t_local)
             mask = qpos[:, None] >= kpos[None, :]
+        if kv_len is not None:
+            valid = (kpos < kv_len)[None, :]
+            mask = valid if mask is None else mask & valid
+        if mask is not None:
             logits = jnp.where(mask[None, None], logits, -jnp.inf)
         block_max = jnp.max(logits, axis=-1)                    # (b,h,q)
         new_m = jnp.maximum(m, block_max)
@@ -162,21 +196,19 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         return local_attention(q, k, v, causal=causal, scale=scale)
     sp = mesh.shape[axis_name]
-    if q.shape[1] % sp:
-        raise ValueError(
-            "ring attention needs seq len (%d) divisible by sp (%d); pad "
-            "the sequence (and mask the tail) before sharding" %
-            (q.shape[1], sp))
+    t_real = q.shape[1]
+    q, k, v, kv_len = _pad_to_shards(q, k, v, sp)
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out if kv_len is None else out[:, :t_real]
 
 
-def _ulysses_local(q, k, v, axis_name, causal, scale):
+def _ulysses_local(q, k, v, axis_name, causal, scale, kv_len=None):
     """all-to-all seq->head, full local attention, all-to-all back."""
     k, v = _expand_kv_heads(q, k, v)
     sp = lax.psum(1, axis_name)
@@ -184,7 +216,7 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
     q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    out = local_attention(q, k, v, causal=causal, scale=scale)
+    out = local_attention(q, k, v, causal=causal, scale=scale, kv_len=kv_len)
     # back: scatter seq, gather heads
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
@@ -203,15 +235,13 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
         raise ValueError(
             "ulysses needs heads (%d) divisible by sp (%d); use "
             "ring_attention" % (q.shape[2], sp))
-    if q.shape[1] % sp:
-        raise ValueError(
-            "ulysses needs seq len (%d) divisible by sp (%d); pad the "
-            "sequence (and mask the tail) before sharding" %
-            (q.shape[1], sp))
+    t_real = q.shape[1]
+    q, k, v, kv_len = _pad_to_shards(q, k, v, sp)
     spec = P(None, axis_name, None, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                          scale=scale),
+                          scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    return fn(q, k, v)
+    out = fn(q, k, v)
+    return out if kv_len is None else out[:, :t_real]

@@ -33,23 +33,51 @@ class CudaKernel:  # pragma: no cover - CUDA unavailable by design
 
 
 class PallasModule:
-    """Compile python source defining jax/Pallas kernels at runtime.
+    """Compile python source defining jax/Pallas kernels at runtime —
+    the TPU analogue of NVRTC CudaModule (kernel source compiled at
+    runtime, launched on device arrays).
+
+    The source namespace is pre-seeded with the Pallas toolkit (``pl``
+    = jax.experimental.pallas, ``plt`` = its TPU backend when present,
+    ``jnp``, ``jax``, ``INTERPRET`` = True off-TPU so kernels run
+    everywhere), so a module can define real grid kernels:
 
     >>> mod = PallasModule('''
-    ... import jax.numpy as jnp
-    ... def axpy(a, x, y):
-    ...     return a * x + y
-    ... ''', exports=["axpy"])
-    >>> kernel = mod.get_kernel("axpy")
-    >>> out = kernel(2.0, x, y)   # NDArrays in, NDArray out
+    ... def _scale_kernel(x_ref, o_ref):
+    ...     o_ref[...] = x_ref[...] * 2.0
+    ... def double(x):
+    ...     return pl.pallas_call(_scale_kernel,
+    ...                           out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    ...                           interpret=INTERPRET)(x)
+    ... ''', exports=["double"])
+    >>> kernel = mod.get_kernel("double")
+    >>> out = kernel(x)   # NDArrays in, NDArray out
+
+    Plain jax functions (no pallas_call) work as well and are simply
+    jitted.
     """
 
     def __init__(self, source, exports=()):
-        self._namespace = {}
+        import jax
+        import jax.numpy as jnp
+
+        self._namespace = {"jax": jax, "jnp": jnp}
+        try:
+            from jax.experimental import pallas as pl
+            self._namespace["pl"] = pl
+            self._namespace["INTERPRET"] = jax.default_backend() != "tpu"
+            try:
+                from jax.experimental.pallas import tpu as plt
+                self._namespace["plt"] = plt
+            except ImportError:  # pragma: no cover
+                pass
+        except ImportError:  # pragma: no cover - pallas ships with jax
+            pass
         exec(compile(source, "<rtc>", "exec"), self._namespace)
         self._exports = list(exports) or [
             k for k, v in self._namespace.items()
-            if callable(v) and not k.startswith("_")]
+            if callable(v) and not k.startswith("_")
+            and not hasattr(v, "__loader__")]
 
     def get_kernel(self, name, signature=None):
         if name not in self._exports or name not in self._namespace:

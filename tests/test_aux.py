@@ -191,3 +191,22 @@ def test_backward_do_mirror_numerics(monkeypatch):
     mir_out, mir_grad = run()
     assert np.allclose(base_out, mir_out, atol=1e-6)
     assert np.allclose(base_grad, mir_grad, atol=1e-6)
+
+
+def test_rtc_real_pallas_kernel():
+    """PallasModule seeds pl/jnp/jax/INTERPRET so real pallas_call grid
+    kernels compile at runtime (the NVRTC-CudaModule analogue)."""
+    mod = mx.rtc.PallasModule(
+        "def _scale_kernel(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...] * 2.0 + 1.0\n"
+        "def affine(x):\n"
+        "    return pl.pallas_call(\n"
+        "        _scale_kernel,\n"
+        "        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+        "        interpret=INTERPRET)(x)\n",
+        exports=["affine"])
+    kernel = mod.get_kernel("affine")
+    import numpy as _np
+    x = nd.array(_np.arange(8, dtype=_np.float32).reshape(2, 4))
+    out = kernel(x)
+    assert_almost_equal(out, 2 * x.asnumpy() + 1.0)

@@ -263,10 +263,71 @@ def test_sequence_parallel_larger_shapes():
     assert np.abs(np.asarray(out) - ref).max() < 1e-4
 
 
-def test_sequence_parallel_nondivisible_rejected():
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_nondivisible_autopads(causal):
+    """T % sp != 0: the wrapper pads the tail, masks padded keys, and
+    slices the output back — numerically identical to dense attention
+    on the unpadded length."""
     mesh = parallel.make_mesh(dp=1, sp=8)
-    q = jnp.zeros((1, 30, 4, 8))   # 30 % 8 != 0
-    with pytest.raises(ValueError, match="divisible"):
-        parallel.ring_attention(q, q, q, mesh=mesh)
-    with pytest.raises(ValueError, match="divisible"):
-        parallel.ulysses_attention(q, q, q, mesh=mesh)
+    rng = np.random.RandomState(21)
+    B, T, H, D = 1, 30, 2, 8   # 30 % 8 != 0
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh=mesh, causal=causal)
+    assert out.shape == (B, T, H, D)
+    ref = _dense_ref_attn(q, k, v, causal)
+    assert np.abs(np.asarray(out) - ref).max() < 1e-4
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_nondivisible_autopads(causal):
+    mesh = parallel.make_mesh(dp=1, sp=2, devices=jax.devices()[:2])
+    rng = np.random.RandomState(22)
+    B, T, H, D = 1, 13, 4, 8   # 13 % 2 != 0
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, H, D).astype(np.float32)
+    v = rng.randn(B, T, H, D).astype(np.float32)
+    out = parallel.ulysses_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), mesh=mesh,
+                                     causal=causal)
+    assert out.shape == (B, T, H, D)
+    ref = _dense_ref_attn(q, k, v, causal)
+    assert np.abs(np.asarray(out) - ref).max() < 1e-4
+
+
+def test_ring_attention_nondivisible_grads():
+    mesh = parallel.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    rng = np.random.RandomState(23)
+    B, T, H, D = 1, 10, 2, 4
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    def loss_ring(q, k, v):
+        return parallel.ring_attention(q, k, v, mesh=mesh,
+                                       causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return jnp.asarray(
+            _dense_ref_attn(np.asarray(q), np.asarray(k), np.asarray(v),
+                            True)).sum()
+
+    g = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    # finite-difference the reference loss wrt a few coordinates
+    eps = 1e-3
+    for arr_i, arr in enumerate((q, k, v)):
+        flat = np.asarray(arr).ravel()
+        for ji in (0, 37, flat.size - 1):
+            bump = np.zeros_like(flat)
+            bump[ji] = eps
+            bshape = bump.reshape(arr.shape)
+            args_p = [np.asarray(a) for a in (q, k, v)]
+            args_m = [np.asarray(a) for a in (q, k, v)]
+            args_p[arr_i] = args_p[arr_i] + bshape
+            args_m[arr_i] = args_m[arr_i] - bshape
+            fd = (float(loss_ref(*args_p)) - float(loss_ref(*args_m))) \
+                / (2 * eps)
+            got = float(np.asarray(g[arr_i]).ravel()[ji])
+            assert abs(got - fd) < 5e-2, (arr_i, ji, got, fd)
