@@ -1,0 +1,93 @@
+"""Training-throughput sweep driver.
+
+Reference: ``example/image-classification/benchmark.py`` — runs
+train_imagenet across a (network x batch-size) grid, scrapes the
+Speedometer img/s, and writes a summary table.  TPU-native notes: the
+device axis of the reference's sweep (1..N GPUs) becomes the mesh
+shape — on one chip the sweep is network x batch; multi-chip sweeps
+pass ``--kv-store tpu`` with a larger mesh via the driver env.
+
+Usage:
+  python benchmark.py                         # default grid, prints table
+  python benchmark.py --networks resnet,mobilenet --batch-sizes 64,128 \
+      --output /tmp/bench.csv
+"""
+import argparse
+import csv
+import json
+import os
+import re
+import subprocess
+import sys
+
+SPEED_RE = re.compile(r"Speed:\s*([0-9.]+)\s*samples/sec")
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+NET_ARGS = {
+    "resnet": ["--network", "resnet", "--num-layers", "50"],
+    "resnet18": ["--network", "resnet", "--num-layers", "18"],
+    "vgg": ["--network", "vgg", "--num-layers", "16"],
+    "alexnet": ["--network", "alexnet"],
+    "inception-bn": ["--network", "inception-bn"],
+    "mobilenet": ["--network", "mobilenet"],
+    "lenet": ["--network", "lenet"],
+    "mlp": ["--network", "mlp"],
+}
+
+
+def run_one(network, batch_size, num_batches, image_shape, dtype):
+    cmd = [sys.executable, os.path.join(HERE, "train_imagenet.py"),
+           "--benchmark", "1", "--kv-store", "tpu",
+           "--batch-size", str(batch_size), "--dtype", dtype,
+           "--num-epochs", "1", "--num-batches", str(num_batches),
+           "--disp-batches", str(max(5, num_batches // 4)),
+           "--image-shape", image_shape] + NET_ARGS[network]
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(HERE))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env)
+    speeds = [float(m.group(1))
+              for m in SPEED_RE.finditer(proc.stdout + proc.stderr)]
+    if not speeds:
+        return None
+    steady = sorted(speeds[1:] or speeds)
+    return steady[len(steady) // 2]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--networks", default="resnet,mobilenet",
+                    help="comma list from: %s" % ",".join(sorted(NET_ARGS)))
+    ap.add_argument("--batch-sizes", default="64,128,256")
+    ap.add_argument("--num-batches", type=int, default=40)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--output", default=None, help="also write CSV here")
+    args = ap.parse_args()
+
+    rows = []
+    for network in args.networks.split(","):
+        if network not in NET_ARGS:
+            print("skipping unknown network %r" % network, file=sys.stderr)
+            continue
+        for bs in (int(b) for b in args.batch_sizes.split(",")):
+            img_s = run_one(network, bs, args.num_batches,
+                            args.image_shape, args.dtype)
+            rows.append({"network": network, "batch_size": bs,
+                         "img_per_sec": img_s})
+            print(json.dumps(rows[-1]))
+    print("\n%-14s %10s %12s" % ("network", "batch", "img/s"))
+    for r in rows:
+        print("%-14s %10d %12s" % (
+            r["network"], r["batch_size"],
+            "FAILED" if r["img_per_sec"] is None
+            else "%.1f" % r["img_per_sec"]))
+    if args.output:
+        with open(args.output, "w", newline="") as f:
+            w = csv.DictWriter(f, ["network", "batch_size", "img_per_sec"])
+            w.writeheader()
+            w.writerows(rows)
+
+
+if __name__ == "__main__":
+    main()

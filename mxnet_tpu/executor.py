@@ -167,10 +167,15 @@ class Executor:
             if any(self.arg_names[i] not in allowed for i in self._diff_idx):
                 return False
         # decouple weight buffers from any master/kvstore aliases: the
-        # fused step donates them, which would invalidate shared buffers
-        for i in self._diff_idx:
-            nd = self.arg_dict[self.arg_names[i]]
-            nd._data = jnp.array(nd._data, copy=True)
+        # fused step donates them, which would invalidate shared buffers.
+        # ONE jitted copy program for all of them — per-array copies
+        # compile per shape (~1.4s each via the tunnel's remote compiler)
+        import jax as _jax
+        nds = [self.arg_dict[self.arg_names[i]] for i in self._diff_idx]
+        copies = _jax.jit(lambda xs: tuple(jnp.array(x) for x in xs))(
+            tuple(nd._data for nd in nds))
+        for nd, c in zip(nds, copies):
+            nd._data = c
         self._fused_update = (optimizer, kernel[0], kernel[1])
         self._fused_state = None
         self._jit_fbu = None
@@ -426,7 +431,8 @@ class Executor:
         cache = getattr(self, "_seed_cache", None)
         if cache is None or cache[0] != sig:
             outs_shape = jax.eval_shape(self._jit_fwd_train, args, aux, key)[0]
-            self._seed_cache = (sig, [jnp.ones(o.shape, o.dtype) for o in outs_shape])
+            self._seed_cache = (sig, [jnp.asarray(np.ones(o.shape, o.dtype))
+                                      for o in outs_shape])
         return self._seed_cache[1]
 
     def backward(self, out_grads=None, is_train=True):

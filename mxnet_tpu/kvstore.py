@@ -57,12 +57,27 @@ class KVStore:
 
     # -- data plane ---------------------------------------------------------
     def init(self, key, value):
-        """Initialize key(s) once (reference: kvstore.py:114)."""
+        """Initialize key(s) once (reference: kvstore.py:114).
+
+        All stored copies run as ONE jitted program — per-array copies
+        would compile one XLA program per distinct shape (~1.4s each
+        through the TPU tunnel's remote compiler)."""
         keys, vals = _ctype_key_value(key, value)
+        fresh = []
         for k, vlist in zip(keys, vals):
             if k in self._store:
                 raise MXNetError("key %r already initialized" % (k,))
-            self._store[k] = vlist[0].copy()
+            fresh.append((k, vlist[0]))
+        if not fresh:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import _wrap
+        copies = jax.jit(lambda xs: tuple(jnp.array(x) for x in xs))(
+            tuple(v._data for _, v in fresh))
+        for (k, _), c in zip(fresh, copies):
+            self._store[k] = _wrap(c)
 
     def _reduce(self, k, vlist):
         """Merge per-device values for one key (reference CommCPU/CommDevice

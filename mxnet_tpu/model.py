@@ -56,11 +56,14 @@ def _create_kvstore(kvstore, num_device, arg_params):
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
     """Reference: model.py:87."""
-    for idx, param_on_devs in enumerate(param_arrays):
-        name = param_names[idx]
-        kvstore.init(name, arg_params[name])
-        if update_on_kvstore:
-            kvstore.pull(name, param_on_devs, priority=-idx)
+    # one batched init call -> the store copies all keys in one compiled
+    # program instead of one per parameter shape
+    names = list(param_names[:len(param_arrays)])
+    if names:
+        kvstore.init(names, [arg_params[n] for n in names])
+    if update_on_kvstore:
+        for idx, param_on_devs in enumerate(param_arrays):
+            kvstore.pull(param_names[idx], param_on_devs, priority=-idx)
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):

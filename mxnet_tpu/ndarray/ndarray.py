@@ -176,8 +176,10 @@ class NDArray:
     def copyto(self, other):
         """Reference: CopyFromTo (src/ndarray/ndarray.cc:1162)."""
         if isinstance(other, NDArray):
-            other._data = jax.device_put(self._data.astype(other.dtype),
-                                         next(iter(other._data.devices())))
+            src = self._data if self._data.dtype == other._data.dtype \
+                else self._data.astype(other.dtype)
+            other._data = jax.device_put(
+                src, next(iter(other._data.devices())))
             return other
         ctx = Context(other)
         return NDArray(jax.device_put(self._data, ctx.jax_device))
@@ -192,7 +194,9 @@ class NDArray:
     # -- autograd -----------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
         """Reference: ndarray.py attach_grad -> MXAutogradMarkVariables."""
-        self._grad = NDArray(jnp.zeros_like(self._data))
+        # host-built zeros: a transfer, not a per-shape XLA program
+        self._grad = NDArray(jnp.asarray(
+            np.zeros(self._data.shape, self._data.dtype)))
         self._grad_req = grad_req
         self._ag_leaf = True
 
@@ -480,9 +484,31 @@ class NDArray:
             v = value._data
         else:
             v = value
-        if isinstance(key, slice) and key == slice(None) and not isinstance(v, (int, float)):
+        if isinstance(key, slice) and key == slice(None) and \
+                isinstance(v, (bool, int, float, np.number)):
+            # full-slice constant fill: build on host and transfer — no
+            # XLA program (per-shape remote compiles through the TPU
+            # tunnel cost ~1.4s each; parameter init hits this path for
+            # every distinct shape). A constant overwrite disconnects
+            # the array from the tape by definition.
+            self._data = jnp.asarray(
+                np.full(self.shape, v, dtype=self._data.dtype))
+            self._ag_slot = None
+        elif isinstance(key, slice) and key == slice(None) and \
+                isinstance(v, np.ndarray):
+            # host-array full overwrite: broadcast/cast in numpy, one
+            # device transfer, no compile (same disconnect semantics)
+            self._data = jnp.asarray(np.broadcast_to(
+                v.astype(self._data.dtype, copy=False), self.shape))
+            self._ag_slot = None
+        elif isinstance(key, slice) and key == slice(None) and not isinstance(v, (int, float)):
             v = jnp.asarray(v)
-            self._data = jnp.broadcast_to(v.astype(self._data.dtype), self.shape)
+            if v.shape == self.shape and v.dtype == self._data.dtype:
+                # immutable buffers make sharing safe — no device program
+                self._data = v
+            else:
+                self._data = jnp.broadcast_to(v.astype(self._data.dtype),
+                                              self.shape)
             if isinstance(value, NDArray):
                 self._ag_slot = value._ag_slot
         else:
@@ -547,15 +573,19 @@ def empty(shape, ctx=None, dtype=None):
 
 
 def zeros(shape, ctx=None, dtype=None, **kwargs):
-    return NDArray(jnp.zeros(shape, dtype_np(dtype)), ctx=ctx)
+    # constant creators build on HOST and transfer: a per-shape XLA
+    # broadcast program costs ~1.4s to compile through the TPU tunnel,
+    # and executor binds create one buffer per argument shape
+    return NDArray(jnp.asarray(np.zeros(shape, dtype_np(dtype))), ctx=ctx)
 
 
 def ones(shape, ctx=None, dtype=None, **kwargs):
-    return NDArray(jnp.ones(shape, dtype_np(dtype)), ctx=ctx)
+    return NDArray(jnp.asarray(np.ones(shape, dtype_np(dtype))), ctx=ctx)
 
 
 def full(shape, val, ctx=None, dtype=None):
-    return NDArray(jnp.full(shape, val, dtype_np(dtype)), ctx=ctx)
+    return NDArray(jnp.asarray(np.full(shape, val, dtype_np(dtype))),
+                   ctx=ctx)
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
