@@ -31,7 +31,7 @@ def main():
            "--benchmark", "1", "--kv-store", "tpu",
            "--network", "resnet", "--num-layers", "50",
            "--batch-size", str(BATCH), "--dtype", "bfloat16",
-           "--num-epochs", "1", "--num-batches", "110",
+           "--num-epochs", "1", "--num-batches", "210",
            "--disp-batches", "20"]
     env = dict(os.environ)
     env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")

@@ -212,3 +212,11 @@ def test_fgsm_example():
     clean = float(out.split("clean accuracy=")[-1].split()[0])
     adv = float(out.split("adversarial accuracy=")[-1].split()[0])
     assert adv < clean, out[-500:]
+
+
+def test_benchmark_sweep_driver():
+    out = run_example("image-classification/benchmark.py",
+                      "--networks", "mlp", "--batch-sizes", "32",
+                      "--num-batches", "6", "--image-shape", "3,28,28",
+                      done_marker="img/s")
+    assert '"network": "mlp"' in out and "FAILED" not in out
