@@ -31,6 +31,10 @@ from . import gluon  # noqa: F401
 from . import parallel  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
+from . import kvstore_server  # noqa: F401
+from . import registry  # noqa: F401
+from . import misc  # noqa: F401
+from . import executor_manager  # noqa: F401
 from . import model  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401

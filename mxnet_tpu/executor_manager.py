@@ -1,0 +1,27 @@
+"""Executor-manager helpers (legacy module surface).
+
+Reference: ``python/mxnet/executor_manager.py`` — the pre-Module
+data-parallel training helper whose utilities (`_split_input_slice`,
+`_load_data`, `_load_label`) are imported directly by old user code.
+The real data-parallel engine in this build is
+``module/executor_group.py`` (DataParallelExecutorGroup); this module
+re-exports the shared helpers under their reference names.
+"""
+from .module.executor_group import (  # noqa: F401
+    _load_general,
+    _split_input_slice,
+)
+
+__all__ = ["_split_input_slice", "_load_data", "_load_label",
+           "_load_general"]
+
+
+def _load_data(batch, targets):
+    """Scatter a DataBatch's data into per-device buffers
+    (reference: executor_manager.py:81)."""
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    """Scatter a DataBatch's labels (reference: executor_manager.py:86)."""
+    _load_general(batch.label, targets)

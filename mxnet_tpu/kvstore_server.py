@@ -1,0 +1,52 @@
+"""Server/scheduler role entry point.
+
+Reference: ``python/mxnet/kvstore_server.py:28-75`` — in the ps-lite
+design, processes launched with ``DMLC_ROLE`` of ``server`` or
+``scheduler`` block inside ``KVStoreServer.run()`` serving key/value
+RPCs until shutdown.
+
+TPU-native divergence (documented in docs/faq/distributed_training.md):
+the data plane is compiled XLA collectives — there are no parameter
+servers, and the scheduler role collapses into jax.distributed's
+coordinator inside worker 0's process.  ``run()`` therefore logs the
+divergence and returns so launcher scripts that still spawn server
+processes exit cleanly instead of hanging.
+"""
+import logging
+import os
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """Reference: kvstore_server.py KVStoreServer."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def _controller(self):
+        def server_controller(cmd_id, cmd_body):
+            if not self.init_logging:
+                logging.basicConfig(level=logging.INFO)
+                self.init_logging = True
+        return server_controller
+
+    def run(self):
+        """No parameter server exists in the TPU build; return so the
+        launcher's server process exits cleanly."""
+        logging.getLogger(__name__).info(
+            "kvstore=tpu uses compiled collectives; the %s role has no "
+            "server loop to run (reference kvstore_server.py:52 blocked "
+            "here).", os.environ.get("DMLC_ROLE", "server"))
+
+
+def _init_kvstore_server_module():
+    """Reference: kvstore_server.py:77 — called at import in the
+    reference to hijack server/scheduler processes.  Worker and
+    single-process roles fall through untouched."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role in ("server", "scheduler"):
+        server = KVStoreServer()
+        server.run()
+    return role

@@ -65,10 +65,12 @@ def test_autograd_grad():
         y = (x * x).sum()
     with pytest.raises(mx.base.MXNetError):
         autograd.grad(y, z)
+    # create_graph=True returns a differentiable gradient (full
+    # coverage in tests/test_higher_order_grad.py)
     with autograd.record():
         y = (x * x).sum()
-    with pytest.raises(mx.base.MXNetError):
-        autograd.grad(y, x, create_graph=True)
+        gx = autograd.grad(y, x, create_graph=True)
+    assert np.allclose(gx.asnumpy(), 2 * x.asnumpy())
 
 
 def test_autograd_grad_intermediate():
@@ -185,3 +187,58 @@ def test_test_utils_long_tail():
     assert tu.list_gpus() == []
     with pytest.raises(mx.base.MXNetError):
         tu.download("http://example.com/file.bin", fname="/tmp/никогда")
+
+
+def test_registry_module():
+    """Reference: python/mxnet/registry.py generic factory machinery."""
+    from mxnet_tpu import registry
+
+    class Base:
+        pass
+
+    reg = registry.get_register_func(Base, "widget")
+    create = registry.get_create_func(Base, "widget")
+    al = registry.get_alias_func(Base, "widget")
+
+    @al("gadget")
+    @reg
+    class MyWidget(Base):
+        def __init__(self, size=1):
+            self.size = size
+
+    w = create("mywidget", size=3)
+    assert isinstance(w, MyWidget) and w.size == 3
+    assert isinstance(create("gadget"), MyWidget)
+    # instance passthrough + json config
+    assert create(w) is w
+    w2 = create('{"widget": "mywidget", "size": 7}')
+    assert w2.size == 7
+    with pytest.raises(mx.base.MXNetError):
+        create("nope")
+
+
+def test_misc_and_executor_manager_and_server():
+    import warnings
+    from mxnet_tpu import misc, executor_manager, kvstore_server
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        sched = misc.FactorScheduler(step=2, factor=0.5)
+    assert sched(0) > sched(5)
+    ms = misc.multi_factor_scheduler(0, 10, step=[1, 2])
+    assert ms is not None and misc.multi_factor_scheduler(5, 10, step=[1]) is None
+
+    slices = executor_manager._split_input_slice(10, [1, 1])
+    assert [s.stop - s.start for s in slices] == [5, 5]
+
+    # server role facade returns instead of blocking (no PS in TPU build)
+    import os
+    old = os.environ.get("DMLC_ROLE")
+    os.environ["DMLC_ROLE"] = "server"
+    try:
+        assert kvstore_server._init_kvstore_server_module() == "server"
+    finally:
+        if old is None:
+            os.environ.pop("DMLC_ROLE", None)
+        else:
+            os.environ["DMLC_ROLE"] = old
