@@ -1,0 +1,107 @@
+"""Long-context causal transformer LM with sequence parallelism.
+
+No reference analogue: MXNet 1.2's long-sequence story was bucketing +
+fused RNN (docs/faq/bucketing.md); this example shows the TPU-native
+replacement (SURVEY.md §5.7/§7):
+
+1. Train a small decoder-only LM (gluon.contrib.transformer) on a
+   synthetic structured-sequence task; attention runs the Pallas flash
+   kernel on TPU.
+2. Evaluate on sequences 8x longer under a sequence-parallel mesh:
+   ``with parallel.mesh_scope(make_mesh(sp=N))`` transparently reroutes
+   the SAME model's attention through ring attention (K/V blocks
+   rotating over ICI, O(T/sp) memory per device) — and we verify the
+   logits match the dense path exactly.
+
+Runs anywhere: use XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for a virtual 8-device mesh.
+
+Usage: python transformer_lm.py [--epochs 2] [--sp 8]
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, parallel
+from mxnet_tpu.gluon.contrib.transformer import TransformerLM
+
+VOCAB = 32
+
+
+def make_batch(rng, batch, seq_len):
+    """Structured sequences: a repeating motif of random period — the
+    model must learn to copy the token from one period back."""
+    period = rng.randint(4, 9)
+    motif = rng.randint(2, VOCAB, (batch, period))
+    reps = seq_len // period + 2
+    seq = np.tile(motif, (1, reps))[:, :seq_len + 1]
+    return seq[:, :-1].astype(np.float32), seq[:, 1:].astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batches-per-epoch", type=int, default=60)
+    ap.add_argument("--units", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--sp", type=int, default=8,
+                    help="sequence-parallel width for the long-context eval")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    long_len = 8 * args.seq_len
+    lm = TransformerLM(VOCAB, units=args.units, hidden_size=4 * args.units,
+                       num_layers=args.layers, num_heads=args.heads,
+                       max_len=long_len)
+    lm.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(lm.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        losses = []
+        for _ in range(args.batches_per_epoch):
+            x, y = make_batch(rng, args.batch_size, args.seq_len)
+            xb, yb = nd.array(x), nd.array(y)
+            with autograd.record():
+                logits = lm(xb)
+                loss = loss_fn(logits.reshape((-1, VOCAB)),
+                               yb.reshape((-1,))).mean()
+            loss.backward()
+            trainer.step(1)
+            losses.append(float(loss.asnumpy()))
+        logging.info("Epoch[%d] loss=%.4f", epoch, np.mean(losses))
+
+    # long-context eval: same weights, 8x the training context, attention
+    # sequence-sharded over the sp mesh
+    x, y = make_batch(rng, 2, long_len)
+    xb = nd.array(x)
+    dense_logits = lm(xb).asnumpy()
+    dense_acc = (dense_logits.argmax(-1) == y).mean()
+
+    import jax
+    n_dev = len(jax.devices())
+    sp = min(args.sp, n_dev)
+    if sp > 1:
+        mesh = parallel.make_mesh(dp=1, sp=sp,
+                                  devices=jax.devices()[:sp])
+        with parallel.mesh_scope(mesh):
+            sp_logits = lm(xb).asnumpy()
+        err = np.abs(dense_logits - sp_logits).max()
+        print("long-context eval: T=%d acc=%.3f | sp=%d ring-attention "
+              "max |delta logits| = %.2e" % (long_len, dense_acc, sp, err))
+        assert err < 1e-3, "ring attention diverged from dense"
+    else:
+        print("long-context eval: T=%d acc=%.3f | single device "
+              "(no sp mesh)" % (long_len, dense_acc))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,2 +1,3 @@
 """Experimental gluon blocks (reference: python/mxnet/gluon/contrib/)."""
 from . import rnn  # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,117 @@
+"""Transformer building blocks (long-context model family).
+
+No reference analogue — MXNet 1.2 predates attention (SURVEY.md §5.7:
+its long-sequence story was bucketing + fused RNN).  These layers are
+the model-level consumers of the TPU-native attention stack:
+
+- single chip: ``F.contrib.flash_attention`` lowers to the Pallas flash
+  kernel on TPU (O(T) memory), einsum elsewhere.
+- sequence-sharded: the same math runs under
+  ``parallel.ring_attention``/``ulysses_attention`` over an ``sp`` mesh
+  axis; ``example/long-context/transformer_lm.py`` shows the handoff.
+
+Pre-LN residual blocks (the variant that trains stably without warmup).
+"""
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+
+__all__ = ["MultiHeadAttention", "TransformerEncoderCell", "TransformerLM"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention with optional GQA (num_kv_heads < num_heads).
+
+    Input (B, T, C); output (B, T, C).
+    """
+
+    def __init__(self, units, num_heads, num_kv_heads=None, causal=False,
+                 dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError("units (%d) must divide num_heads (%d)"
+                             % (units, num_heads))
+        self._units = units
+        self._h = num_heads
+        self._hkv = num_kv_heads or num_heads
+        if self._h % self._hkv:
+            raise ValueError("num_heads must be a multiple of num_kv_heads")
+        self._d = units // num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.q_proj = Dense(self._h * self._d, use_bias=False,
+                                flatten=False, prefix="q_")
+            self.k_proj = Dense(self._hkv * self._d, use_bias=False,
+                                flatten=False, prefix="k_")
+            self.v_proj = Dense(self._hkv * self._d, use_bias=False,
+                                flatten=False, prefix="v_")
+            self.out_proj = Dense(units, use_bias=False, flatten=False,
+                                  prefix="out_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        q = self.q_proj(x).reshape((0, 0, self._h, self._d))
+        k = self.k_proj(x).reshape((0, 0, self._hkv, self._d))
+        v = self.v_proj(x).reshape((0, 0, self._hkv, self._d))
+        o = F.contrib.flash_attention(q, k, v, causal=self._causal)
+        o = self.out_proj(o.reshape((0, 0, -1)))
+        return self.drop(o) if self.drop is not None else o
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-LN block: x + MHA(LN(x)); x + FFN(LN(x))."""
+
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads=None,
+                 causal=False, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = LayerNorm()
+            self.attn = MultiHeadAttention(units, num_heads,
+                                           num_kv_heads=num_kv_heads,
+                                           causal=causal, dropout=dropout)
+            self.ln2 = LayerNorm()
+            self.ffn = HybridSequential(prefix="ffn_")
+            with self.ffn.name_scope():
+                self.ffn.add(Dense(hidden_size, activation="relu",
+                                   flatten=False))
+                self.ffn.add(Dense(units, flatten=False))
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.ln1(x))
+        h = self.ffn(self.ln2(x))
+        if self.drop is not None:
+            h = self.drop(h)
+        return x + h
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only causal LM: embed -> N pre-LN blocks -> tied-free head.
+
+    Learned positional embeddings sized to ``max_len``; inputs are
+    (B, T) int token ids, outputs (B, T, vocab) logits.
+    """
+
+    def __init__(self, vocab_size, units=128, hidden_size=512, num_layers=2,
+                 num_heads=4, num_kv_heads=None, max_len=512, dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._max_len = max_len
+        with self.name_scope():
+            self.embed = Embedding(vocab_size, units)
+            self.pos_embed = Embedding(max_len, units)
+            self.blocks = HybridSequential(prefix="blocks_")
+            with self.blocks.name_scope():
+                for _ in range(num_layers):
+                    self.blocks.add(TransformerEncoderCell(
+                        units, hidden_size, num_heads,
+                        num_kv_heads=num_kv_heads, causal=True,
+                        dropout=dropout))
+            self.ln_f = LayerNorm()
+            self.head = Dense(vocab_size, flatten=False, prefix="head_")
+
+    def hybrid_forward(self, F, tokens):
+        T = tokens.shape[-1] if hasattr(tokens, "shape") else None
+        pos = F.arange(0, self._max_len).slice_axis(axis=0, begin=0, end=T)
+        x = self.embed(tokens) + self.pos_embed(pos).expand_dims(0)
+        x = self.blocks(x)
+        return self.head(self.ln_f(x))

@@ -808,3 +808,27 @@ def _fused_bn_relu(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     y = fused_scale_bias_relu(flat, scale, bias, relu=_boolattr(act))
     H, W = data.shape[2], data.shape[3]
     return jnp.transpose(y.reshape(B, H, W, C), (0, 3, 1, 2))
+
+
+# ---------------------------------------------------------------------------
+# Fused attention (long-context primitive; no reference analogue —
+# MXNet 1.2 predates attention, SURVEY.md §5.7)
+# ---------------------------------------------------------------------------
+@register("_contrib_flash_attention")
+def _flash_attention_op(q, k, v, causal=False, scale=None, **attrs):
+    """Softmax attention over (B, T, H, D) tensors; K/V may carry fewer
+    heads (GQA).  Dispatches to the Pallas flash kernel on TPU (O(T)
+    memory), the einsum path elsewhere (mxnet_tpu/parallel/attention.py
+    local_attention).  For sequence-sharded T use parallel.ring_attention
+    / ulysses_attention over an 'sp' mesh axis."""
+    from ..parallel.attention import local_attention, ring_attention
+    from ..parallel.mesh import current_mesh
+    if scale is not None:
+        scale = float(scale)
+    mesh = current_mesh()
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # an active sp mesh makes the SAME model sequence-parallel:
+        # the time axis shards over the ring, K/V blocks rotate on ICI
+        return ring_attention(q, k, v, mesh=mesh,
+                              causal=_boolattr(causal), scale=scale)
+    return local_attention(q, k, v, causal=_boolattr(causal), scale=scale)

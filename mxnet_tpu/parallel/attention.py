@@ -96,6 +96,31 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _single_device_of(x):
+    """The one device ``x`` lives on when eager/committed, else None
+    (already distributed or inside a trace)."""
+    try:
+        devs = x.devices()
+        if len(devs) == 1:
+            return next(iter(devs))
+    except Exception:
+        pass
+    return None
+
+
+def _restore_device(out, home):
+    """Gather a mesh-sharded eager result back to the caller's device so
+    downstream eager ops (replicated weights on one device) compose.
+    Under jit / with distributed inputs this is a no-op — GSPMD keeps
+    the value sharded."""
+    if home is None:
+        return out
+    try:
+        return jax.device_put(out, home)
+    except Exception:  # pragma: no cover - tracers
+        return out
+
+
 def _pad_to_shards(q, k, v, sp):
     """Pad the time axis up to a multiple of ``sp``.
 
@@ -197,15 +222,22 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
         return local_attention(q, k, v, causal=causal, scale=scale)
     sp = mesh.shape[axis_name]
     t_real = q.shape[1]
+    home = _single_device_of(q)
     q, k, v, kv_len = _pad_to_shards(q, k, v, sp)
     spec = P(None, axis_name, None, None)
+    # explicit scatter onto the mesh: inputs may arrive committed to a
+    # single device (jit outputs are), which shard_map rejects
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     out = fn(q, k, v)
-    return out if kv_len is None else out[:, :t_real]
+    if kv_len is not None:
+        out = out[:, :t_real]
+    return _restore_device(out, home)
 
 
 def _ulysses_local(q, k, v, axis_name, causal, scale, kv_len=None):
@@ -236,12 +268,17 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
             "ulysses needs heads (%d) divisible by sp (%d); use "
             "ring_attention" % (q.shape[2], sp))
     t_real = q.shape[1]
+    home = _single_device_of(q)
     q, k, v, kv_len = _pad_to_shards(q, k, v, sp)
     spec = P(None, axis_name, None, None)
+    sharding = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
     fn = jax.shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
                           scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     out = fn(q, k, v)
-    return out if kv_len is None else out[:, :t_real]
+    if kv_len is not None:
+        out = out[:, :t_real]
+    return _restore_device(out, home)

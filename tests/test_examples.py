@@ -220,3 +220,13 @@ def test_benchmark_sweep_driver():
                       "--num-batches", "6", "--image-shape", "3,28,28",
                       done_marker="img/s")
     assert '"network": "mlp"' in out and "FAILED" not in out
+
+
+def test_long_context_transformer_example():
+    out = run_example(
+        "long-context/transformer_lm.py", "--epochs", "1",
+        "--batches-per-epoch", "25", "--batch-size", "8",
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+        done_marker="ring-attention max")
+    err = float(out.split("|delta logits| =")[-1].split()[0])
+    assert err < 1e-3
