@@ -17,7 +17,8 @@ from ...nn import Sequential, HybridSequential
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
            "CenterCrop", "Resize", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "ColorJitter"]
+           "RandomSaturation", "RandomHue", "RandomLighting",
+           "RandomColorJitter", "ColorJitter"]
 
 
 def _as_np(img):
@@ -209,8 +210,60 @@ class RandomSaturation(Block):
         return ndarray.array(np.clip(a * alpha + gray * (1 - alpha), 0, 255))
 
 
-class ColorJitter(Block):
-    """Random brightness/contrast/saturation (reference: transforms.py:458)."""
+class RandomHue(Block):
+    """Random hue rotation in the YIQ plane (reference: transforms.py:438
+    random_hue — the same linear-RGB approximation the image_random op
+    uses, src/operator/image/image_random-inl.h)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        alpha = np.random.uniform(-self._hue, self._hue)
+        if alpha == 0.0:
+            # the YIQ<->RGB matrices are approximate inverses; skip the
+            # round-trip entirely for a zero rotation
+            return ndarray.array(a)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        t_yiq = np.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], np.float32)
+        t_rgb = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return ndarray.array(np.clip(a @ m.T, 0, 255))
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: transforms.py:478
+    random_lighting; eigen basis from the reference augmenter,
+    image_aug_default.cc)."""
+
+    _EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _as_np(x).astype(np.float32)
+        alpha = np.random.normal(0, self._alpha, 3).astype(np.float32)
+        rgb = (self._EIGVEC * alpha) @ self._EIGVAL
+        return ndarray.array(np.clip(a + rgb, 0, 255))
+
+
+class RandomColorJitter(Block):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: transforms.py:458)."""
 
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         super().__init__()
@@ -221,9 +274,15 @@ class ColorJitter(Block):
             self._transforms.append(RandomContrast(contrast))
         if saturation:
             self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
 
     def forward(self, x):
         order = np.random.permutation(len(self._transforms))
         for i in order:
             x = self._transforms[i](x)
         return x
+
+
+# pre-1.3 name kept for compatibility
+ColorJitter = RandomColorJitter

@@ -151,3 +151,24 @@ def test_resnet50_v1_builds():
     n_params = sum(int(np.prod(p.shape))
                    for p in net.collect_params().values())
     assert 2.4e7 < n_params < 2.7e7, n_params  # ~25.5M params
+
+
+def test_transforms_hue_lighting_colorjitter():
+    """Reference: transforms.py RandomHue/RandomLighting/RandomColorJitter."""
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    img = nd.array(np.random.RandomState(0).randint(
+        0, 255, (8, 8, 3)).astype(np.float32))
+    for tf in (T.RandomHue(0.3), T.RandomLighting(0.3),
+               T.RandomColorJitter(brightness=0.2, contrast=0.2,
+                                   saturation=0.2, hue=0.2)):
+        out = tf(img)
+        assert out.shape == img.shape
+        a = out.asnumpy()
+        assert a.min() >= 0 and a.max() <= 255
+    # zero-strength hue is identity
+    same = T.RandomHue(0.0)(img).asnumpy()
+    assert np.allclose(same, img.asnumpy(), atol=1e-2)
+    assert T.ColorJitter is T.RandomColorJitter
