@@ -416,6 +416,9 @@ class Executor:
                              else self._jit_fwd_eval(args, aux, key))
             self._cached_grads = None
         self._commit(outs, new_aux)
+        if self._monitor_callback is not None and \
+                getattr(self, "_monitor_all", False):
+            self._run_monitor_taps(args, aux, key, is_train)
         return self._outputs
 
     def _commit(self, outs, new_aux):
@@ -538,8 +541,30 @@ class Executor:
                         cast_exclude=self._cast_exclude)
 
     def set_monitor_callback(self, callback, monitor_all=False):
-        """Reference: graph_executor.cc:121 monitor tap (output-level)."""
+        """Reference: graph_executor.cc:121,1444 monitor tap.
+
+        With ``monitor_all=True`` every internal node output is fed to
+        the callback after each forward (the reference taps each engine
+        op as it completes).  The compiled step never materializes
+        intermediates, so monitoring runs a SEPARATE jitted program
+        built from ``symbol.get_internals()`` — slower, like the
+        reference's monitored runs, and only while installed."""
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
+        self._monitor_fn = None
+
+    def _run_monitor_taps(self, args, aux, key, is_train):
+        """Compute + report every internal activation (monitor_all)."""
+        internals = self._symbol.get_internals()
+        if self._monitor_fn is None:
+            fn = build_graph_fn(internals, self.arg_names, self.aux_names,
+                                False)
+            self._monitor_fn = (jax.jit(lambda a, x, k: fn(a, x, k)[0]),
+                                internals.list_outputs())
+        jit_fn, names = self._monitor_fn
+        outs = jit_fn(self._cast_fn(args), aux, key)
+        for name, o in zip(names, outs):
+            self._monitor_callback(name, _wrap(o))
 
     def debug_str(self):
         return self._symbol.debug_str()

@@ -39,8 +39,12 @@ class Monitor:
         self.queue.append((self.step, name, self.stat_func(arr)))
 
     def install(self, exe):
-        """Attach to an executor (reference: monitor.py install)."""
-        exe.set_monitor_callback(self.stat_helper)
+        """Attach to an executor (reference: monitor.py install).
+
+        monitor_all=True matches the reference's semantics: the 1.2
+        engine called the tap for EVERY op output (graph_executor.cc:
+        1444), with ``pattern`` filtering in stat_helper."""
+        exe.set_monitor_callback(self.stat_helper, monitor_all=True)
         self.exes.append(exe)
 
     def tic(self):

@@ -210,3 +210,36 @@ def test_rtc_real_pallas_kernel():
     x = nd.array(_np.arange(8, dtype=_np.float32).reshape(2, 4))
     out = kernel(x)
     assert_almost_equal(out, 2 * x.asnumpy() + 1.0)
+
+
+def test_monitor_taps_internal_nodes():
+    """Monitor must see EVERY node output (reference: Monitor +
+    graph_executor.cc:1444 per-op tap), not just the graph heads."""
+    import numpy as np
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(act, num_hidden=3,
+                                                     name="fc2"),
+                               name="softmax")
+    mon = mx.Monitor(1, pattern=".*")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    it = mx.io.NDArrayIter(np.random.rand(30, 5).astype(np.float32),
+                           np.random.randint(0, 3, 30).astype(np.float32),
+                           batch_size=10, label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(it), is_train=True)
+    names = [r[1] for r in mon.toc()]
+    for expect in ("fc1_output", "relu1_output", "fc2_weight",
+                   "softmax_output"):
+        assert any(expect in n for n in names), (expect, names)
+    # pattern filtering still applies
+    mon2 = mx.Monitor(1, pattern=".*relu.*")
+    mod.install_monitor(mon2)
+    mon2.tic()
+    mod.forward(next(it), is_train=True)
+    names2 = [r[1] for r in mon2.toc()]
+    assert names2 and all("relu" in n for n in names2), names2
