@@ -230,3 +230,11 @@ def test_long_context_transformer_example():
         done_marker="ring-attention max")
     err = float(out.split("|delta logits| =")[-1].split()[0])
     assert err < 1e-3
+
+
+def test_bi_lstm_sort_example():
+    out = run_example("bi-lstm-sort/lstm_sort.py", "--num-epochs", "3",
+                      "--batches-per-epoch", "40",
+                      done_marker="sort accuracy")
+    acc = float(out.split("sort accuracy:")[-1].split()[0])
+    assert acc > 0.8, out[-500:]
