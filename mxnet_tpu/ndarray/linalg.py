@@ -36,3 +36,20 @@ def syrk(A, transpose=False, alpha=1.0, **kwargs):
 
 def sumlogdiag(A, **kwargs):
     return invoke("_linalg_sumlogdiag", [A])
+
+
+def potri(A, **kwargs):
+    """Inverse from a Cholesky factor (reference: la_op potri)."""
+    return invoke("_linalg_potri", [A])
+
+
+def syevd(A, **kwargs):
+    """Symmetric eigendecomposition: returns (U, lambda) with
+    A = U^T diag(lambda) U (reference: la_op syevd)."""
+    return invoke("_linalg_syevd", [A])
+
+
+def gelqf(A, **kwargs):
+    """LQ factorization A = L Q with Q orthonormal rows
+    (reference: la_op gelqf)."""
+    return invoke("_linalg_gelqf", [A])
