@@ -1,0 +1,169 @@
+"""Caffe converter: prototxt text parsing, caffemodel wire decoding,
+symbol + weight conversion.
+
+Reference: tools/caffe_converter/ (convert_symbol/convert_model over
+compiled caffe bindings; here hermetic parsers — test_converter.py
+analogue with synthesized fixtures instead of downloaded models).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "caffe_converter"))
+
+import caffe_parser  # noqa: E402
+from convert_model import convert_model  # noqa: E402
+from convert_symbol import convert_symbol  # noqa: E402
+
+PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_dim: 1
+input_dim: 3
+input_dim: 8
+input_dim: 8
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer {
+  name: "bn1"
+  type: "BatchNorm"
+  bottom: "conv1"
+  top: "bn1"
+  batch_norm_param { use_global_stats: true eps: 0.00001 }
+}
+layer {
+  name: "scale1"
+  type: "Scale"
+  bottom: "bn1"
+  top: "bn1"
+  scale_param { bias_term: true }
+}
+layer { name: "relu1" type: "ReLU" bottom: "bn1" top: "bn1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "bn1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "fc1"
+  inner_product_param { num_output: 5 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc1" top: "prob" }
+"""
+
+
+def test_prototxt_parser():
+    net = caffe_parser.parse_prototxt(PROTOTXT)
+    assert net["name"] == "TinyNet"
+    assert net["input_dim"] == [1, 3, 8, 8]
+    layers = caffe_parser.get_layers(net)
+    assert [l["type"] for l in layers] == [
+        "Convolution", "BatchNorm", "Scale", "ReLU", "Pooling",
+        "InnerProduct", "Softmax"]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+
+
+def test_caffemodel_wire_roundtrip():
+    rng = np.random.RandomState(0)
+    blobs = {
+        "conv1": [((4, 3, 3, 3), rng.randn(108).tolist()),
+                  ((4,), rng.randn(4).tolist())],
+        "fc1": [((5, 64), rng.randn(320).tolist()),
+                ((5,), rng.randn(5).tolist())],
+    }
+    raw = caffe_parser.write_caffemodel(blobs)
+    parsed = caffe_parser.parse_caffemodel(raw)
+    assert set(parsed) == {"conv1", "fc1"}
+    for name in blobs:
+        for (s1, d1), (s2, d2) in zip(blobs[name], parsed[name]):
+            assert s1 == s2
+            assert np.allclose(d1, d2)
+
+
+def test_convert_symbol_structure():
+    sym, input_name, scale_merge = convert_symbol(PROTOTXT)
+    assert input_name == "data"
+    assert scale_merge == {"scale1": "bn1"}
+    args = sym.list_arguments()
+    for want in ("conv1_weight", "conv1_bias", "bn1_gamma", "bn1_beta",
+                 "fc1_weight", "fc1_bias"):
+        assert want in args, args
+    auxs = sym.list_auxiliary_states()
+    assert "bn1_moving_mean" in auxs and "bn1_moving_var" in auxs
+
+
+def test_convert_model_end_to_end():
+    rng = np.random.RandomState(1)
+    conv_w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    conv_b = rng.randn(4).astype(np.float32) * 0.1
+    bn_mean = rng.rand(4).astype(np.float32)
+    bn_var = rng.rand(4).astype(np.float32) + 0.5
+    bn_scale = np.array([2.0], np.float32)      # caffe stores scaled stats
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32) * 0.1
+    fc_w = rng.randn(5, 64).astype(np.float32) * 0.1
+    fc_b = rng.randn(5).astype(np.float32) * 0.1
+    raw = caffe_parser.write_caffemodel({
+        "conv1": [(conv_w.shape, conv_w.ravel().tolist()),
+                  (conv_b.shape, conv_b.ravel().tolist())],
+        "bn1": [((4,), (bn_mean * 2.0).tolist()),
+                ((4,), (bn_var * 2.0).tolist()),
+                ((1,), bn_scale.tolist())],
+        "scale1": [((4,), gamma.tolist()), ((4,), beta.tolist())],
+        "fc1": [(fc_w.shape, fc_w.ravel().tolist()),
+                (fc_b.shape, fc_b.ravel().tolist())],
+    })
+    sym, arg_params, aux_params = convert_model(PROTOTXT, raw)
+    assert np.allclose(aux_params["bn1_moving_mean"].asnumpy(), bn_mean)
+    assert np.allclose(aux_params["bn1_moving_var"].asnumpy(), bn_var)
+    assert np.allclose(arg_params["bn1_gamma"].asnumpy(), gamma)
+
+    # run the converted net and diff against a numpy forward
+    x = rng.rand(1, 3, 8, 8).astype(np.float32)
+    exe = sym.simple_bind(data=(1, 3, 8, 8), softmax_label=(1,))
+    for k, v in arg_params.items():
+        exe.arg_dict[k][:] = v.asnumpy()
+    for k, v in aux_params.items():
+        exe.aux_dict[k][:] = v.asnumpy()
+    exe.forward(is_train=False, data=x)
+    got = exe.outputs[0].asnumpy()
+
+    # numpy oracle
+    def conv(xin, w, b):
+        n, c, h, wd = xin.shape
+        o, _, kh, kw = w.shape
+        pad = np.pad(xin, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        out = np.zeros((n, o, h, wd), np.float32)
+        for i in range(h):
+            for j in range(wd):
+                patch = pad[:, :, i:i + kh, j:j + kw]
+                out[:, :, i, j] = np.tensordot(
+                    patch, w, axes=([1, 2, 3], [1, 2, 3])) + b
+        return out
+
+    y = conv(x, conv_w, conv_b)
+    y = (y - bn_mean[None, :, None, None]) / np.sqrt(
+        bn_var[None, :, None, None] + 1e-5)
+    y = gamma[None, :, None, None] * y + beta[None, :, None, None]
+    y = np.maximum(y, 0)
+    y = y.reshape(1, 4, 4, 2, 4, 2).max(-1).max(-2)  # 2x2 maxpool
+    logits = y.reshape(1, -1) @ fc_w.T + fc_b
+    p = np.exp(logits - logits.max())
+    p /= p.sum()
+    assert np.allclose(got, p, atol=1e-4), (got, p)

@@ -1,0 +1,258 @@
+"""Self-contained Caffe file parsers (no caffe/protobuf dependency).
+
+Reference: ``tools/caffe_converter/caffe_parser.py`` — the reference
+shells out to the compiled caffe.proto bindings; this build parses the
+two wire formats directly so conversion works in a hermetic
+environment:
+
+- prototxt: protobuf TEXT format (braces + key: value lines), parsed
+  into nested dicts with repeated-field lists.
+- caffemodel: protobuf BINARY wire format, decoded generically
+  (varint/length-delimited framing) with the small set of NetParameter/
+  LayerParameter/BlobProto field numbers from caffe.proto.
+"""
+import struct
+
+# --------------------------------------------------------------------------
+# prototxt (protobuf text format)
+# --------------------------------------------------------------------------
+
+
+_TOKEN_RE = None
+
+
+def _scan(text):
+    """Lexer: quoted strings, braces, colons, bare atoms; '#' comments."""
+    global _TOKEN_RE
+    if _TOKEN_RE is None:
+        import re
+        _TOKEN_RE = re.compile(
+            r'"(?:[^"\\]|\\.)*"'      # quoted string
+            r"|[{}:]"                  # structural
+            r"|[^\s{}:\"#]+"           # bare atom
+            r"|#[^\n]*")               # comment (dropped)
+    for m in _TOKEN_RE.finditer(text):
+        tok = m.group(0)
+        if not tok.startswith("#"):
+            yield tok
+
+
+def _tokenize(text):
+    """Token stream -> (key, '{') / (key, value) / '}' events."""
+    toks = list(_scan(text))
+    i = 0
+    while i < len(toks):
+        tok = toks[i]
+        if tok == "}":
+            yield "}"
+            i += 1
+        elif i + 1 < len(toks) and toks[i + 1] == ":":
+            if i + 2 < len(toks) and toks[i + 2] == "{":
+                yield (tok, "{")
+                i += 3
+            else:
+                yield (tok, _text_value(toks[i + 2]))
+                i += 3
+        elif i + 1 < len(toks) and toks[i + 1] == "{":
+            yield (tok, "{")
+            i += 2
+        else:
+            raise ValueError("unexpected token %r in prototxt" % tok)
+
+
+def _text_value(val):
+    val = val.strip()
+    if val.startswith('"') and val.endswith('"'):
+        return val[1:-1]
+    if val in ("true", "false"):
+        return val == "true"
+    try:
+        return int(val)
+    except ValueError:
+        pass
+    try:
+        return float(val)
+    except ValueError:
+        return val
+
+
+def parse_prototxt(text):
+    """Text-format protobuf -> dict; repeated keys become lists."""
+    root = {}
+    stack = [root]
+    for tok in _tokenize(text):
+        if tok == "}":
+            stack.pop()
+            continue
+        key, val = tok
+        cur = stack[-1]
+        if val == "{":
+            child = {}
+            _append(cur, key, child)
+            stack.append(child)
+        else:
+            _append(cur, key, val)
+    if len(stack) != 1:
+        raise ValueError("unbalanced braces in prototxt")
+    return root
+
+
+def _append(d, key, val):
+    if key in d:
+        if not isinstance(d[key], list):
+            d[key] = [d[key]]
+        d[key].append(val)
+    else:
+        d[key] = val
+
+
+def as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def get_layers(net):
+    """Layers from either the new ('layer') or legacy ('layers') field."""
+    return as_list(net.get("layer")) or as_list(net.get("layers"))
+
+
+# --------------------------------------------------------------------------
+# caffemodel (protobuf binary wire format)
+# --------------------------------------------------------------------------
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _iter_fields(buf):
+    """Yield (field_number, wire_type, value) over a protobuf message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:                      # varint
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:                    # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:                    # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:                    # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        yield field, wire, val
+
+
+def _floats(val, wire):
+    """Decode a repeated-float field (packed bytes or a single fixed32)."""
+    if wire == 5:
+        return list(struct.unpack("<f", val))
+    return list(struct.unpack("<%df" % (len(val) // 4), val))
+
+
+def parse_blob(buf):
+    """BlobProto -> (shape tuple, float list).
+
+    caffe.proto: shape=7 (BlobShape.dim=1), data=5 (packed float),
+    legacy dims num=1 channels=2 height=3 width=4."""
+    shape, data = [], []
+    legacy = {}
+    for field, wire, val in _iter_fields(buf):
+        if field == 7 and wire == 2:       # BlobShape
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1:
+                    if w2 == 2:            # packed int64
+                        pos = 0
+                        while pos < len(v2):
+                            d, pos = _read_varint(v2, pos)
+                            shape.append(d)
+                    else:
+                        shape.append(v2)
+        elif field == 5:                   # data
+            data.extend(_floats(val, wire))
+        elif field in (1, 2, 3, 4) and wire == 0:
+            legacy[field] = val
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    return tuple(int(s) for s in shape), data
+
+
+def parse_caffemodel(buf):
+    """NetParameter -> {layer_name: [(shape, floats), ...]}.
+
+    caffe.proto: LayerParameter at field 100 (new) / V1LayerParameter at
+    field 2 (legacy); within a layer: name=1 (.. legacy: 4+? name is 4
+    in V0 but 1 in both V1 and new), blobs=7 (V1: 6)."""
+    out = {}
+    for field, wire, val in _iter_fields(buf):
+        if field not in (100, 2) or wire != 2:
+            continue
+        blob_field = 7 if field == 100 else 6
+        name = None
+        blobs = []
+        for f2, w2, v2 in _iter_fields(val):
+            if f2 == 1 and w2 == 2:
+                try:
+                    name = v2.decode()
+                except UnicodeDecodeError:
+                    name = None
+            elif f2 == blob_field and w2 == 2:
+                blobs.append(parse_blob(v2))
+        if name is not None and blobs:
+            out[name] = blobs
+    return out
+
+
+# --------------------------------------------------------------------------
+# writers (round-trip support + test fixtures)
+# --------------------------------------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num, wire, payload):
+    return _varint((num << 3) | wire) + payload
+
+
+def write_blob(shape, floats):
+    dims = b"".join(_varint(int(d)) for d in shape)
+    shape_msg = _field(1, 2, _varint(len(dims)) + dims)
+    data = struct.pack("<%df" % len(floats), *floats)
+    return (_field(7, 2, _varint(len(shape_msg)) + shape_msg)
+            + _field(5, 2, _varint(len(data)) + data))
+
+
+def write_caffemodel(layers):
+    """{name: [(shape, floats), ...]} -> NetParameter bytes (new format)."""
+    out = bytearray()
+    for name, blobs in layers.items():
+        body = _field(1, 2, _varint(len(name.encode())) + name.encode())
+        for shape, floats in blobs:
+            blob = write_blob(shape, floats)
+            body += _field(7, 2, _varint(len(blob)) + blob)
+        out += _field(100, 2, _varint(len(body)) + bytes(body))
+    return bytes(out)

@@ -1,0 +1,78 @@
+"""Caffe (prototxt, caffemodel) -> mxnet_tpu (symbol json, params).
+
+Reference: ``tools/caffe_converter/convert_model.py``.  Weights are
+decoded straight from the caffemodel's protobuf wire format
+(caffe_parser.parse_caffemodel — no caffe install needed) and renamed
+to this framework's argument convention:
+
+- Convolution/InnerProduct: blob0 -> <name>_weight, blob1 -> <name>_bias
+- BatchNorm (+merged Scale): bn blob0/blob1 scaled by 1/blob2 ->
+  <bn>_moving_mean / <bn>_moving_var (aux); the merged Scale layer's
+  blob0/blob1 -> <bn>_gamma / <bn>_beta
+
+Usage:
+  python convert_model.py net.prototxt net.caffemodel out-prefix
+  -> out-prefix-symbol.json + out-prefix-0000.params
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import caffe_parser  # noqa: E402
+from convert_symbol import convert_symbol  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def convert_model(prototxt_text, caffemodel_bytes):
+    """Returns (symbol, arg_params, aux_params)."""
+    sym, _, scale_merge = convert_symbol(prototxt_text)
+    net = caffe_parser.parse_prototxt(prototxt_text)
+    layers = caffe_parser.get_layers(net)
+    weights = caffe_parser.parse_caffemodel(caffemodel_bytes)
+    ltype = {str(l.get("name")): l.get("type") for l in layers}
+
+    arg_params, aux_params = {}, {}
+    for name, blobs in weights.items():
+        arrs = [np.asarray(data, np.float32).reshape(shape)
+                for shape, data in blobs]
+        kind = ltype.get(name)
+        if kind in ("Convolution", "InnerProduct", "Deconvolution"):
+            arg_params[name + "_weight"] = mx.nd.array(arrs[0])
+            if len(arrs) > 1:
+                arg_params[name + "_bias"] = mx.nd.array(arrs[1])
+        elif kind == "BatchNorm":
+            scale = arrs[2].reshape(())[()] if len(arrs) > 2 else 1.0
+            scale = 1.0 / scale if scale != 0 else 0.0
+            aux_params[name + "_moving_mean"] = mx.nd.array(arrs[0] * scale)
+            aux_params[name + "_moving_var"] = mx.nd.array(arrs[1] * scale)
+        elif kind == "Scale" and name in scale_merge:
+            bn = scale_merge[name]
+            arg_params[bn + "_gamma"] = mx.nd.array(arrs[0])
+            if len(arrs) > 1:
+                arg_params[bn + "_beta"] = mx.nd.array(arrs[1])
+        # other layer kinds carry no learnable blobs we map
+    return sym, arg_params, aux_params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prototxt")
+    ap.add_argument("caffemodel")
+    ap.add_argument("prefix", help="output prefix")
+    args = ap.parse_args()
+    sym, arg_params, aux_params = convert_model(
+        open(args.prototxt).read(), open(args.caffemodel, "rb").read())
+    mx.model.save_checkpoint(args.prefix, 0, sym, arg_params, aux_params)
+    print("wrote %s-symbol.json and %s-0000.params"
+          % (args.prefix, args.prefix))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,163 @@
+"""Caffe prototxt -> mxnet_tpu Symbol.
+
+Reference: ``tools/caffe_converter/convert_symbol.py`` (proto_to_symbol
+over the compiled caffe bindings; here over the hermetic text parser).
+Supports the common CNN layer set: Input/Data, Convolution, Pooling,
+InnerProduct, ReLU/Sigmoid/TanH, LRN, Dropout, BatchNorm(+Scale merge),
+Eltwise, Concat, Flatten, Softmax/SoftmaxWithLoss/Accuracy.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import caffe_parser  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _pair(param, key, default=0):
+    v = param.get(key, param.get("%s_h" % key, default))
+    if isinstance(v, list):
+        v = v[0]
+    return (int(v), int(v))
+
+
+def convert_symbol(prototxt_text):
+    """Returns (symbol, input_name, layer_name->symbol map)."""
+    net = caffe_parser.parse_prototxt(prototxt_text)
+    layers = caffe_parser.get_layers(net)
+    blobs = {}
+    input_name = "data"
+
+    if "input" in net:
+        input_name = caffe_parser.as_list(net["input"])[0]
+    blobs[input_name] = mx.sym.Variable(input_name)
+    sym = blobs[input_name]
+    last = sym
+
+    scale_merge = {}   # scale layer name -> bn layer name (weight remap)
+    skip = set()
+    for idx, layer in enumerate(layers):
+        ltype = layer.get("type")
+        name = str(layer.get("name", ltype))
+        if name in skip:
+            continue
+        bottoms = caffe_parser.as_list(layer.get("bottom"))
+        tops = caffe_parser.as_list(layer.get("top")) or [name]
+        ins = [blobs[b] for b in bottoms if b in blobs]
+        x = ins[0] if ins else last
+
+        if ltype in ("Input", "Data"):
+            blobs[tops[0]] = blobs.get(input_name,
+                                       mx.sym.Variable(input_name))
+            last = blobs[tops[0]]
+            continue
+        if ltype == "Convolution":
+            p = layer.get("convolution_param", {})
+            kernel = _pair(p, "kernel_size")
+            out = mx.sym.Convolution(
+                x, name=name, num_filter=int(p.get("num_output")),
+                kernel=kernel, stride=_pair(p, "stride", 1),
+                pad=_pair(p, "pad", 0), num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            pool = str(p.get("pool", "MAX")).lower()
+            pool = {"max": "max", "ave": "avg", "0": "max",
+                    "1": "avg"}.get(pool, "max")
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(x, name=name, pool_type=pool,
+                                     global_pool=True, kernel=(1, 1))
+            else:
+                out = mx.sym.Pooling(
+                    x, name=name, pool_type=pool,
+                    kernel=_pair(p, "kernel_size"),
+                    stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                    pooling_convention="full")
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(x, name=name,
+                                        num_hidden=int(p.get("num_output")))
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(x, name=name, act_type="relu")
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(x, name=name, act_type="sigmoid")
+        elif ltype == "TanH":
+            out = mx.sym.Activation(x, name=name, act_type="tanh")
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(x, name=name,
+                             nsize=int(p.get("local_size", 5)),
+                             alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)))
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(x, name=name,
+                                 p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            # caffe pairs BatchNorm (stats only) with a following Scale
+            # layer (gamma/beta); mxnet's BatchNorm carries all four, so
+            # merge the pair into one op (the reference converter does
+            # the same merge in convert_model)
+            fix_gamma = True
+            nxt = layers[idx + 1] if idx + 1 < len(layers) else None
+            if nxt is not None and nxt.get("type") == "Scale" and \
+                    caffe_parser.as_list(nxt.get("bottom"))[:1] == [tops[0]]:
+                fix_gamma = False
+                scale_name = str(nxt.get("name", "Scale"))
+                skip.add(scale_name)
+                scale_merge[scale_name] = name
+                tops = caffe_parser.as_list(nxt.get("top")) or tops
+            out = mx.sym.BatchNorm(
+                x, name=name, use_global_stats=bool(
+                    p.get("use_global_stats", True)),
+                eps=float(p.get("eps", 1e-5)), fix_gamma=fix_gamma)
+        elif ltype == "Scale":
+            raise NotImplementedError(
+                "standalone Scale layers (not following BatchNorm) are "
+                "not supported")
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM")).upper()
+            if op in ("SUM", "1"):
+                out = ins[0] + ins[1]
+            elif op in ("PROD", "0"):
+                out = ins[0] * ins[1]
+            else:
+                out = mx.sym.maximum(ins[0], ins[1])
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = mx.sym.concat(*ins, dim=int(p.get("axis", 1)), name=name)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(x, name=name)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            out = mx.sym.SoftmaxOutput(x, name="softmax")
+        elif ltype in ("Accuracy", "Silence"):
+            continue
+        else:
+            raise NotImplementedError(
+                "caffe layer type %r is not supported by the converter"
+                % ltype)
+        for t in tops:
+            blobs[t] = out
+        last = out
+    return last, input_name, scale_merge
+
+
+def main():
+    ap = argparse.ArgumentParser(description="prototxt -> symbol json")
+    ap.add_argument("prototxt")
+    ap.add_argument("output", help="output symbol .json path")
+    args = ap.parse_args()
+    sym, _, _ = convert_symbol(open(args.prototxt).read())
+    with open(args.output, "w") as f:
+        f.write(sym.tojson())
+    print("wrote %s" % args.output)
+
+
+if __name__ == "__main__":
+    main()
