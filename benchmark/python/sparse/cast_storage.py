@@ -1,0 +1,46 @@
+"""cast_storage benchmark: dense <-> csr/row_sparse conversion rates.
+
+Reference: ``benchmark/python/sparse/cast_storage.py``.
+
+Usage: python cast_storage.py [--rows 8192] [--cols 512]
+"""
+import argparse
+import time
+
+import numpy as np
+
+from mxnet_tpu import nd
+
+
+def _time(fn, repeat=10):
+    out = fn()
+    (out if not isinstance(out, list) else out[0]).wait_to_read()
+    t0 = time.time()
+    for _ in range(repeat):
+        out = fn()
+    (out if not isinstance(out, list) else out[0]).wait_to_read()
+    return (time.time() - t0) / repeat
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=8192)
+    ap.add_argument("--cols", type=int, default=512)
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    for density in (0.01, 0.1, 0.5):
+        mask = rng.rand(args.rows, args.cols) < density
+        dense = nd.array((rng.randn(args.rows, args.cols) * mask)
+                         .astype(np.float32))
+        for stype in ("csr", "row_sparse"):
+            t_to = _time(lambda: dense.tostype(stype))
+            sp = dense.tostype(stype)
+            t_back = _time(lambda: sp.tostype("default"))
+            mb = dense.size * 4 / 1e6
+            print("density=%.2f %-11s to: %7.3f ms (%6.1f MB/s)   "
+                  "back: %7.3f ms" % (density, stype, t_to * 1e3,
+                                      mb / t_to / 1e3, t_back * 1e3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Sparse optimizer-update benchmark.
+
+Reference: ``benchmark/python/sparse/updater.py`` — times sgd/adam
+updates with row_sparse gradients of varying density against the dense
+update (the lazy-row path only touches gathered rows,
+mxnet_tpu/ndarray/sparse.py).
+
+Usage: python updater.py [--rows 100000] [--cols 128]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+
+
+def _time(fn, repeat=10):
+    fn()
+    t0 = time.time()
+    for _ in range(repeat):
+        fn()
+    return (time.time() - t0) / repeat
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=100000)
+    ap.add_argument("--cols", type=int, default=128)
+    ap.add_argument("--opt", default="sgd", choices=["sgd", "adam"])
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+
+    for density in (0.001, 0.01, 0.1):
+        nnz = max(1, int(args.rows * density))
+
+        def one_sparse():
+            opt = mx.optimizer.create(args.opt, learning_rate=0.1)
+            w = nd.zeros((args.rows, args.cols), stype="row_sparse")
+            state = opt.create_state(0, w)
+            idx = np.sort(rng.choice(args.rows, nnz, replace=False))
+            g = sparse.row_sparse_array(
+                (nd.array(rng.randn(nnz, args.cols).astype(np.float32)),
+                 nd.array(idx)), shape=(args.rows, args.cols))
+            opt.update(0, w, g, state)
+            w.wait_to_read()
+
+        def one_dense():
+            opt = mx.optimizer.create(args.opt, learning_rate=0.1)
+            w = nd.zeros((args.rows, args.cols))
+            state = opt.create_state(0, w)
+            g = nd.array(rng.randn(args.rows, args.cols)
+                         .astype(np.float32))
+            opt.update(0, w, g, state)
+            w.wait_to_read()
+
+        t_sp = _time(one_sparse, repeat=5)
+        t_dn = _time(one_dense, repeat=5)
+        print("%s density=%.3f: row_sparse %7.2f ms   dense %7.2f ms"
+              % (args.opt, density, t_sp * 1e3, t_dn * 1e3))
+
+
+if __name__ == "__main__":
+    main()
