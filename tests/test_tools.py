@@ -93,3 +93,59 @@ def test_parse_log():
     assert tsum == pytest.approx(0.7) and tcnt == 1
     assert vsum == pytest.approx(0.6)
     assert time_sum == pytest.approx(11.1)
+
+
+DIST_TRAIN = r"""
+import os, sys
+import numpy as np
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+rng = np.random.RandomState(123)  # same data on both ranks
+X = rng.rand(64, 3).astype(np.float32)
+true_w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+y = X @ true_w
+
+kv._set_updater(lambda k, g, w: w.__isub__(0.5 * g / 64 / kv.num_workers))
+w = nd.zeros((3, 1))
+kv.init("w", w)
+# each rank trains on its half-batch; dist_sync sums the pushes
+lo, hi = (0, 32) if rank == 0 else (32, 64)
+for it in range(400):
+    kv.pull("w", out=w)
+    xb, yb = X[lo:hi], y[lo:hi]
+    pred = xb @ w.asnumpy()
+    grad = 2 * xb.T @ (pred - yb)
+    kv.push("w", nd.array(grad))
+kv.pull("w", out=w)
+err = float(np.abs(w.asnumpy() - true_w).max())
+assert err < 0.05, (rank, w.asnumpy())
+print("LAUNCHED_TRAIN_OK rank=%%d err=%%.4f" %% (rank, err))
+"""
+
+
+@pytest.mark.slow
+def test_launch_py_local_distributed_training(tmp_path):
+    """tools/launch.py --launcher local spawns N DMLC-env workers that
+    converge together over dist_sync (reference: launch.py + nightly
+    dist_lenet.py pattern)."""
+    script = tmp_path / "dist_train.py"
+    script.write_text(DIST_TRAIN % {"repo": REPO})
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["MXNET_KVSTORE_HEARTBEAT_DIR"] = str(tmp_path / "hb")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--root-port", "9427", "--launcher", "local",
+         sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=600, cwd=REPO)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count("LAUNCHED_TRAIN_OK") == 2, out[-3000:]
