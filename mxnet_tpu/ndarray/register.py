@@ -62,3 +62,33 @@ def populate(module_name):
             continue
         if not hasattr(mod, pyname):
             setattr(mod, pyname, _make_op_func(pyname, opdef))
+
+
+# single-tensor ops the reference also exposes as NDArray METHODS
+# (x.sin(), x.zeros_like(), ... — ndarray.py's 181-method surface)
+_METHOD_OPS = (
+    "sin cos tan sinh cosh arcsin arccos arctan arcsinh arccosh arctanh "
+    "degrees radians exp expm1 log log10 log2 log1p sqrt rsqrt cbrt rcbrt "
+    "square reciprocal abs sign ceil floor rint round fix trunc relu "
+    "sigmoid softmax log_softmax erf gamma gammaln sum nansum prod nanprod "
+    "mean max min norm argmax argmin argmax_channel topk sort argsort "
+    "clip flatten tile repeat pad swapaxes flip depth_to_space "
+    "space_to_depth slice_axis slice_like one_hot take pick "
+    "expand_dims squeeze split zeros_like ones_like sum_axis max_axis "
+    "min_axis broadcast_axes broadcast_axis").split()
+
+
+def attach_methods(nd_class):
+    """Attach op methods to NDArray (reference register.py's method
+    codegen).  Existing explicit methods are never overridden."""
+    for opname in _METHOD_OPS:
+        opdef = _OP_REGISTRY.get(opname)
+        if opdef is None or hasattr(nd_class, opname):
+            continue
+
+        def method(self, *args, _op=opdef, **kwargs):
+            return invoke(_op, [self] + [a for a in args], kwargs)
+
+        method.__name__ = opname
+        method.__doc__ = opdef.gen_doc()
+        setattr(nd_class, opname, method)

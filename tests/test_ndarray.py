@@ -219,3 +219,23 @@ def test_random_mixed_params():
     v = u.asnumpy()
     assert v.shape == (2,)
     assert 0 <= v[0] <= 20 and 10 <= v[1] <= 20
+
+
+def test_op_methods_attached():
+    """Reference ndarray.py exposes single-tensor ops as METHODS
+    (x.sin(), x.zeros_like(), ...) — register.attach_methods parity."""
+    x = nd.array(np.array([[0.3, -0.5], [1.2, 2.0]], np.float32))
+    assert np.allclose(x.sin().asnumpy(), np.sin(x.asnumpy()))
+    assert np.allclose(x.arctan().asnumpy(), np.arctan(x.asnumpy()))
+    assert np.allclose(x.zeros_like().asnumpy(), 0)
+    assert np.allclose(x.ones_like().asnumpy(), 1)
+    assert np.allclose(x.rint().asnumpy(), np.rint(x.asnumpy()))
+    assert np.allclose(x.log1p().abs().asnumpy(),
+                       np.abs(np.log1p(x.asnumpy())))
+    # autograd flows through method calls
+    from mxnet_tpu import autograd
+    x.attach_grad()
+    with autograd.record():
+        y = x.cos().sum()
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()), atol=1e-6)
