@@ -90,6 +90,30 @@ def _populate(module_name=__name__):
 
 _populate()
 
+
+def _attach_symbol_methods():
+    """Single-tensor ops as Symbol METHODS (reference symbol.py's
+    142-method surface: s.sin(), s.flatten(), ...).  Explicit methods
+    are never overridden."""
+    from ..ndarray.register import _METHOD_OPS
+    extra = ("exp log sqrt square abs sign sigmoid tanh relu "
+             "reshape_like broadcast_to slice slice_axis").split()
+    for opn in list(_METHOD_OPS) + extra:
+        opdef = _OP_REGISTRY.get(opn)
+        if opdef is None or hasattr(Symbol, opn):
+            continue
+        fn = _make_sym_func(opn, opdef)
+
+        def method(self, *args, _f=fn, **kwargs):
+            return _f(self, *args, **kwargs)
+
+        method.__name__ = opn
+        method.__doc__ = opdef.gen_doc()
+        setattr(Symbol, opn, method)
+
+
+_attach_symbol_methods()
+
 from . import contrib  # noqa: E402,F401  (needs populated registry)
 
 

@@ -143,3 +143,18 @@ def test_infer_shape_partial():
     arg_shapes, out_shapes, _ = fc.infer_shape_partial(x=(2, 5))
     d = dict(zip(fc.list_arguments(), arg_shapes))
     assert d["fc_weight"] == (3, 5)
+
+
+def test_symbol_op_methods_attached():
+    """Reference symbol.py exposes ops as METHODS (s.sin(), ...)."""
+    import numpy as np
+    a = mx.sym.Variable("a")
+    y = a.sin().square().sum()
+    exe = y.simple_bind(a=(3,))
+    xv = np.array([0.1, 0.5, 1.0], np.float32)
+    exe.forward(is_train=False, a=xv)
+    assert np.allclose(exe.outputs[0].asnumpy(),
+                       (np.sin(xv) ** 2).sum(), rtol=1e-5)
+    # chained layout methods compose and keep names listable
+    z = a.flatten().clip(0, 1).zeros_like()
+    assert z.list_arguments() == ["a"]
