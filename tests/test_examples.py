@@ -238,3 +238,25 @@ def test_bi_lstm_sort_example():
                       done_marker="sort accuracy")
     acc = float(out.split("sort accuracy:")[-1].split()[0])
     assert acc > 0.8, out[-500:]
+
+
+def test_checkpoint_resume_roundtrip(tmp_path):
+    """fit -> do_checkpoint -> resume with --load-epoch (reference:
+    model.py save/load_checkpoint + base_module.fit(begin_epoch))."""
+    prefix = str(tmp_path / "mnist")
+    out1 = run_example("image-classification/train_mnist.py",
+                       "--num-epochs", "1", "--batch-size", "64",
+                       "--model-prefix", prefix,
+                       done_marker="Train-accuracy")
+    acc1 = float(out1.split("Train-accuracy=")[-1].split()[0])
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+    out2 = run_example("image-classification/train_mnist.py",
+                       "--num-epochs", "2", "--batch-size", "64",
+                       "--model-prefix", prefix, "--load-epoch", "1",
+                       done_marker="Train-accuracy")
+    acc2 = float(out2.split("Train-accuracy=")[-1].split()[0])
+    # resumed training must not restart from scratch: epoch-2 accuracy
+    # continues from (not below) the checkpointed level
+    assert acc2 >= acc1 - 0.05, (acc1, acc2)
+    assert "Resumed" in out2 or "load" in out2.lower()
