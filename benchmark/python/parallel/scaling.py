@@ -1,0 +1,83 @@
+"""Data-parallel scaling benchmark over a device mesh.
+
+Reference: the dist-scaling tables in
+``example/image-classification/README.md:311-319`` (ResNet-152 at 90%
+linear to 256 GPUs via dist_device_sync).  Here scaling is compiled-in:
+the trainer jits one SPMD program per mesh, XLA places the gradient
+all-reduce on ICI.  This harness sweeps mesh widths and reports
+samples/s and scaling efficiency; on a virtual CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) the absolute
+numbers are meaningless but the harness is the same one a pod runs.
+
+Usage: python scaling.py [--widths 1,2,4,8] [--batch-per-device 32]
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel
+from mxnet_tpu.gluon import nn
+
+
+def build_net(classes=10):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(32, 3, padding=1, in_channels=3),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(64, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Flatten(),
+            nn.Dense(classes))
+    return net
+
+
+def bench_width(width, batch_per_device, steps, image_size):
+    import jax
+    devices = jax.devices()[:width]
+    mesh = parallel.make_mesh(dp=width, devices=devices)
+    net = build_net()
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    net(nd.ones((1, 3, image_size, image_size)))  # materialize deferred shapes
+    trainer = parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9}, mesh=mesh)
+    batch = batch_per_device * width
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, image_size, image_size)
+                 .astype(np.float32))
+    y = nd.array(rng.randint(0, 10, batch).astype(np.float32))
+    loss = trainer.step(x, y)           # compile + warm
+    float(loss.asnumpy())
+    t0 = time.time()
+    for _ in range(steps):
+        loss = trainer.step(x, y)
+    float(loss.asnumpy())
+    dt = (time.time() - t0) / steps
+    return batch / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--widths", default="1,2,4,8")
+    ap.add_argument("--batch-per-device", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=32)
+    args = ap.parse_args()
+    import jax
+    n = len(jax.devices())
+    base = None
+    print("%6s %12s %10s" % ("dp", "samples/s", "efficiency"))
+    for w in (int(x) for x in args.widths.split(",")):
+        if w > n:
+            print("%6d %12s %10s" % (w, "(no devices)", "-"))
+            continue
+        sps = bench_width(w, args.batch_per_device, args.steps,
+                          args.image_size)
+        if base is None:
+            base = sps
+        eff = sps / (base * w)
+        print("%6d %12.1f %9.0f%%" % (w, sps, 100 * eff))
+
+
+if __name__ == "__main__":
+    main()
