@@ -366,13 +366,32 @@ class Symbol:
                     arg_types.append(dtype_np(node.attrs["__dtype__"]))
                 else:
                     arg_types.append(np.dtype(np.float32))
-        out_types = []
-        for node, _ in self._flat_outputs():
-            if not node.is_variable and node.op.name == "Cast":
-                out_types.append(dtype_np(coerce_attrs(node.attrs).get(
-                    "dtype", "float32")))
-            else:
-                out_types.append(default)
+        # propagate dtypes through the DAG: variables from known/attrs,
+        # op outputs by numpy result-type promotion, with explicit
+        # `dtype` attrs (Cast, quantize, init ops) overriding
+        node_dtype = {}
+        for node in self._topo():
+            if node.is_variable:
+                if node.name in known:
+                    node_dtype[id(node)] = known[node.name]
+                elif "__dtype__" in node.attrs:
+                    node_dtype[id(node)] = dtype_np(node.attrs["__dtype__"])
+                else:
+                    node_dtype[id(node)] = default
+                continue
+            attrs = coerce_attrs(node.attrs)
+            if "dtype" in attrs and attrs["dtype"]:
+                node_dtype[id(node)] = dtype_np(attrs["dtype"])
+                continue
+            in_dts = [node_dtype.get(id(src), default)
+                      for (src, _i) in node.inputs]
+            try:
+                node_dtype[id(node)] = (np.result_type(*in_dts)
+                                        if in_dts else default)
+            except TypeError:
+                node_dtype[id(node)] = default
+        out_types = [node_dtype.get(id(node), default)
+                     for node, _ in self._flat_outputs()]
         aux_types = [np.dtype(np.float32) for _ in self.list_auxiliary_states()]
         return arg_types, out_types, aux_types
 
@@ -604,7 +623,19 @@ def _infer_graph(symbol, known_shapes, known_dtypes, partial=False):
         if op.needs_rng:
             kw["__rng__"] = key
 
-        out_struct = jax.eval_shape(lambda *xs: op.fn(*xs, **kw), *ins)
+        try:
+            out_struct = jax.eval_shape(lambda *xs: op.fn(*xs, **kw), *ins)
+        except MXNetError:
+            raise
+        except Exception as exc:
+            # surface shape conflicts as framework errors naming the
+            # node (the reference's InferShape error contract,
+            # infer_graph_attr_pass.cc) instead of a raw tracer error
+            raise MXNetError(
+                "shape inference failed at node %r (op %s) with input "
+                "shapes %s: %s"
+                % (node.name, op.name,
+                   [tuple(s.shape) for s in ins], exc)) from exc
         if not isinstance(out_struct, (tuple, list)):
             out_struct = (out_struct,)
         n_aux = len(op.mutate_aux)

@@ -1,0 +1,89 @@
+"""Shape/type inference edge cases.
+
+Reference: tests/python/unittest/test_infer_shape.py — attribute
+propagation through branches, conv chains, error quality, and dtype
+inference (here via jax.eval_shape under the Symbol DAG).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_conv_chain_shapes():
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                            name="c1")
+    p1 = mx.sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="p1")
+    c2 = mx.sym.Convolution(p1, num_filter=16, kernel=(3, 3), stride=(2, 2),
+                            name="c2")
+    args, outs, _ = c2.infer_shape(data=(4, 3, 32, 32))
+    d = dict(zip(c2.list_arguments(), args))
+    assert d["c1_weight"] == (8, 3, 3, 3)
+    assert d["c2_weight"] == (16, 8, 3, 3)
+    assert outs[0] == (4, 16, 7, 7)
+
+
+def test_branch_merge_shapes():
+    a = mx.sym.Variable("a")
+    left = mx.sym.FullyConnected(a, num_hidden=6, name="l")
+    right = mx.sym.FullyConnected(a, num_hidden=6, name="r")
+    merged = left + right
+    args, outs, _ = merged.infer_shape(a=(3, 4))
+    d = dict(zip(merged.list_arguments(), args))
+    assert d["l_weight"] == (6, 4) and d["r_weight"] == (6, 4)
+    assert outs[0] == (3, 6)
+
+
+def test_reshape_reverse_and_zero_special_values():
+    x = mx.sym.Variable("x")
+    r = mx.sym.Reshape(x, shape=(0, -1))
+    _, outs, _ = r.infer_shape(x=(2, 3, 4))
+    assert outs[0] == (2, 12)
+    r2 = mx.sym.Reshape(x, shape=(-2,))
+    _, outs2, _ = r2.infer_shape(x=(2, 3, 4))
+    assert outs2[0] == (2, 3, 4)
+
+
+def test_infer_shape_error_names_the_node():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    bad = mx.sym.FullyConnected(a, num_hidden=3, name="fcbad") + b
+    with pytest.raises(MXNetError):
+        bad.infer_shape(a=(2, 5), b=(7, 7))
+
+
+def test_missing_input_shape_is_reported():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s = a + b
+    exe_err = None
+    try:
+        s.simple_bind(a=(2, 2))
+    except MXNetError as e:
+        exe_err = str(e)
+    assert exe_err is not None and "b" in exe_err
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    y = mx.sym.cast(a, dtype="float16") + mx.sym.cast(a, dtype="float16")
+    if hasattr(y, "infer_type"):
+        arg_types, out_types, _ = y.infer_type(a="float32")
+        assert out_types[0] == np.float16
+    else:
+        exe = y.simple_bind(a=(2,))
+        exe.forward(is_train=False, a=np.zeros(2, np.float32))
+        assert exe.outputs[0].dtype == np.float16
+
+
+def test_rnn_unroll_shapes():
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=10, output_dim=6, name="emb")
+    cell = mx.rnn.LSTMCell(12, prefix="lstm_")
+    outputs, _ = cell.unroll(5, inputs=embed, merge_outputs=True,
+                             layout="NTC")
+    _, outs, _ = outputs.infer_shape(data=(3, 5))
+    assert outs[0] == (3, 5, 12)
