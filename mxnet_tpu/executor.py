@@ -425,7 +425,11 @@ class Executor:
         for n, a in zip(self.aux_names, new_aux):
             self.aux_dict[n]._data = a
         self._outputs = [_wrap(o) for o in outs]
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and \
+                not getattr(self, "_monitor_all", False):
+            # with monitor_all the heads are reported by the internals
+            # program (_run_monitor_taps) — reporting here too would
+            # duplicate them in the monitor's queue
             for name, o in zip(self.output_names, self._outputs):
                 self._monitor_callback(name, o)
 
@@ -554,17 +558,32 @@ class Executor:
         self._monitor_fn = None
 
     def _run_monitor_taps(self, args, aux, key, is_train):
-        """Compute + report every internal activation (monitor_all)."""
+        """Compute + report every internal activation (monitor_all).
+
+        The internals program is built in the SAME mode as the step it
+        mirrors (dropout active, BatchNorm on batch stats when
+        is_train) and replays the step's RNG key, so reported
+        activations match what the monitored step computed — the
+        reference taps the actually-executed op outputs
+        (graph_executor.cc:1444)."""
         internals = self._symbol.get_internals()
         if self._monitor_fn is None:
+            self._monitor_fn = {}
+        if is_train not in self._monitor_fn:
             fn = build_graph_fn(internals, self.arg_names, self.aux_names,
-                                False)
-            self._monitor_fn = (jax.jit(lambda a, x, k: fn(a, x, k)[0]),
-                                internals.list_outputs())
-        jit_fn, names = self._monitor_fn
+                                is_train)
+            self._monitor_fn[is_train] = (
+                jax.jit(lambda a, x, k: fn(a, x, k)[0]),
+                internals.list_outputs())
+        jit_fn, names = self._monitor_fn[is_train]
         outs = jit_fn(self._cast_fn(args), aux, key)
+        arg_names = set(self.arg_names) | set(self.aux_names)
         for name, o in zip(names, outs):
-            self._monitor_callback(name, _wrap(o))
+            # report op outputs only — variables (args/aux) are covered
+            # by Monitor.toc's own argument snapshot, as in the
+            # reference's engine tap (op completions, not variables)
+            if name not in arg_names:
+                self._monitor_callback(name, _wrap(o))
 
     def debug_str(self):
         return self._symbol.debug_str()

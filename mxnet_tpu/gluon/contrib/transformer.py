@@ -110,8 +110,13 @@ class TransformerLM(HybridBlock):
             self.head = Dense(vocab_size, flatten=False, prefix="head_")
 
     def hybrid_forward(self, F, tokens):
-        T = tokens.shape[-1] if hasattr(tokens, "shape") else None
-        pos = F.arange(0, self._max_len).slice_axis(axis=0, begin=0, end=T)
-        x = self.embed(tokens) + self.pos_embed(pos).expand_dims(0)
+        # derive the sequence length from the embedded tokens with
+        # slice_like, so pure-Symbol graphs (no shape at trace time)
+        # get the right positional window for any T <= max_len
+        x = self.embed(tokens)
+        pos = F.arange(0, self._max_len)
+        pos_e = self.pos_embed(pos).expand_dims(0)
+        pos_e = F.slice_like(pos_e, x, axes=(1,))
+        x = x + pos_e
         x = self.blocks(x)
         return self.head(self.ln_f(x))

@@ -150,22 +150,32 @@ def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
     os.makedirs(root, exist_ok=True)
     url = "%sgluon/models/%s.zip" % (_repo(), file_name)
     try:
-        zip_path = download(url, path=os.path.join(root, file_name + ".zip"),
-                            overwrite=True)
+        # verify-then-install: extract into a scratch dir and sha1-check
+        # there, so a corrupted or tampered archive never lands in the
+        # cache the loader trusts
+        import tempfile
         import zipfile
-        with zipfile.ZipFile(zip_path) as zf:
-            zf.extractall(root)
-        os.remove(zip_path)
+        with tempfile.TemporaryDirectory(dir=root) as tmp:
+            zip_path = download(url,
+                                path=os.path.join(tmp, file_name + ".zip"),
+                                overwrite=True)
+            with zipfile.ZipFile(zip_path) as zf:
+                zf.extractall(tmp)
+            staged = os.path.join(tmp, file_name)
+            if not check_sha1(staged, _model_sha1[name]):
+                raise MXNetError(
+                    "downloaded archive fails its sha1 pin")
+            path = os.path.join(root, file_name)
+            shutil.move(staged, path)
+    except MXNetError:
+        raise
     except Exception as exc:
         raise MXNetError(
             "Pretrained weights %s are not staged locally and could not "
             "be downloaded (%s). Place the file under %s or point "
             "MXNET_GLUON_REPO at a directory containing it."
             % (file_name, exc, root))
-    path = os.path.join(root, file_name)
-    if check_sha1(path, _model_sha1[name]):
-        return path
-    raise MXNetError("Downloaded file %s fails its sha1 check." % path)
+    return path
 
 
 def load_pretrained(net, name, ctx=None, root=None):

@@ -1,8 +1,13 @@
 """Monitor — per-op output statistics during training.
 
-Reference: ``python/mxnet/monitor.py`` — Monitor taps executor outputs
-via the monitor callback (graph_executor.cc:121,1444), collecting
-stat_func(output) per step, printed with ``toc_print``.
+Reference behavior being matched (not mirrored): ``python/mxnet/
+monitor.py`` taps executor outputs through the monitor callback
+(graph_executor.cc:121,1444), collects ``stat_func(output)`` for every
+node whose name matches ``pattern``, and prints the batch of stats at
+``toc_print``.  Here the tap is fed by the executor's compiled
+internals program (executor.py ``_run_monitor_taps``) rather than a
+per-op engine callback — XLA fuses the graph, so node outputs are
+recovered by jitting a second program that returns them.
 """
 from __future__ import annotations
 
@@ -14,77 +19,118 @@ from .ndarray import NDArray
 __all__ = ["Monitor"]
 
 
+def _mean_abs(arr):
+    """Default statistic: mean |x| over the tensor."""
+    return arr.abs().sum() / arr.size
+
+
+def _render_stat(value):
+    """Stringify one collected statistic (NDArray, list, or scalar)."""
+    items = value if isinstance(value, list) else [value]
+    return ",".join(
+        str(v.asnumpy()) if isinstance(v, NDArray) else str(v)
+        for v in items)
+
+
 class Monitor:
-    """Monitor outputs, weights, gradients (reference: monitor.py:30)."""
+    """Collect per-node output/weight statistics every ``interval`` steps.
+
+    Parameters
+    ----------
+    interval : int
+        Collect on steps where ``step % interval == 0``.
+    stat_func : callable, optional
+        ``NDArray -> NDArray`` statistic; defaults to mean absolute value.
+    pattern : str
+        Regex; only node/array names matching it are recorded.
+    sort : bool
+        Sort the per-step report by name before returning it.
+    """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                """returns |x|/size(x), async execution."""
-                return x.abs().sum() / x.size
-            stat_func = asum_stat
-        self.stat_func = stat_func
-        self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.interval = int(interval)
+        self.stat_func = stat_func or _mean_abs
         self.sort = sort
+        self._name_filter = re.compile(pattern)
+        self._records = []      # (step, name, raw stat) collected this window
+        self._collecting = False
+        self._step = 0
+        self._executors = []
 
+    # -- executor-facing surface ------------------------------------
     def stat_helper(self, name, arr):
-        """Executor callback (reference: monitor.py stat_helper)."""
-        if not self.activated or not self.re_prog.match(name):
-            return
-        self.queue.append((self.step, name, self.stat_func(arr)))
+        """Tap callback the executor invokes with each node output."""
+        if self._collecting and self._name_filter.match(name):
+            self._records.append((self._step, name, self.stat_func(arr)))
 
     def install(self, exe):
-        """Attach to an executor (reference: monitor.py install).
+        """Attach to an executor.
 
-        monitor_all=True matches the reference's semantics: the 1.2
-        engine called the tap for EVERY op output (graph_executor.cc:
-        1444), with ``pattern`` filtering in stat_helper."""
+        ``monitor_all=True`` reproduces the reference's per-op engine tap
+        (graph_executor.cc:1444): every internal node output reaches
+        ``stat_helper``, with ``pattern`` deciding what is kept.
+        """
         exe.set_monitor_callback(self.stat_helper, monitor_all=True)
-        self.exes.append(exe)
+        self._executors.append(exe)
 
+    # -- user-facing step protocol ----------------------------------
     def tic(self):
-        """Start collecting for this step (reference: monitor.py tic)."""
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Open a collection window if this step is due."""
+        if self._step % self.interval == 0:
+            self._sync_args()
+            self._records = []
+            self._collecting = True
+        self._step += 1
 
     def toc(self):
-        """Finish a step; returns list of (step, name, stat)
-        (reference: monitor.py toc)."""
-        if not self.activated:
+        """Close the window; return ``[(step, name, stat_string), ...]``."""
+        if not self._collecting:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe.arg_names, exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
+        self._sync_args()
+        self._snapshot_args()
+        self._collecting = False
+        report = [(step, name, _render_stat(stat))
+                  for step, name, stat in self._records]
+        self._records = []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join(str(v.asnumpy() if isinstance(v, NDArray) else v)
-                         for v in v_list)
-            res.append((n, k, s))
-        self.queue = []
-        return res
+            report.sort(key=lambda item: item[1])
+        return report
 
     def toc_print(self):
-        """Print stats (reference: monitor.py toc_print)."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """Log the window's stats (one line per node)."""
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)
+
+    # -- internals ---------------------------------------------------
+    def _sync_args(self):
+        """Block until installed executors' argument arrays are readable."""
+        for exe in self._executors:
+            for arr in exe.arg_arrays:
+                arr.wait_to_read()
+
+    def _snapshot_args(self):
+        """Record weight/input statistics alongside the node outputs."""
+        for exe in self._executors:
+            for name, arr in zip(exe.arg_names, exe.arg_arrays):
+                if self._name_filter.match(name):
+                    self._records.append(
+                        (self._step, name, self.stat_func(arr)))
+
+
+# old attribute spellings kept as properties for callers that poked at
+# the reference Monitor's internals
+def _alias(old, new):
+    def get(self):
+        return getattr(self, new)
+
+    def set_(self, value):
+        setattr(self, new, value)
+
+    setattr(Monitor, old, property(get, set_))
+
+
+_alias("activated", "_collecting")
+_alias("queue", "_records")
+_alias("step", "_step")
+_alias("exes", "_executors")
+_alias("re_prog", "_name_filter")

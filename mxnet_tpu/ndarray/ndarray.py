@@ -7,8 +7,13 @@ Reference: ``include/mxnet/ndarray.h:82`` + ``python/mxnet/ndarray/ndarray.py``
   reference's shared mutable Chunk becomes a *rebindable reference*:
   in-place APIs (``x += y``, ``x[:] = v``, optimizer updates) compute a
   new functional value and rebind ``self._data``.  Aliasing views
-  (reference zero-copy Reshape/Slice) are therefore value-snapshots —
-  the documented divergence from the reference's mutable-view semantics.
+  (reference zero-copy Reshape/Slice, ndarray.h:82) are emulated by a
+  write-through link: a basic-indexing ``__getitem__`` or ``reshape``
+  result (outside autograd recording) remembers its base and window;
+  writes through either side propagate to the other by functional
+  scatter + rebind, so reference scripts that assign through slices
+  compute the same values.  Advanced (array-) indexing returns a copy,
+  as in the reference.
 - Asynchrony comes from jax's dispatch: every op returns immediately;
   ``wait_to_read`` = ``block_until_ready`` (reference
   NDArray::WaitToRead, engine WaitForVar).  ``asnumpy`` blocks and
@@ -48,8 +53,8 @@ def _dev_ctx(jarr):
 class NDArray:
     """Multi-dimensional array on a device, with async semantics."""
 
-    __slots__ = ("_data", "_grad", "_grad_req", "_ag_leaf", "_ag_slot",
-                 "__weakref__")
+    __slots__ = ("_buf", "_grad", "_grad_req", "_ag_leaf", "_ag_slot",
+                 "_views", "_view_base", "_view_spec", "__weakref__")
     # make numpy defer to our reflected ops
     __array_priority__ = 1000.0
 
@@ -60,11 +65,117 @@ class NDArray:
             data = jnp.asarray(data)
         if ctx is not None:
             data = jax.device_put(data, Context(ctx).jax_device)
-        self._data = data
+        self._buf = data
         self._grad = None
         self._grad_req = "null"
         self._ag_leaf = False
         self._ag_slot = None
+        self._views = None
+        self._view_base = None
+        self._view_spec = None
+
+    # -- buffer + write-through view maintenance ---------------------------
+    @property
+    def _data(self):
+        if self._view_spec is not None and self._view_spec[2]:
+            self._refresh_window()
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        self._rebind(value)
+
+    def _rebind(self, value):
+        """Swap the buffer; keep aliasing views coherent in both directions
+        (reference shared-Chunk semantics, include/mxnet/ndarray.h:82).
+
+        Views are marked stale (flag only — no device work) and
+        recompute their window lazily on next read, so held-but-unused
+        views are free; the write-back into the base is immediate."""
+        self._buf = value
+        if self._view_spec is not None:
+            self._view_spec = (*self._view_spec[:2], False)  # now fresh
+        self._mark_views_stale()
+        if self._view_base is not None:
+            base = self._view_base
+            new_base = self._write_back(base._data)
+            if new_base is None:  # window no longer fits: detach
+                self._view_base = None
+                self._view_spec = None
+            else:
+                base._rebind(new_base)
+                # base._rebind marked us stale; this buffer IS the
+                # freshest value (it caused the write) — unmark
+                self._view_spec = (*self._view_spec[:2], False)
+
+    def _mark_views_stale(self):
+        if self._views is None:
+            return
+        live = []
+        for ref in self._views:
+            v = ref()
+            if v is not None and v._view_spec is not None:
+                v._view_spec = (*v._view_spec[:2], True)
+                v._mark_views_stale()
+                live.append(ref)
+        self._views = live or None
+
+    def _refresh_window(self):
+        """Recompute this view's value from its (possibly stale) base."""
+        base = self._view_base
+        kind, arg, _ = self._view_spec
+        base_buf = base._data  # refreshes the chain upward
+        try:
+            fresh = base_buf[arg] if kind == "index" else \
+                base_buf.reshape(self._buf.shape)
+        except (TypeError, ValueError):
+            fresh = None
+        if fresh is None or fresh.shape != self._buf.shape:
+            # base was rebound to an incompatible buffer (e.g. a
+            # checkpoint reload changed its shape): the alias link is
+            # meaningless now — detach, keep the last value
+            self._view_base = None
+            self._view_spec = None
+        else:
+            self._buf = fresh
+            self._view_spec = (kind, arg, False)
+
+    def _write_back(self, base_buf):
+        """The base's new buffer after this view's value is written in,
+        or None when the window no longer fits the base."""
+        kind, arg, _ = self._view_spec
+        try:
+            if kind == "index":
+                win = base_buf[arg]
+                if win.shape != self._buf.shape:
+                    return None
+                return base_buf.at[arg].set(self._buf.astype(base_buf.dtype))
+            if base_buf.size != self._buf.size:
+                return None
+            return self._buf.reshape(base_buf.shape)
+        except (TypeError, ValueError):
+            return None
+
+    def _attach_view(self, out, spec):
+        """Link ``out`` as a write-through alias of ``self``.
+
+        Only outside autograd recording (the tape's scatter-cotangent
+        entries own mutation semantics while recording) and never on
+        sparse arrays (compact payload, no shared dense chunk)."""
+        import weakref
+
+        if autograd.is_recording() or type(self) is not NDArray:
+            return out
+        out._view_base = self
+        out._view_spec = (*spec, False)  # (kind, arg, stale)
+        if self._views is None:
+            self._views = []
+        elif len(self._views) >= 32:
+            # read-mostly bases accumulate dead refs (views are usually
+            # short-lived); compact before growing further
+            self._views = [r for r in self._views if r() is not None]
+        self._views.append(weakref.ref(out))
+        return out
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -209,8 +320,11 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        return invoke("Reshape", [self], {"shape": shape,
-                                          "reverse": kwargs.get("reverse", False)})
+        out = invoke("Reshape", [self], {"shape": shape,
+                                         "reverse": kwargs.get("reverse", False)})
+        # reference Reshape shares the chunk (ndarray.h:82); same
+        # write-through aliasing as basic-index views
+        return self._attach_view(out, ("reshape", None))
 
     def reshape_like(self, other):
         return self.reshape(other.shape)
@@ -474,9 +588,21 @@ class NDArray:
             return jnp.asarray(key)
         return key
 
+    @staticmethod
+    def _is_basic_index(key):
+        if isinstance(key, tuple):
+            return all(NDArray._is_basic_index(k) for k in key)
+        return key is None or key is Ellipsis or \
+            isinstance(key, (int, np.integer, slice))
+
     def __getitem__(self, key):
         key = self._conv_index(key)
-        return invoke_fn(lambda x: x[key], [self])
+        out = invoke_fn(lambda x: x[key], [self])
+        if self._is_basic_index(key):
+            # basic indexing aliases the chunk in the reference
+            # (zero-copy Slice); emulate with a write-through link
+            self._attach_view(out, ("index", key))
+        return out
 
     def __setitem__(self, key, value):
         key = self._conv_index(key)

@@ -86,8 +86,27 @@ def attach_methods(nd_class):
         if opdef is None or hasattr(nd_class, opname):
             continue
 
-        def method(self, *args, _op=opdef, **kwargs):
-            return invoke(_op, [self] + [a for a in args], kwargs)
+        param_order = _array_param_order(opdef)
+
+        def method(self, *args, _op=opdef, _order=param_order, **kwargs):
+            # positionals are always inputs (raw numpy/scalars included,
+            # as the generated reference methods accept); kwargs split
+            # into NDArray inputs vs attrs the same way _make_op_func
+            # does, so x.take(indices=idx) binds idx as an input
+            from .ndarray import NDArray
+            attrs = {k: v for k, v in kwargs.items()
+                     if not isinstance(v, NDArray)}
+            nd_kwargs = {k: v for k, v in kwargs.items()
+                         if isinstance(v, NDArray)}
+            inputs = [self, *args]
+            if nd_kwargs and _order is not None:
+                names = sorted(nd_kwargs,
+                               key=lambda k: _order.index(k)
+                               if k in _order else len(_order))
+                inputs += [nd_kwargs[k] for k in names]
+            else:
+                inputs += list(nd_kwargs.values())
+            return invoke(_op, inputs, attrs)
 
         method.__name__ = opname
         method.__doc__ = opdef.gen_doc()

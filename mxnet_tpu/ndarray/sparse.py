@@ -1,14 +1,19 @@
-"""Sparse NDArray — row_sparse and csr storage types.
+"""Sparse NDArray — row_sparse and csr storage types, compact-first.
 
 Reference: ``python/mxnet/ndarray/sparse.py`` (CSRNDArray,
 RowSparseNDArray) over C++ storage types kRowSparseStorage/kCSRStorage
-(include/mxnet/ndarray.h:61-65).
+(include/mxnet/ndarray.h:61-65) — which store ONLY the nnz payload plus
+aux index arrays.
 
-TPU-native reality (SURVEY.md §7 hard parts): XLA has no native sparse
-tensors.  The semantic surface is preserved — indices/data accessors,
-cast_storage, retain, sparse creation — with computation lowering to
-dense XLA gather/scatter/segment ops.  This keeps every reference script
-running; the perf divergence is documented rather than hidden.
+TPU-native design: the array owns exactly (values, indices[, indptr]);
+memory is O(nnz), so a 10M x 300 row_sparse embedding table costs what
+its touched rows cost — same as the reference.  XLA has no native
+sparse tensors, so *compute* falls back at op boundaries: any op that
+needs the dense value triggers a lazy scatter-materialization through
+the ``_data`` property (cached until the array is rebound).  Sparse-
+aware paths — CSR dot (src/operator/tensor/dot-inl.h FComputeEx),
+retain, lazy row optimizer updates, kvstore row_sparse_pull — consume
+the compact payload and never materialize.
 """
 from __future__ import annotations
 
@@ -16,22 +21,103 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..base import MXNetError, dtype_np
-from .ndarray import NDArray, array as _dense_array
+from .ndarray import NDArray
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_stype", "_indices", "_indptr", "_values")
+    """Compact sparse storage with lazy dense materialization.
 
-    def dot(self, other, transpose_a=False, transpose_b=False):
-        return dot(self, other, transpose_a=transpose_a,
-                   transpose_b=transpose_b)
+    ``_values``/``_indices``/``_indptr`` are the source of truth.  The
+    inherited ``_data`` slot is shadowed by a property: reading it
+    scatters the payload into a dense jax.Array (cached in
+    ``_dense_cache``); writing it — the in-place rebind every dense
+    NDArray op uses — keeps the dense value and marks the compact
+    payload stale, to be recovered on next access.
+    """
+
+    __slots__ = ("_stype", "_indices", "_indptr", "_values", "_sshape",
+                 "_dense_cache", "_stale")
+
+    def _init_sparse(self, stype, values, indices, indptr, shape, ctx=None):
+        # NDArray.__init__ is bypassed (it would demand a dense buffer);
+        # initialize its autograd slots here.
+        if ctx is not None:
+            import jax
+            from ..context import Context
+            dev = Context(ctx).jax_device
+            values = jax.device_put(values, dev)
+            indices = jax.device_put(indices, dev)
+            if indptr is not None:
+                indptr = jax.device_put(indptr, dev)
+        self._grad = None
+        self._grad_req = "null"
+        self._ag_leaf = False
+        self._ag_slot = None
+        self._views = None
+        self._view_base = None
+        self._view_spec = None
+        self._stype = stype
+        self._values = values
+        self._indices = indices
+        self._indptr = indptr
+        self._sshape = tuple(int(s) for s in shape)
+        self._dense_cache = None
+        self._stale = False
+
+    # -- dense bridge -------------------------------------------------------
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._materialize()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        self._dense_cache = value
+        self._sshape = tuple(int(s) for s in value.shape)
+        self._stale = True  # compact payload recovered lazily
+
+    def _fresh(self):
+        """Re-derive the compact payload after a dense rebind."""
+        if self._stale:
+            self._compact_from_dense(np.asarray(self._dense_cache))
+            self._stale = False
+        return self
+
+    # -- metadata served from compact state (no materialization) -----------
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def dtype(self):
+        return np.dtype(self._values.dtype)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sshape:
+            n *= s
+        return n
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    def wait_to_read(self):
+        from .. import engine
+        engine.check_raise()
+        self._values.block_until_ready()
+
+    wait_to_write = wait_to_read
 
     @property
     def stype(self):
         return self._stype
 
-    def asnumpy(self):
-        return super().asnumpy()
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return dot(self, other, transpose_a=transpose_a,
+                   transpose_b=transpose_b)
 
     def tostype(self, stype):
         if stype == self._stype:
@@ -42,78 +128,136 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """row_sparse: subset of rows are non-zero (reference sparse.py:778)."""
+    """row_sparse: subset of rows are non-zero (reference sparse.py:778).
+
+    Stores ``values (nnz, *row_shape)`` + ``indices (nnz,)`` only.
+    """
 
     __slots__ = ()
 
     def __init__(self, data, indices=None, shape=None, ctx=None):
-        if indices is None:  # dense data given
-            dense = jnp.asarray(data)
-            idx = jnp.nonzero(jnp.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+        if indices is None:  # dense input: recover the touched-row set
+            import jax
+
+            dense_np = np.asarray(data)
+            idx_np = np.flatnonzero(
+                dense_np.reshape(dense_np.shape[0], -1).any(axis=1))
+            values = jnp.asarray(dense_np[idx_np])
+            self._init_sparse("row_sparse", values,
+                              jnp.asarray(idx_np, dtype=jnp.int64), None,
+                              dense_np.shape, ctx=ctx)
+            if ctx is None:
+                # the dense value is already in hand — keep it as cache;
+                # reuse the device buffer when one was passed in (no
+                # host round-trip re-upload)
+                self._dense_cache = data if isinstance(data, jax.Array) \
+                    else jnp.asarray(dense_np)
         else:
             values = jnp.asarray(data)
             idx = jnp.asarray(indices, dtype=jnp.int64)
-            dense = jnp.zeros(shape, values.dtype).at[idx].set(values)
-        super().__init__(dense, ctx=ctx)
-        self._stype = "row_sparse"
-        self._indices = idx
-        self._indptr = None
-        self._values = jnp.take(dense, idx.astype(jnp.int32), axis=0)
+            if shape is None:
+                shape = (int(idx.max()) + 1 if idx.size else 0,) \
+                    + values.shape[1:]
+            self._init_sparse("row_sparse", values, idx, None, shape,
+                              ctx=ctx)
+
+    def _materialize(self):
+        zeros = jnp.zeros(self._sshape, self._values.dtype)
+        if self._indices.size == 0:
+            return zeros
+        return zeros.at[self._indices.astype(jnp.int32)].set(self._values)
+
+    def _compact_from_dense(self, dense_np):
+        idx_np = np.flatnonzero(
+            dense_np.reshape(dense_np.shape[0], -1).any(axis=1))
+        self._indices = jnp.asarray(idx_np, dtype=jnp.int64)
+        self._values = jnp.asarray(dense_np[idx_np])
 
     @property
     def indices(self):
+        self._fresh()
         return NDArray(self._indices.astype(jnp.int64))
 
     @property
     def data(self):
-        return NDArray(jnp.take(self._data, self._indices.astype(jnp.int32), axis=0))
+        self._fresh()
+        return NDArray(self._values)
 
     def retain(self, indices):
         return retain(self, indices)
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """csr: compressed sparse row matrix (reference sparse.py:532)."""
+    """csr: compressed sparse row matrix (reference sparse.py:532).
+
+    Stores ``data (nnz,)`` + ``indices (nnz,)`` + ``indptr (rows+1,)``.
+    """
 
     __slots__ = ()
 
     def __init__(self, data, indptr=None, indices=None, shape=None, ctx=None):
-        if indptr is None:
-            dense = jnp.asarray(data)
-            np_d = np.asarray(dense)
-            nz = np_d != 0
-            indptr_np = np.concatenate([[0], np.cumsum(nz.sum(axis=1))])
-            indices_np = np.concatenate([np.nonzero(nz[i])[0] for i in range(np_d.shape[0])]) \
-                if np_d.shape[0] else np.array([], np.int64)
-            self._indptr = jnp.asarray(indptr_np, dtype=jnp.int64)
-            self._indices = jnp.asarray(indices_np, dtype=jnp.int64)
-            self._values = jnp.asarray(np_d[nz])
+        if indptr is None:  # dense input
+            dense_np = np.asarray(data)
+            if dense_np.ndim != 2:
+                raise MXNetError("csr requires 2D")
+            self._init_sparse("csr", jnp.zeros((0,)), jnp.zeros((0,)),
+                              jnp.zeros((0,)), dense_np.shape)
+            self._compact_from_dense(dense_np)
+            if ctx is not None:
+                import jax
+                from ..context import Context
+                dev = Context(ctx).jax_device
+                self._values = jax.device_put(self._values, dev)
+                self._indices = jax.device_put(self._indices, dev)
+                self._indptr = jax.device_put(self._indptr, dev)
+            else:
+                import jax
+                self._dense_cache = data if isinstance(data, jax.Array) \
+                    else jnp.asarray(dense_np)
         else:
-            d = np.asarray(data)
-            ip = np.asarray(indptr, dtype=np.int64)
-            ix = np.asarray(indices, dtype=np.int64)
-            dense_np = np.zeros(shape, d.dtype)
-            for r in range(shape[0]):
-                cols = ix[ip[r]:ip[r + 1]]
-                dense_np[r, cols] = d[ip[r]:ip[r + 1]]
-            dense = jnp.asarray(dense_np)
-            self._indptr = jnp.asarray(ip)
-            self._indices = jnp.asarray(ix)
-            self._values = jnp.asarray(d)
-        super().__init__(dense, ctx=ctx)
-        self._stype = "csr"
+            vals = jnp.asarray(data)
+            ip = jnp.asarray(np.asarray(indptr, dtype=np.int64))
+            ix = jnp.asarray(np.asarray(indices, dtype=np.int64))
+            if shape is None:
+                n_cols = int(ix.max()) + 1 if ix.size else 0
+                shape = (int(ip.shape[0]) - 1, n_cols)
+            self._init_sparse("csr", vals, ix, ip, shape, ctx=ctx)
+
+    def _materialize(self):
+        rows = _csr_row_ids(np.asarray(self._indptr), self._sshape[0])
+        zeros = jnp.zeros(self._sshape, self._values.dtype)
+        if self._values.size == 0:
+            return zeros
+        return zeros.at[rows, self._indices.astype(jnp.int32)].set(
+            self._values)
+
+    def _compact_from_dense(self, dense_np):
+        nz = dense_np != 0
+        self._indptr = jnp.asarray(
+            np.concatenate([[0], np.cumsum(nz.sum(axis=1))]).astype(np.int64))
+        cols = np.nonzero(nz)[1] if dense_np.size else np.array([], np.int64)
+        self._indices = jnp.asarray(cols.astype(np.int64))
+        self._values = jnp.asarray(dense_np[nz])
 
     @property
     def indices(self):
+        self._fresh()
         return NDArray(self._indices)
 
     @property
     def indptr(self):
+        self._fresh()
         return NDArray(self._indptr)
 
     @property
     def data(self):
+        self._fresh()
         return NDArray(self._values)
+
+
+def _csr_row_ids(indptr_np, n_rows):
+    counts = np.diff(indptr_np)
+    return jnp.asarray(np.repeat(np.arange(n_rows), counts).astype(np.int32))
 
 
 def cast_storage(arr, stype):
@@ -130,20 +274,30 @@ def cast_storage(arr, stype):
 
 
 def retain(arr, indices):
-    """Reference: sparse_retain op — keep only given rows."""
-    from .ndarray import NDArray as ND
-    from ..ops.misc import retain_rows
-    idx = indices._data if isinstance(indices, ND) else jnp.asarray(indices)
-    return RowSparseNDArray(retain_rows(arr._data, idx))
+    """Reference: sparse_retain op — keep only the given rows.
+
+    Compact in, compact out: filters the stored (values, indices) pairs;
+    the dense backing is never touched.
+    """
+    arr._fresh()
+    idx = indices.asnumpy() if isinstance(indices, NDArray) \
+        else np.asarray(indices)
+    stored = np.asarray(arr._indices)
+    keep = np.isin(stored, idx.astype(stored.dtype))
+    return RowSparseNDArray(arr._values[jnp.asarray(keep)],
+                            indices=stored[keep], shape=arr.shape)
 
 
 def zeros(stype, shape, ctx=None, dtype=None, **kwargs):
-    dense = jnp.zeros(shape, dtype_np(dtype))
+    dt = dtype_np(dtype)
     if stype == "row_sparse":
-        return RowSparseNDArray(dense)
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                indices=np.array([], np.int64), shape=shape)
     if stype == "csr":
-        return CSRNDArray(dense)
-    return NDArray(dense)
+        return CSRNDArray(jnp.zeros((0,), dt),
+                          indptr=np.zeros(shape[0] + 1, np.int64),
+                          indices=np.array([], np.int64), shape=shape)
+    return NDArray(jnp.zeros(shape, dt))
 
 
 def empty(stype, shape, ctx=None, dtype=None):
@@ -151,10 +305,20 @@ def empty(stype, shape, ctx=None, dtype=None):
 
 
 def array(source_array, ctx=None, dtype=None):
-    if isinstance(source_array, (CSRNDArray, RowSparseNDArray)):
-        return source_array.__class__(source_array._data)
+    if isinstance(source_array, RowSparseNDArray):
+        source_array._fresh()
+        return RowSparseNDArray(source_array._values,
+                                indices=source_array._indices,
+                                shape=source_array.shape)
+    if isinstance(source_array, CSRNDArray):
+        source_array._fresh()
+        return CSRNDArray(source_array._values,
+                          indptr=source_array._indptr,
+                          indices=source_array._indices,
+                          shape=source_array.shape)
     a = np.asarray(source_array if not isinstance(source_array, NDArray)
-                   else source_array.asnumpy(), dtype=dtype_np(dtype) if dtype else None)
+                   else source_array.asnumpy(),
+                   dtype=dtype_np(dtype) if dtype else None)
     return RowSparseNDArray(jnp.asarray(a))
 
 
@@ -165,7 +329,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     """Reference: sparse.py csr_matrix."""
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
-        return CSRNDArray(data, indptr=indptr, indices=indices, shape=shape, ctx=ctx)
+        return CSRNDArray(data, indptr=indptr, indices=indices, shape=shape,
+                          ctx=ctx)
     a = np.asarray(arg1 if not isinstance(arg1, NDArray) else arg1.asnumpy())
     return CSRNDArray(jnp.asarray(a), ctx=ctx)
 
@@ -191,12 +356,11 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
 
     if isinstance(lhs, CSRNDArray) and not transpose_b and \
             not isinstance(rhs, BaseSparseNDArray):
+        lhs._fresh()
         n_rows, n_cols = lhs.shape
         vals = lhs._values
         cols = lhs._indices.astype(jnp.int32)
-        counts = np.diff(np.asarray(lhs._indptr))
-        rows = jnp.asarray(
-            np.repeat(np.arange(n_rows), counts).astype(np.int32))
+        rows = _csr_row_ids(np.asarray(lhs._indptr), n_rows)
         r = rhs._data
         squeeze = r.ndim == 1
         if squeeze:

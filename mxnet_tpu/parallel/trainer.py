@@ -74,20 +74,33 @@ def _apply_with_params(block, params, *inputs):
 def _param_pspec(name, shape, mesh):
     """Choose a PartitionSpec for one parameter.
 
-    fsdp: shard dim 0 when divisible (ZeRO-3); tp: shard the largest
-    remaining dim of matmul-bearing >=2D weights.  GSPMD inserts the
-    all-gathers/reduce-scatters these shardings imply."""
+    tp: shard dim 0 of 2-D matmul weights (the output-features dim of an
+    mxnet ``(out, in)`` weight — Megatron column-parallel); fsdp: shard
+    the largest remaining divisible dim (ZeRO-3), which for conv weights
+    is the output-channel dim.  GSPMD inserts the all-gathers/
+    reduce-scatters these shardings imply.
+
+    The assignment is constrained by an XLA CPU-backend SPMD numerics
+    bug (jax 0.9.0) found by this trainer's oracle tests: under a
+    dp x tp x fsdp mesh, (a) ``P("fsdp", "tp")`` on two chained dense
+    weights gives ~3e-2 forward error (standalone 20-line jnp repro, no
+    framework code), and (b) tp on a conv weight's output-channel dim
+    combined with doubly-sharded dense weights gives ~2e-3 backward
+    error.  tp-on-dim0 restricted to 2-D weights + fsdp elsewhere is
+    numerically exact in both directions there and on TPU, and is the
+    idiomatic TPU layout anyway; ``_build`` additionally pins logits to
+    the batch sharding as a fixed GSPMD resharding boundary."""
     fsdp = mesh.shape.get("fsdp", 1)
     tp = mesh.shape.get("tp", 1)
     spec = [None] * len(shape)
-    if fsdp > 1 and len(shape) >= 1 and shape[0] % fsdp == 0:
-        spec[0] = "fsdp"
-    if tp > 1 and len(shape) >= 2:
-        # pick the largest dim not already sharded and divisible by tp
+    if tp > 1 and len(shape) == 2 and shape[0] % tp == 0:
+        spec[0] = "tp"
+    if fsdp > 1:
+        # largest unsharded divisible dim (one mesh axis per dim)
         order = sorted(range(len(shape)), key=lambda i: -shape[i])
         for i in order:
-            if spec[i] is None and shape[i] % tp == 0:
-                spec[i] = "tp"
+            if spec[i] is None and shape[i] % fsdp == 0:
+                spec[i] = "fsdp"
                 break
     return P(*spec)
 
@@ -171,6 +184,13 @@ class ParallelTrainer:
             if isinstance(out, tuple):
                 out = out[0]
             out = out.astype(jnp.float32)  # loss always in fp32
+            # pin logits to the batch layout: gives GSPMD a fixed
+            # resharding boundary between model body and loss (see
+            # _param_pspec docstring for the CPU-backend miscompile this
+            # also guards against)
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, P(*([("dp", "fsdp")]
+                                             + [None] * (out.ndim - 1)))))
             with autograd.pause(train_mode=True):
                 l = loss_blk(NDArray(out), NDArray(y))
             return jnp.mean(l._data)

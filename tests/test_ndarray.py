@@ -239,3 +239,58 @@ def test_op_methods_attached():
         y = x.cos().sum()
     y.backward()
     assert np.allclose(x.grad.asnumpy(), -np.sin(x.asnumpy()), atol=1e-6)
+
+
+def test_write_through_slice_view():
+    """Reference idiom (zero-copy Slice, include/mxnet/ndarray.h:82):
+    writes through a slice land in the parent."""
+    a = nd.ones((4, 4))
+    b = a[1:3]
+    b[:] = 5.0
+    assert np.array_equal(a.asnumpy()[1:3], np.full((2, 4), 5.0, np.float32))
+    assert np.array_equal(a.asnumpy()[0], np.ones(4, np.float32))
+    # in-place arithmetic through the view propagates too
+    b += 1.0
+    assert np.array_equal(a.asnumpy()[1:3], np.full((2, 4), 6.0, np.float32))
+    # element write through a row view
+    r = a[0]
+    r[2] = -1.0
+    assert a.asnumpy()[0, 2] == -1.0
+
+
+def test_write_through_reshape_view():
+    a = nd.zeros((2, 6))
+    v = a.reshape((3, 4))
+    v[1] = 7.0
+    got = a.asnumpy().reshape(3, 4)
+    assert np.array_equal(got[1], np.full(4, 7.0, np.float32))
+    assert got[0].sum() == 0 and got[2].sum() == 0
+
+
+def test_parent_write_refreshes_view():
+    """Mutating the parent is visible through existing views (shared
+    chunk semantics in both directions)."""
+    a = nd.ones((4, 3))
+    v = a[2:]
+    a[:] = 9.0
+    assert np.array_equal(v.asnumpy(), np.full((2, 3), 9.0, np.float32))
+    flat = a.reshape((12,))
+    a[0] = 0.5
+    assert flat.asnumpy()[0] == 0.5
+    assert flat.asnumpy()[1] == 0.5
+
+
+def test_view_chain_propagates_to_root():
+    a = nd.zeros((2, 4))
+    v1 = a[1]          # (4,)
+    v2 = v1.reshape((2, 2))
+    v2[1, 1] = 3.0
+    assert a.asnumpy()[1, 3] == 3.0
+
+
+def test_advanced_index_is_copy():
+    """Array-index gathers copy in the reference too — no aliasing."""
+    a = nd.ones((4, 3))
+    g = a[nd.array(np.array([0, 2], np.float32))]
+    g[:] = 5.0
+    assert np.array_equal(a.asnumpy(), np.ones((4, 3), np.float32))

@@ -43,19 +43,51 @@ def test_csr_dot_matches_dense():
     assert np.allclose(csr.dot(nd.array(b)).asnumpy(), a @ b, atol=1e-5)
 
 
-def test_csr_dot_never_reads_dense_backing():
-    """The kernel must consume only (values, indices, indptr)."""
+def test_csr_dot_never_materializes_dense():
+    """The kernel must consume only (values, indices, indptr): after a
+    compact construction and a dot, no dense backing may exist."""
     csr = sparse.csr_matrix((np.array([1.0, 2.0, 3.0], np.float32),
                              np.array([0, 2, 1]), np.array([0, 2, 3])),
                             shape=(2, 3))
-    b = np.arange(12, np.float32).reshape(3, 4) if False else \
-        np.arange(12).astype(np.float32).reshape(3, 4)
-    ref = csr.asnumpy() @ b
-    # corrupt the dense backing; sparse dot must not notice
-    import jax.numpy as jnp
-    csr._data = jnp.full((2, 3), 777.0)
+    b = np.arange(12).astype(np.float32).reshape(3, 4)
     out = nd.dot(csr, nd.array(b))
-    assert np.allclose(out.asnumpy(), ref)
+    assert csr._dense_cache is None, "CSR dot touched the dense backing"
+    dense = np.array([[1, 0, 2], [0, 3, 0]], np.float32)
+    assert np.allclose(out.asnumpy(), dense @ b)
+    # writing through _data (dense rebind) refreshes the compact payload
+    import jax.numpy as jnp
+    csr._data = jnp.asarray(np.array([[0, 7, 0], [0, 0, 0]], np.float32))
+    assert csr.data.asnumpy().tolist() == [7.0]
+    assert csr.indices.asnumpy().tolist() == [1]
+
+
+def test_rowsparse_allocates_o_nnz():
+    """A 1M x 128 row_sparse with 1% nnz rows must cost O(nnz) memory
+    (reference: kRowSparseStorage stores only values+indices,
+    include/mxnet/ndarray.h:61-65)."""
+    import jax
+    rows, cols, nnz = 1_000_000, 128, 10_000
+    idx = np.arange(0, rows, rows // nnz)[:nnz]
+    vals = np.ones((nnz, cols), np.float32)
+    before = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                 for a in jax.live_arrays())
+    rs = sparse.row_sparse_array((vals, idx), shape=(rows, cols))
+    # metadata + compact accessors must not materialize
+    assert rs.shape == (rows, cols)
+    assert rs.data.shape == (nnz, cols)
+    assert rs.indices.shape == (nnz,)
+    rs.wait_to_read()
+    after = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                for a in jax.live_arrays())
+    assert rs._dense_cache is None
+    payload = nnz * cols * 4
+    assert after - before < 3 * payload, \
+        "row_sparse allocated %.1f MB for a %.1f MB payload" % (
+            (after - before) / 1e6, payload / 1e6)
+    # retain stays compact too
+    kept = rs.retain(nd.array(idx[:5].astype(np.float32)))
+    assert kept._dense_cache is None
+    assert kept.data.shape == (5, cols)
 
 
 def test_sgd_lazy_update_touched_rows_only():
