@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""CNN for sentence classification (Kim 2014).
+
+Reference: /root/reference/example/cnn_text_classification/text_cnn.py —
+embedding -> parallel convolutions over n-gram windows -> max-over-time
+pooling -> concat -> dropout -> FC -> softmax, trained through the
+Module API.
+
+TPU-first notes: the n-gram convolutions are expressed as Conv2D over
+the (T, E) "image" so all filter widths batch onto the MXU in one
+program; max-over-time is a global max pool, fusing into the conv
+epilogue under XLA.
+
+Dataset: synthetic sentiment — sentences are token-id sequences where
+class 1 plants at least one bigram from a "positive" phrase bank and
+class 0 from a "negative" bank (MR-polarity in miniature, no download).
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+VOCAB = 200
+SEQ_LEN = 24
+POS_BIGRAMS = [(11, 12), (31, 32), (51, 52), (71, 72)]
+NEG_BIGRAMS = [(21, 22), (41, 42), (61, 62), (81, 82)]
+
+
+def make_dataset(rng, n):
+    X = rng.randint(100, VOCAB, size=(n, SEQ_LEN)).astype(np.float32)
+    y = rng.randint(0, 2, size=n).astype(np.float32)
+    for i in range(n):
+        bank = POS_BIGRAMS if y[i] == 1 else NEG_BIGRAMS
+        for _ in range(rng.randint(1, 3)):
+            a, b = bank[rng.randint(len(bank))]
+            p = rng.randint(0, SEQ_LEN - 1)
+            X[i, p], X[i, p + 1] = a, b
+    return X, y
+
+
+def text_cnn_symbol(num_embed, filter_sizes, num_filter, dropout):
+    """The reference's symbol, rebuilt natively."""
+    data = mx.sym.Variable("data")                     # (B, T)
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=num_embed,
+                             name="embed")             # (B, T, E)
+    x = mx.sym.Reshape(embed, shape=(-1, 1, SEQ_LEN, num_embed))
+    pooled = []
+    for fs in filter_sizes:
+        c = mx.sym.Convolution(x, kernel=(fs, num_embed),
+                               num_filter=num_filter,
+                               name="conv%d" % fs)     # (B, F, T-fs+1, 1)
+        a = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(a, global_pool=True, pool_type="max",
+                           kernel=(1, 1))              # max over time
+        pooled.append(mx.sym.Flatten(p))
+    h = mx.sym.Concat(*pooled, dim=1)
+    if dropout > 0:
+        h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=2, name="cls")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="CNN text classification")
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--filter-sizes", type=str, default="2,3,4")
+    ap.add_argument("--num-filter", type=int, default=16)
+    ap.add_argument("--dropout", type=float, default=0.25)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-epochs", type=int, default=12)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--optimizer", type=str, default="rmsprop")
+    args = ap.parse_args(argv)
+
+    rng = np.random.RandomState(0)
+    Xtr, ytr = make_dataset(rng, 512)
+    Xte, yte = make_dataset(rng, 128)
+    train_iter = mx.io.NDArrayIter(Xtr, ytr, batch_size=args.batch_size,
+                                   shuffle=True, label_name="softmax_label")
+    val_iter = mx.io.NDArrayIter(Xte, yte, batch_size=args.batch_size,
+                                 label_name="softmax_label")
+
+    sym = text_cnn_symbol(args.num_embed,
+                          [int(f) for f in args.filter_sizes.split(",")],
+                          args.num_filter, args.dropout)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    mod.fit(train_iter, eval_data=val_iter,
+            optimizer=args.optimizer,
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc", num_epoch=args.num_epochs)
+    score = mod.score(val_iter, "acc")
+    acc = dict(score)["accuracy"]
+    print("final validation accuracy: %.3f" % acc)
+    print("text-cnn done")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,12 @@ def _gemm(ins, attrs):
                                   no_bias=len(ins) == 2, flatten=True)
 
 
+def _unsqueeze(x, axes):
+    for ax in sorted(int(a) for a in axes):
+        x = sym_mod.expand_dims(x, axis=ax)
+    return x
+
+
 def _pool(kind):
     def conv(ins, attrs):
         kernel = _pair(attrs.get("kernel_shape", (2, 2)))
@@ -100,9 +106,134 @@ def _reshape(ins, attrs):
     return sym_mod.Reshape(ins[0], shape=tuple(int(s) for s in shape))
 
 
+def _slice(ins, attrs):
+    axes = attrs.get("axes")
+    starts = list(attrs["starts"])
+    ends = list(attrs["ends"])
+    if axes is None:
+        axes = list(range(len(starts)))
+    out = ins[0]
+    for ax, b, e in zip(axes, starts, ends):
+        out = sym_mod.slice_axis(out, axis=int(ax), begin=int(b),
+                                 end=None if e >= (1 << 31) - 1 else int(e))
+    return out
+
+
+def _pad(ins, attrs):
+    pads = list(attrs.get("pads", attrs.get("paddings", ())))
+    mode = attrs.get("mode", "constant")
+    n = len(pads) // 2
+    width = ()
+    for i in range(n):
+        width += (int(pads[i]), int(pads[i + n]))
+    return sym_mod.Pad(ins[0], mode={"constant": "constant",
+                                     "reflect": "reflect",
+                                     "edge": "edge"}[mode],
+                       pad_width=width,
+                       constant_value=float(attrs.get("value", 0.0)))
+
+
+def _upsample(ins, attrs):
+    scales = attrs.get("scales", (1.0, 1.0, 2.0, 2.0))
+    return sym_mod.UpSampling(ins[0], scale=int(scales[-1]),
+                              sample_type="nearest")
+
+
+def _lrn(ins, attrs):
+    return sym_mod.LRN(ins[0], nsize=int(attrs.get("size", 5)),
+                       alpha=float(attrs.get("alpha", 1e-4)),
+                       beta=float(attrs.get("beta", 0.75)),
+                       knorm=float(attrs.get("bias", 1.0)))
+
+
+def _reduce(op, default_keep=1):
+    def conv(ins, attrs):
+        axes = attrs.get("axes")
+        keep = bool(attrs.get("keepdims", default_keep))
+        kw = {"keepdims": keep}
+        if axes is not None:
+            kw["axis"] = tuple(int(a) for a in axes)
+        return getattr(sym_mod, op)(ins[0], **kw)
+    return conv
+
+
+def _cast(ins, attrs):
+    to = int(attrs.get("to", 1))
+    dt = {1: "float32", 6: "int32", 7: "int64", 10: "float16",
+          11: "float64"}.get(to, "float32")
+    return sym_mod.Cast(ins[0], dtype=dt)
+
+
+def _split(ins, attrs):
+    axis = int(attrs.get("axis", 0))
+    split = attrs.get("split")
+    if split is not None and len(set(split)) != 1:
+        raise MXNetError("Split import supports equal parts only")
+    # ONNX: no split attr means equal parts, one per declared output
+    num = len(split) if split is not None else attrs["__num_outputs__"]
+    return sym_mod.SliceChannel(ins[0], num_outputs=num, axis=axis)
+
+
 _CONVERT_MAP = {
     "Conv": _conv,
     "Gemm": _gemm,
+    # elementwise family
+    "Exp": lambda ins, attrs: sym_mod.exp(ins[0]),
+    "Log": lambda ins, attrs: sym_mod.log(ins[0]),
+    "Sqrt": lambda ins, attrs: sym_mod.sqrt(ins[0]),
+    "Abs": lambda ins, attrs: sym_mod.abs(ins[0]),
+    "Neg": lambda ins, attrs: sym_mod.negative(ins[0]),
+    "Floor": lambda ins, attrs: sym_mod.floor(ins[0]),
+    "Ceil": lambda ins, attrs: sym_mod.ceil(ins[0]),
+    "Reciprocal": lambda ins, attrs: 1.0 / ins[0],
+    "Pow": lambda ins, attrs: sym_mod.broadcast_power(*ins),
+    "Max": lambda ins, attrs: sym_mod.maximum(*ins) if len(ins) == 2
+        else sym_mod.broadcast_maximum(*ins),
+    "Min": lambda ins, attrs: sym_mod.minimum(*ins) if len(ins) == 2
+        else sym_mod.broadcast_minimum(*ins),
+    "Clip": lambda ins, attrs: sym_mod.clip(
+        ins[0], a_min=float(attrs.get("min", -3.4e38)),
+        a_max=float(attrs.get("max", 3.4e38))),
+    "Erf": lambda ins, attrs: sym_mod.erf(ins[0]),
+    "Greater": lambda ins, attrs: sym_mod.broadcast_greater(*ins),
+    "Less": lambda ins, attrs: sym_mod.broadcast_lesser(*ins),
+    "Equal": lambda ins, attrs: sym_mod.broadcast_equal(*ins),
+    # activations
+    "LeakyRelu": lambda ins, attrs: sym_mod.LeakyReLU(
+        ins[0], act_type="leaky", slope=float(attrs.get("alpha", 0.01))),
+    "Elu": lambda ins, attrs: sym_mod.LeakyReLU(
+        ins[0], act_type="elu", slope=float(attrs.get("alpha", 1.0))),
+    "PRelu": lambda ins, attrs: sym_mod.LeakyReLU(
+        ins[0], gamma=ins[1], act_type="prelu"),
+    "Softplus": lambda ins, attrs: sym_mod.Activation(
+        ins[0], act_type="softrelu"),
+    "HardSigmoid": lambda ins, attrs: sym_mod.hard_sigmoid(
+        ins[0], alpha=float(attrs.get("alpha", 0.2)),
+        beta=float(attrs.get("beta", 0.5))),
+    # shape / layout
+    "Squeeze": lambda ins, attrs: sym_mod.squeeze(
+        ins[0], axis=tuple(int(a) for a in attrs.get("axes", ()))
+        or None),
+    "Unsqueeze": lambda ins, attrs: _unsqueeze(ins[0], attrs["axes"]),
+    "Slice": _slice,
+    "Pad": _pad,
+    "Split": _split,
+    "Cast": _cast,
+    "Upsample": _upsample,
+    "LRN": _lrn,
+    # reductions / indexing
+    "ReduceMean": _reduce("mean"),
+    "ReduceSum": _reduce("sum"),
+    "ReduceMax": _reduce("max"),
+    "ReduceMin": _reduce("min"),
+    "ReduceProd": _reduce("prod"),
+    "ArgMax": lambda ins, attrs: sym_mod.argmax(
+        ins[0], axis=int(attrs.get("axis", 0)),
+        keepdims=bool(attrs.get("keepdims", 1))),
+    "Gather": lambda ins, attrs: sym_mod.take(
+        ins[0], ins[1], axis=int(attrs.get("axis", 0))),
+    "LogSoftmax": lambda ins, attrs: sym_mod.log_softmax(
+        ins[0], axis=int(attrs.get("axis", 1))),
     "MatMul": lambda ins, attrs: sym_mod.dot(*ins),
     "Relu": lambda ins, attrs: sym_mod.Activation(ins[0], act_type="relu"),
     "Sigmoid": lambda ins, attrs: sym_mod.Activation(ins[0],
@@ -137,6 +268,7 @@ def import_graph_ir(graph):
     tensors = {}
     arg_params = {}
     aux_params = {}
+    consumed = set()   # initializers folded into attrs (shape tensors)
     init_names = set(graph.initializers)
     for name in graph.inputs:
         if name not in init_names:
@@ -149,6 +281,20 @@ def import_graph_ir(graph):
 
     from ... import nd
     for node in graph.nodes:
+        if node.op_type == "Constant":
+            # exporters spell weights as Constant nodes too
+            graph.initializers[node.outputs[0]] = np.asarray(
+                node.attrs["value"])
+            init_names.add(node.outputs[0])
+            continue
+        if node.op_type == "Reshape" and len(node.inputs) == 2 and \
+                node.inputs[1] in graph.initializers:
+            # opset>=5 carries the target shape as an initializer input
+            consumed.add(node.inputs[1])
+            node = NodeIR(node.op_type, node.inputs[:1], node.outputs,
+                          {**node.attrs,
+                           "shape": [int(s) for s in
+                                     graph.initializers[node.inputs[1]]]})
         if node.op_type not in _CONVERT_MAP:
             raise MXNetError("ONNX op %r is not supported by the importer"
                              % node.op_type)
@@ -160,10 +306,18 @@ def import_graph_ir(graph):
         if node.op_type == "Gemm" and len(node.inputs) >= 2:
             attrs["__num_hidden__"] = int(
                 graph.initializers[node.inputs[1]].shape[0])
+        if node.op_type == "Split":
+            attrs["__num_outputs__"] = len(node.outputs)
         ins = [tensors[i] if i in tensors else param_sym(i)
                for i in node.inputs if i]
         out = _CONVERT_MAP[node.op_type](ins, attrs)
-        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        if isinstance(out, (list, tuple)):
+            outs = list(out)
+        elif len(node.outputs) > 1:
+            # one Symbol with several outputs (e.g. Split/SliceChannel)
+            outs = [out[i] for i in range(len(node.outputs))]
+        else:
+            outs = [out]
         for name, o in zip(node.outputs, outs):
             tensors[name] = o
         if node.op_type == "BatchNormalization":
@@ -172,7 +326,7 @@ def import_graph_ir(graph):
                 aux_params[aux_name] = nd.array(
                     graph.initializers[aux_name])
     for name, arr in graph.initializers.items():
-        if name not in aux_params:
+        if name not in aux_params and name not in consumed:
             arg_params[name] = nd.array(np.asarray(arr))
     outputs = [tensors[o] for o in graph.outputs]
     out_sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
@@ -181,12 +335,17 @@ def import_graph_ir(graph):
 
 def _onnx_to_ir(model):
     """onnx ModelProto -> GraphIR (requires the onnx package)."""
-    from onnx import numpy_helper, helper
+    from onnx import numpy_helper, helper, TensorProto
     g = model.graph
     inits = {t.name: numpy_helper.to_array(t) for t in g.initializer}
     nodes = []
     for n in g.node:
-        attrs = {a.name: helper.get_attribute_value(a) for a in n.attribute}
+        attrs = {}
+        for a in n.attribute:
+            v = helper.get_attribute_value(a)
+            if isinstance(v, TensorProto):
+                v = numpy_helper.to_array(v)   # Constant payloads etc.
+            attrs[a.name] = v
         nodes.append(NodeIR(n.op_type, list(n.input), list(n.output),
                             attrs))
     return GraphIR([i.name for i in g.input], [o.name for o in g.output],
@@ -196,13 +355,20 @@ def _onnx_to_ir(model):
 def import_model(model_file):
     """Load an .onnx file (reference: contrib/onnx import_model).
 
-    Returns (sym, arg_params, aux_params)."""
+    Uses the onnx package when present; otherwise falls back to the
+    hermetic wire decoder (onnx_proto.read_model) — real .onnx files
+    import without any extra dependency.  Returns
+    (sym, arg_params, aux_params)."""
     try:
         import onnx
     except ImportError:
-        raise MXNetError(
-            "import_model requires the `onnx` package, which this build "
-            "does not ship; the translation itself (import_graph_ir) has "
-            "no such dependency")
+        from . import onnx_proto
+        with open(model_file, "rb") as f:
+            raw = onnx_proto.read_model(f)
+        nodes = [NodeIR(op, ins, outs, attrs)
+                 for op, ins, outs, attrs in raw["nodes"]]
+        graph = GraphIR(raw["inputs"], raw["outputs"], nodes,
+                        dict(raw["initializers"]))
+        return import_graph_ir(graph)
     model = onnx.load(model_file)
     return import_graph_ir(_onnx_to_ir(model))

@@ -1,0 +1,298 @@
+"""Hermetic ONNX protobuf wire codec — no ``onnx`` package required.
+
+Reference counterpart: ``python/mxnet/contrib/onnx/_import/import_onnx.py``
+leans on the onnx package for deserialization; this build does not ship
+it, so the ModelProto wire format is decoded directly (same approach as
+tools/caffe_converter's caffemodel decoder).  Field numbers follow the
+public ONNX schema (onnx/onnx.proto):
+
+- ModelProto:   graph=7, ir_version=1, opset_import=8, producer_name=2
+- GraphProto:   node=1, name=2, initializer=5, input=11, output=12
+- NodeProto:    input=1, output=2, name=3, op_type=4, attribute=5
+- AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+                  strings=9, type=20
+- TensorProto:  dims=1, data_type=2, float_data=4, int32_data=5,
+                int64_data=7, name=8, raw_data=9
+- ValueInfoProto: name=1
+
+A writer for the same subset lets tests (and users without the onnx
+package) produce real .onnx files; ``read_model`` round-trips them.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+__all__ = ["read_model", "write_model"]
+
+# TensorProto.DataType values used here
+_DT_FLOAT, _DT_INT32, _DT_INT64, _DT_DOUBLE = 1, 6, 7, 11
+_DT_TO_NP = {_DT_FLOAT: np.float32, _DT_INT32: np.int32,
+             _DT_INT64: np.int64, _DT_DOUBLE: np.float64}
+_NP_TO_DT = {np.dtype(np.float32): _DT_FLOAT, np.dtype(np.int32): _DT_INT32,
+             np.dtype(np.int64): _DT_INT64, np.dtype(np.float64): _DT_DOUBLE}
+
+
+# -- wire primitives --------------------------------------------------------
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _signed(v):
+    """Interpret a varint as int64 two's complement."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _fields(buf):
+    pos, n = 0, len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        yield field, wire, val
+
+
+def _packed_varints(val, wire):
+    if wire == 0:
+        return [val]
+    out, pos = [], 0
+    while pos < len(val):
+        v, pos = _read_varint(val, pos)
+        out.append(v)
+    return out
+
+
+def _packed_floats(val, wire):
+    if wire == 5:
+        return list(struct.unpack("<f", val))
+    return list(np.frombuffer(val, "<f4"))
+
+
+# -- readers ---------------------------------------------------------------
+def _read_tensor(buf):
+    dims, dtype, name = [], _DT_FLOAT, ""
+    raw = None
+    floats, i32, i64 = [], [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            dims.extend(_signed(x) for x in _packed_varints(v, w))
+        elif f == 2:
+            dtype = v
+        elif f == 4:
+            floats.extend(_packed_floats(v, w))
+        elif f == 5:
+            i32.extend(_signed(x) for x in _packed_varints(v, w))
+        elif f == 7:
+            i64.extend(_signed(x) for x in _packed_varints(v, w))
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = bytes(v)
+    np_dt = _DT_TO_NP.get(dtype, np.float32)
+    if raw is not None:
+        arr = np.frombuffer(raw, np_dt)
+    elif floats:
+        arr = np.asarray(floats, np_dt)
+    elif i64:
+        arr = np.asarray(i64, np_dt)
+    elif i32:
+        arr = np.asarray(i32, np_dt)
+    else:
+        arr = np.zeros(0, np_dt)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _read_attribute(buf):
+    name, value = "", None
+    floats, ints, strings = [], [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            value = struct.unpack("<f", v)[0]
+        elif f == 3:
+            value = _signed(v)
+        elif f == 4:
+            value = v.decode("utf-8", "surrogateescape")
+        elif f == 5:
+            value = _read_tensor(v)[1]
+        elif f == 7:
+            floats.extend(_packed_floats(v, w))
+        elif f == 8:
+            ints.extend(_signed(x) for x in _packed_varints(v, w))
+        elif f == 9:
+            strings.append(v.decode("utf-8", "surrogateescape"))
+    if floats:
+        value = floats
+    elif ints:
+        value = ints
+    elif strings:
+        value = strings
+    return name, value
+
+
+def _read_node(buf):
+    inputs, outputs, attrs, op_type = [], [], {}, ""
+    for f, w, v in _fields(buf):
+        if f == 1:
+            inputs.append(v.decode())
+        elif f == 2:
+            outputs.append(v.decode())
+        elif f == 4:
+            op_type = v.decode()
+        elif f == 5:
+            k, val = _read_attribute(v)
+            attrs[k] = val
+    return op_type, inputs, outputs, attrs
+
+
+def _read_value_info(buf):
+    for f, w, v in _fields(buf):
+        if f == 1:
+            return v.decode()
+    return ""
+
+
+def _read_graph(buf):
+    nodes, inits, inputs, outputs = [], {}, [], []
+    for f, w, v in _fields(buf):
+        if f == 1:
+            nodes.append(_read_node(v))
+        elif f == 5:
+            name, arr = _read_tensor(v)
+            inits[name] = arr
+        elif f == 11:
+            inputs.append(_read_value_info(v))
+        elif f == 12:
+            outputs.append(_read_value_info(v))
+    return dict(nodes=nodes, initializers=inits, inputs=inputs,
+                outputs=outputs)
+
+
+def read_model(data):
+    """ONNX ModelProto bytes -> dict with nodes/initializers/inputs/outputs.
+
+    ``nodes`` entries are (op_type, inputs, outputs, attrs)."""
+    if hasattr(data, "read"):
+        data = data.read()
+    for f, w, v in _fields(data):
+        if f == 7:
+            return _read_graph(v)
+    raise ValueError("no GraphProto in model bytes — not an ONNX file?")
+
+
+# -- writers ---------------------------------------------------------------
+def _varint(n):
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field(num, wire, payload):
+    if wire == 0:
+        return _varint((num << 3) | 0) + _varint(payload)
+    if wire == 2:
+        return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+    if wire == 5:
+        return _varint((num << 3) | 5) + payload
+    raise ValueError(wire)
+
+
+def _write_tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = _NP_TO_DT.get(arr.dtype)
+    if dt is None:
+        arr = arr.astype(np.float32)
+        dt = _DT_FLOAT
+    out = b"".join(_field(1, 0, int(d)) for d in arr.shape)
+    out += _field(2, 0, dt)
+    out += _field(8, 2, name.encode())
+    out += _field(9, 2, arr.tobytes())
+    return out
+
+
+def _write_attribute(name, value):
+    out = _field(1, 2, name.encode())
+    if isinstance(value, float):
+        out += _field(2, 5, struct.pack("<f", value)) + _field(20, 0, 1)
+    elif isinstance(value, bool):
+        out += _field(3, 0, int(value)) + _field(20, 0, 2)
+    elif isinstance(value, int):
+        out += _field(3, 0, value) + _field(20, 0, 2)
+    elif isinstance(value, str):
+        out += _field(4, 2, value.encode()) + _field(20, 0, 3)
+    elif isinstance(value, np.ndarray):
+        out += _field(5, 2, _write_tensor("", value)) + _field(20, 0, 4)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += _field(7, 5, struct.pack("<f", v))
+            out += _field(20, 0, 6)
+        elif value and isinstance(value[0], str):
+            for v in value:
+                out += _field(9, 2, v.encode())
+            out += _field(20, 0, 8)
+        else:
+            for v in value:
+                out += _field(8, 0, int(v))
+            out += _field(20, 0, 7)
+    else:
+        raise ValueError("unsupported attribute %r=%r" % (name, value))
+    return out
+
+
+def _write_node(op_type, inputs, outputs, attrs):
+    out = b"".join(_field(1, 2, i.encode()) for i in inputs)
+    out += b"".join(_field(2, 2, o.encode()) for o in outputs)
+    out += _field(4, 2, op_type.encode())
+    for k, v in (attrs or {}).items():
+        out += _field(5, 2, _write_attribute(k, v))
+    return out
+
+
+def _write_value_info(name):
+    return _field(1, 2, name.encode())
+
+
+def write_model(nodes, initializers, inputs, outputs, opset=12):
+    """Serialize a model; inverse of ``read_model`` for the same subset.
+
+    nodes: iterable of (op_type, inputs, outputs, attrs)."""
+    g = b"".join(_field(1, 2, _write_node(*n)) for n in nodes)
+    g += _field(2, 2, b"mxnet_tpu")
+    g += b"".join(_field(5, 2, _write_tensor(k, v))
+                  for k, v in initializers.items())
+    g += b"".join(_field(11, 2, _write_value_info(n)) for n in inputs)
+    g += b"".join(_field(12, 2, _write_value_info(n)) for n in outputs)
+    opset_b = _field(2, 0, opset)
+    return (_field(1, 0, 7)            # ir_version
+            + _field(8, 2, opset_b)    # opset_import
+            + _field(7, 2, g))

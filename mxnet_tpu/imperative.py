@@ -44,6 +44,7 @@ _INT_KINDS = ("i", "u", "b")
 
 
 def _call_args(op, attrs):
+    op.validate_attrs(attrs)
     kw = dict(op.attr_defaults)
     kw.update(attrs)
     if op.needs_is_train:

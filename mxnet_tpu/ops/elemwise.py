@@ -20,7 +20,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import register, Param as P
 
 
 # -- unary math zoo ---------------------------------------------------------
@@ -90,13 +90,19 @@ def _block_grad(x, **attrs):
     return lax.stop_gradient(x)
 
 
-@register("Cast", aliases=("cast",))
+@register("Cast", aliases=("cast",), params=[
+    P("dtype", ("float32", "float64", "float16", "bfloat16", "uint8",
+                "int8", "int32", "int64", "bool"), required=True)])
 def _cast(x, dtype="float32", **attrs):
     from ..base import dtype_np
     return x.astype(dtype_np(dtype))
 
 
-@register("clip")
+@register("clip", params=[
+    # not required: the numpy-style method surface passes the bounds
+    # positionally (x.clip(0, 1)), outside the attr path
+    P("a_min", float, default=None),
+    P("a_max", float, default=None)])
 def _clip(x, a_min=None, a_max=None, **attrs):
     return jnp.clip(x, a_min, a_max)
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import register, Param as P
 
 
 def _softmax_fwd(data, multi_output):
@@ -72,7 +72,15 @@ def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, norm_batch,
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
 
 
-@register("SoftmaxOutput", aliases=("Softmax",))
+@register("SoftmaxOutput", aliases=("Softmax",), params=[
+    P("grad_scale", float, default=1.0),
+    P("ignore_label", float, default=-1.0),
+    P("multi_output", bool, default=False),
+    P("use_ignore", bool, default=False),
+    P("preserve_shape", bool, default=False),
+    P("normalization", ("null", "batch", "valid"), default="null"),
+    P("out_grad", bool, default=False),
+    P("smooth_alpha", float, default=0.0, low=0.0, high=1.0)])
 def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                     multi_output=False, use_ignore=False, preserve_shape=False,
                     normalization="null", out_grad=False, smooth_alpha=0.0, **attrs):

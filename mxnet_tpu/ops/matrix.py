@@ -14,7 +14,7 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 from ..base import MXNetError
 
 
@@ -77,7 +77,9 @@ def _infer_reshape(src_shape, target):
     return tuple(out)
 
 
-@register("Reshape", aliases=("reshape",))
+@register("Reshape", aliases=("reshape",), params=[
+    P("shape", tuple, default=None),
+    P("reverse", bool, default=False)])
 def _reshape(x, shape=None, reverse=False, **attrs):
     shape = normalize_tuple(shape)
     if reverse:
@@ -99,7 +101,7 @@ def _transpose(x, axes=None, **attrs):
     return jnp.transpose(x, normalize_tuple(axes))
 
 
-@register("expand_dims")
+@register("expand_dims", params=[P("axis", int, required=True)])
 def _expand_dims(x, axis=0, **attrs):
     return jnp.expand_dims(x, axis)
 
@@ -149,7 +151,9 @@ def _slice(x, begin=None, end=None, step=None, **attrs):
     return x[tuple(idx)]
 
 
-@register("slice_axis")
+@register("slice_axis", params=[
+    P("axis", int, required=True),
+    P("begin", int, required=True)])
 def _slice_axis(x, axis=0, begin=0, end=None, **attrs):
     idx = [slice(None)] * x.ndim
     idx[axis] = slice(begin, end)
@@ -165,7 +169,9 @@ def _slice_like(x, like, axes=(), **attrs):
     return x[tuple(idx)]
 
 
-@register("Concat", aliases=("concat",))
+@register("Concat", aliases=("concat",), params=[
+    P("dim", int, default=1),
+    P("num_args", int, default=0, low=0)])
 def _concat(*args, dim=1, num_args=None, **attrs):
     return jnp.concatenate(args, axis=dim)
 
@@ -179,7 +185,11 @@ def _split_nout(attrs):
     return int(attrs.get("num_outputs", attrs.get("num_output", 1)))
 
 
-@register("SliceChannel", aliases=("split",), num_outputs=_split_nout)
+@register("SliceChannel", aliases=("split",), num_outputs=_split_nout,
+          params=[
+    P("num_outputs", int, required=True, low=1),
+    P("axis", int, default=1),
+    P("squeeze_axis", bool, default=False)])
 def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **attrs):
     """Reference: src/operator/slice_channel-inl.h."""
     parts = jnp.split(x, num_outputs, axis=axis)
@@ -188,12 +198,14 @@ def _split(x, num_outputs=1, axis=1, squeeze_axis=False, **attrs):
     return tuple(parts) if num_outputs > 1 else parts[0]
 
 
-@register("tile")
+@register("tile", params=[P("reps", tuple, required=True, low=1)])
 def _tile(x, reps=(), **attrs):
     return jnp.tile(x, normalize_tuple(reps))
 
 
-@register("repeat")
+@register("repeat", params=[
+    P("repeats", int, required=True, low=1),
+    P("axis", int, default=None)])
 def _repeat(x, repeats=1, axis=None, **attrs):
     return jnp.repeat(x, repeats, axis=axis)
 
@@ -203,7 +215,10 @@ def _reverse(x, axis=(), **attrs):
     return jnp.flip(x, axis=normalize_tuple(axis))
 
 
-@register("Pad", aliases=("pad",))
+@register("Pad", aliases=("pad",), params=[
+    P("mode", ("constant", "edge", "reflect"), required=True),
+    P("pad_width", tuple, required=True, low=0),
+    P("constant_value", float, default=0.0)])
 def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **attrs):
     """Reference: src/operator/pad-inl.h (pad_width in flattened pairs)."""
     pw = normalize_tuple(pad_width)
@@ -215,7 +230,10 @@ def _pad(x, mode="constant", pad_width=(), constant_value=0.0, **attrs):
 
 
 # -- indexing ---------------------------------------------------------------
-@register("Embedding")
+@register("Embedding", params=[
+    P("input_dim", int, required=True, low=1),
+    P("output_dim", int, required=True, low=1),
+    P("sparse_grad", bool, default=False)])
 def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
                sparse_grad=False, **attrs):
     """Reference: src/operator/tensor/indexing_op.h EmbeddingOp.
@@ -223,7 +241,9 @@ def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
-@register("take")
+@register("take", params=[
+    P("axis", int, default=0),
+    P("mode", ("clip", "wrap", "raise"), default="clip")])
 def _take(a, indices, axis=0, mode="clip", **attrs):
     jmode = "clip" if mode in ("clip", "raise") else "wrap"
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=jmode)
@@ -244,7 +264,10 @@ def _pick(data, index, axis=-1, keepdims=False, mode="clip", **attrs):
     return out
 
 
-@register("one_hot")
+@register("one_hot", params=[
+    P("depth", int, required=True, low=1),
+    P("on_value", float, default=1.0),
+    P("off_value", float, default=0.0)])
 def _one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32", **attrs):
     from ..base import dtype_np
     i = indices.astype(jnp.int32)

@@ -27,12 +27,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 from ..base import MXNetError
 
 
 # -- FullyConnected ---------------------------------------------------------
-@register("FullyConnected")
+@register("FullyConnected", params=[
+    P("num_hidden", int, required=True, low=1,
+      doc="number of output units"),
+    P("no_bias", bool, default=False),
+    P("flatten", bool, default=True,
+      doc="collapse all trailing input dims before the matmul")])
 def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
                      flatten=True, **attrs):
     """Reference: src/operator/nn/fully_connected-inl.h.
@@ -46,7 +51,9 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
 
 
 # -- Activation -------------------------------------------------------------
-@register("Activation")
+@register("Activation", params=[
+    P("act_type", ("relu", "sigmoid", "tanh", "softrelu", "softsign"),
+      required=True)])
 def _activation(data, act_type="relu", **attrs):
     """Reference: src/operator/nn/activation-inl.h."""
     if act_type == "relu":
@@ -62,7 +69,12 @@ def _activation(data, act_type="relu", **attrs):
     raise MXNetError("unknown act_type %s" % act_type)
 
 
-@register("LeakyReLU", needs_is_train=True, needs_rng=True)
+@register("LeakyReLU", needs_is_train=True, needs_rng=True, params=[
+    P("act_type", ("leaky", "elu", "selu", "prelu", "rrelu", "gelu"),
+      default="leaky"),
+    P("slope", float, default=0.25, low=0.0),
+    P("lower_bound", float, default=0.125, low=0.0),
+    P("upper_bound", float, default=0.334, low=0.0)])
 def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
                 lower_bound=0.125, upper_bound=0.334,
                 __is_train__=False, __rng__=None, **attrs):
@@ -88,7 +100,9 @@ def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
 
 
 # -- softmax family ---------------------------------------------------------
-@register("softmax")
+@register("softmax", params=[
+    P("axis", int, default=-1),
+    P("temperature", float, default=1.0)])
 def _softmax(data, axis=-1, temperature=None, **attrs):
     """Reference: src/operator/nn/softmax-inl.h."""
     if temperature:
@@ -96,7 +110,7 @@ def _softmax(data, axis=-1, temperature=None, **attrs):
     return jax.nn.softmax(data, axis=axis)
 
 
-@register("log_softmax")
+@register("log_softmax", params=[P("axis", int, default=-1)])
 def _log_softmax(data, axis=-1, temperature=None, **attrs):
     if temperature:
         data = data / temperature
@@ -128,7 +142,15 @@ def _conv_dn(ndim, layout):
     raise MXNetError("unsupported layout %s" % layout)
 
 
-@register("Convolution", aliases=("Convolution_v1",))
+@register("Convolution", aliases=("Convolution_v1",), params=[
+    P("kernel", tuple, required=True, low=1, doc="conv window (h, w)"),
+    P("num_filter", int, required=True, low=1, high=100000),
+    P("stride", tuple, default=None, low=1),
+    P("dilate", tuple, default=None, low=1),
+    P("pad", tuple, default=None, low=0),
+    P("num_group", int, default=1, low=1),
+    P("no_bias", bool, default=False),
+    P("layout", ("NCHW", "NHWC", "NCW", "NCDHW", None), default=None)])
 def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                  pad=None, num_filter=None, num_group=1, no_bias=False,
                  layout=None, cudnn_tune=None, cudnn_off=False, workspace=None,
@@ -160,7 +182,15 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     return out
 
 
-@register("Deconvolution")
+@register("Deconvolution", params=[
+    P("kernel", tuple, required=True, low=1),
+    P("num_filter", int, required=True, low=1),
+    P("stride", tuple, default=None, low=1),
+    P("dilate", tuple, default=None, low=1),
+    P("pad", tuple, default=None, low=0),
+    P("adj", tuple, default=None, low=0),
+    P("num_group", int, default=1, low=1),
+    P("no_bias", bool, default=True)])
 def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
                    dilate=None, pad=None, adj=None, target_shape=None,
                    num_filter=None, num_group=1, no_bias=True, layout=None,
@@ -198,7 +228,14 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None,
 
 
 # -- Pooling ----------------------------------------------------------------
-@register("Pooling", aliases=("Pooling_v1",))
+@register("Pooling", aliases=("Pooling_v1",), params=[
+    P("kernel", tuple, default=None, low=1),
+    P("pool_type", ("max", "avg", "sum", "lp"), default="max"),
+    P("stride", tuple, default=None, low=1),
+    P("pad", tuple, default=None, low=0),
+    P("global_pool", bool, default=False),
+    P("pooling_convention", ("valid", "full", "same"), default="valid"),
+    P("count_include_pad", bool, default=True)])
 def _pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
              global_pool=False, pooling_convention="valid", cudnn_off=False,
              count_include_pad=True, **attrs):
@@ -263,7 +300,10 @@ def _bilinear_resize(data, height=None, width=None, scale_height=None,
     return jax.image.resize(data, (n, c, th, tw), method="linear")
 
 
-@register("UpSampling")
+@register("UpSampling", params=[
+    P("scale", int, required=True, low=1),
+    P("sample_type", ("nearest", "bilinear"), default="nearest"),
+    P("num_filter", int, default=0, low=0)])
 def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
                 num_args=1, multi_input_mode="concat", workspace=None, **attrs):
     """Reference: src/operator/upsampling-inl.h."""
@@ -285,7 +325,13 @@ def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
 
 
 # -- normalization ----------------------------------------------------------
-@register("BatchNorm", aliases=("BatchNorm_v1",), needs_is_train=True,
+@register("BatchNorm", aliases=("BatchNorm_v1",), needs_is_train=True, params=[
+    P("eps", float, default=1e-3, low=0.0),
+    P("momentum", float, default=0.9, low=0.0, high=1.0),
+    P("fix_gamma", bool, default=True),
+    P("use_global_stats", bool, default=False),
+    P("axis", int, default=1),
+    P("output_mean_var", bool, default=False)],
           num_outputs=3, mutate_aux=("moving_mean", "moving_var"))
 def _batch_norm(data, gamma, beta, moving_mean, moving_var,
                 eps=1e-3, momentum=0.9, fix_gamma=True, use_global_stats=False,
@@ -317,7 +363,10 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var,
     return out.astype(data.dtype), new_mean, new_var
 
 
-@register("LayerNorm")
+@register("LayerNorm", params=[
+    P("axis", int, default=-1),
+    P("eps", float, default=1e-5, low=0.0),
+    P("output_mean_var", bool, default=False)])
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **attrs):
     """Reference: src/operator/nn/layer_norm-inl.h."""
     mean = jnp.mean(data, axis=axis, keepdims=True)
@@ -328,7 +377,8 @@ def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **a
     return out * gamma.reshape(shape) + beta.reshape(shape)
 
 
-@register("InstanceNorm")
+@register("InstanceNorm", params=[
+    P("eps", float, default=1e-3, low=0.0)])
 def _instance_norm(data, gamma, beta, eps=1e-3, **attrs):
     """Reference: src/operator/instance_norm-inl.h."""
     red = tuple(range(2, data.ndim))
@@ -339,7 +389,11 @@ def _instance_norm(data, gamma, beta, eps=1e-3, **attrs):
     return out * gamma.reshape(shape) + beta.reshape(shape)
 
 
-@register("LRN")
+@register("LRN", params=[
+    P("nsize", int, required=True, low=1),
+    P("alpha", float, default=1e-4, low=0.0),
+    P("beta", float, default=0.75, low=0.0),
+    P("knorm", float, default=2.0)])
 def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **attrs):
     """Reference: src/operator/nn/lrn-inl.h (cross-channel LRN)."""
     sq = jnp.square(data)
@@ -350,7 +404,11 @@ def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **attrs):
 
 
 # -- Dropout ----------------------------------------------------------------
-@register("Dropout", needs_is_train=True, needs_rng=True)
+@register("Dropout", needs_is_train=True, needs_rng=True, params=[
+    P("p", float, default=0.5, low=0.0, high=1.0,
+      doc="fraction of units dropped in train mode"),
+    P("mode", ("training", "always"), default="training"),
+    P("axes", tuple, default=(), low=0)])
 def _dropout(data, p=0.5, mode="training", axes=(), __is_train__=False,
              __rng__=None, **attrs):
     """Reference: src/operator/nn/dropout-inl.h (inverted dropout)."""

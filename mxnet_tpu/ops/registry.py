@@ -29,9 +29,113 @@ import ast
 
 from ..base import MXNetError
 
-__all__ = ["OpDef", "register", "get_op", "list_ops", "coerce_attrs"]
+__all__ = ["OpDef", "Param", "register", "get_op", "list_ops",
+           "coerce_attrs"]
 
 _OP_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class Param:
+    """Declarative typed op parameter — the native analogue of a
+    ``dmlc::Parameter`` field (reference include/mxnet/imperative.h:39-53,
+    dmlc-core parameter.h): type, default, range, and doc in one place,
+    enforced at call time and rendered into the generated docstring.
+
+    ptype: one of int/float/bool/str/tuple (python types) or a tuple of
+    allowed strings (an enum).  ``low``/``high`` bound numeric values —
+    for tuple params they bound every element.
+    """
+
+    __slots__ = ("name", "ptype", "default", "low", "high", "required",
+                 "doc")
+
+    def __init__(self, name, ptype, default=None, low=None, high=None,
+                 required=False, doc=""):
+        self.name = name
+        self.ptype = ptype
+        self.default = default
+        self.low = low
+        self.high = high
+        self.required = required
+        self.doc = doc
+
+    # -- rendering ------------------------------------------------------
+    def describe(self):
+        if isinstance(self.ptype, tuple):
+            ty = "{%s}" % ", ".join(repr(v) for v in self.ptype)
+        else:
+            ty = self.ptype.__name__
+        parts = ["%s : %s" % (self.name, ty)]
+        if self.required:
+            parts.append("required")
+        else:
+            parts.append("default=%r" % (self.default,))
+        if self.low is not None or self.high is not None:
+            parts.append("range=[%s, %s]" %
+                         ("-inf" if self.low is None else self.low,
+                          "inf" if self.high is None else self.high))
+        head = ", ".join(parts)
+        return head + ("\n    " + self.doc if self.doc else "")
+
+    # -- enforcement ----------------------------------------------------
+    def check(self, opname, value):
+        """Validate + normalize one value; raises MXNetError naming the
+        op and the parameter (reference: dmlc::ParamError)."""
+        def fail(why):
+            raise MXNetError(
+                "%s: invalid parameter %s=%r — %s" %
+                (opname, self.name, value, why))
+
+        if value is None:
+            if self.required:
+                fail("a value is required")
+            return value
+        if isinstance(self.ptype, tuple):           # enum
+            if value not in self.ptype:
+                fail("expected one of %s" % (self.ptype,))
+            return value
+        if self.ptype is bool:
+            if isinstance(value, (bool, int)) or value in (0, 1):
+                return bool(value)
+            fail("expected a boolean")
+        if self.ptype is int:
+            import numbers
+            if isinstance(value, bool) or \
+                    not isinstance(value, numbers.Integral):
+                fail("expected an integer")
+            self._range(fail, int(value))
+            return int(value)
+        if self.ptype is float:
+            import numbers
+            if not isinstance(value, numbers.Real) or \
+                    isinstance(value, bool):
+                fail("expected a number")
+            self._range(fail, float(value))
+            return float(value)
+        if self.ptype is str:
+            if not isinstance(value, str):
+                fail("expected a string")
+            return value
+        if self.ptype is tuple:
+            if isinstance(value, (int, float)) and not \
+                    isinstance(value, bool):
+                value = (int(value),)
+            if not isinstance(value, (tuple, list)):
+                fail("expected a tuple of integers")
+            try:
+                t = tuple(int(v) for v in value)
+            except (TypeError, ValueError):
+                fail("expected a tuple of integers")
+            for v in t:
+                self._range(fail, v)
+            return t
+        return value  # pragma: no cover - unknown ptype passes through
+
+    def _range(self, fail, v):
+        if self.low is not None and v < self.low:
+            fail("below the allowed minimum %s" % self.low)
+        if self.high is not None and v > self.high:
+            fail("above the allowed maximum %s" % self.high)
 
 
 class OpDef:
@@ -39,7 +143,7 @@ class OpDef:
 
     def __init__(self, name, fn, *, num_outputs=1, aliases=(),
                  needs_is_train=False, needs_rng=False,
-                 mutate_aux=(), attr_defaults=None, doc=None):
+                 mutate_aux=(), attr_defaults=None, doc=None, params=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -51,6 +155,31 @@ class OpDef:
         self.mutate_aux = tuple(mutate_aux)
         self.attr_defaults = dict(attr_defaults or {})
         self.doc = doc or (fn.__doc__ or "")
+        # declared typed parameters (dmlc::Parameter analogue); ops
+        # without a table keep free-form coerced kwargs
+        self.params = {p.name: p for p in (params or ())}
+
+    def validate_attrs(self, attrs):
+        """Enforce the declared parameter table on user attrs.
+
+        Reserved runtime attrs (``__*__``) and framework metadata pass
+        through untouched; required params missing from attrs raise.
+        No-op for ops without a table."""
+        if not self.params:
+            return attrs
+        for k, v in attrs.items():
+            if k.startswith("__") or k in ("name", "ctx_group"):
+                continue
+            spec = self.params.get(k)
+            if spec is None:
+                continue  # free-form extras stay allowed (scope attrs)
+            attrs[k] = spec.check(self.name, v)
+        for spec in self.params.values():
+            if spec.required and attrs.get(spec.name) is None:
+                raise MXNetError(
+                    "%s: required parameter %r is missing"
+                    % (self.name, spec.name))
+        return attrs
 
     def n_outputs(self, attrs):
         if callable(self.num_outputs):
@@ -67,6 +196,11 @@ class OpDef:
         import inspect
         lines = [self.doc.strip() or "%s operator." % self.name, "",
                  "Parameters", "----------"]
+        if self.params:
+            # declared table wins: typed fields with defaults/ranges/docs
+            lines += [p.describe() for p in self.params.values()]
+            self._doc_cache = "\n".join(lines)
+            return self._doc_cache
         try:
             params = inspect.signature(self.fn).parameters.values()
         except (TypeError, ValueError):  # pragma: no cover
@@ -98,13 +232,15 @@ class OpDef:
 
 
 def register(name, *, num_outputs=1, aliases=(), needs_is_train=False,
-             needs_rng=False, mutate_aux=(), attr_defaults=None):
+             needs_rng=False, mutate_aux=(), attr_defaults=None,
+             params=None):
     """Decorator: register a pure jax function as an operator."""
 
     def _wrap(fn):
         op = OpDef(name, fn, num_outputs=num_outputs, aliases=aliases,
                    needs_is_train=needs_is_train, needs_rng=needs_rng,
-                   mutate_aux=mutate_aux, attr_defaults=attr_defaults)
+                   mutate_aux=mutate_aux, attr_defaults=attr_defaults,
+                   params=params)
         for n in (name,) + tuple(aliases):
             if n in _OP_REGISTRY:
                 raise MXNetError("duplicate op registration: %s" % n)

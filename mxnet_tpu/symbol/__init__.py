@@ -26,6 +26,9 @@ def _make_symbol_call(op_name, input_syms, attrs, name=None):
         hint = hint[1:]
     name = NameManager.current().get(name, hint)
     attrs = {k: v for k, v in attrs.items() if v is not None}
+    # typed-parameter enforcement at graph-construction time — bad
+    # values fail HERE naming op+param, not deep inside jit tracing
+    op.validate_attrs(coerce_attrs(attrs))
     scope_attrs = AttrScope.current().get({})
     node_attrs = dict(scope_attrs)
     node_attrs.update(attrs)

@@ -94,3 +94,96 @@ def test_dist_sync_kvstore_two_processes(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out[-3000:])
         assert "WORKER_OK" in out
+
+
+def test_dist_async_update_on_arrival(tmp_path):
+    """dist_async applies pushes the moment they arrive — no pull, no
+    step barrier (reference kvstore_dist_server.h:282 async branch)."""
+    import time
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    os.environ["MXNET_KVSTORE_ASYNC_DIR"] = str(tmp_path)
+    try:
+        kv = mx.kv.create("dist_async")
+        assert type(kv).__name__ == "KVStoreDistAsync"
+        arrivals = []
+
+        def updater(key_int, grad, weight):
+            arrivals.append(float(grad.asnumpy()[0, 0]))
+            weight -= 0.1 * grad
+
+        kv._set_updater(updater)
+        kv.init("w", nd.zeros((2, 2)))
+        # two pushes, NO pull in between: a sync store would buffer or
+        # apply at the pull barrier; async must apply both on arrival
+        kv.push("w", nd.array(np.full((2, 2), 1.0, np.float32)))
+        kv.push("w", nd.array(np.full((2, 2), 2.0, np.float32)))
+        deadline = time.time() + 10
+        while len(arrivals) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert arrivals == [1.0, 2.0], arrivals  # arrival order
+        out = nd.zeros((2, 2))
+        kv.pull("w", out=out)
+        assert np.allclose(out.asnumpy(), -0.3), out.asnumpy()
+        kv.close()
+    finally:
+        os.environ.pop("MXNET_KVSTORE_ASYNC_DIR", None)
+
+
+def test_dist_async_two_processes(tmp_path):
+    """A second worker process spools pushes; the coordinator applies
+    them on arrival and the worker pulls the updated weights."""
+    import time
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    env = dict(os.environ)
+    env.update({"MXNET_KVSTORE_ASYNC_DIR": str(tmp_path),
+                "DMLC_WORKER_ID": "1", "DMLC_NUM_WORKER": "2",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", "")})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    worker_src = r"""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+kv = mx.kv.create("dist_async")
+kv.init("w", nd.zeros((2, 3)))        # adopts coordinator weights
+kv.push("w", nd.array(np.full((2, 3), 4.0, np.float32)))
+# poll until the coordinator's update is visible
+import time
+deadline = time.time() + 20
+out = nd.zeros((2, 3))
+while time.time() < deadline:
+    kv.pull("w", out=out)
+    if abs(float(out.asnumpy()[0, 0]) - 1.0) < 1e-6:
+        print("WORKER_SAW_UPDATE")
+        break
+    time.sleep(0.05)
+else:
+    raise SystemExit("worker never saw the update")
+"""
+    os.environ["MXNET_KVSTORE_ASYNC_DIR"] = str(tmp_path)
+    os.environ["DMLC_WORKER_ID"] = "0"
+    os.environ["DMLC_NUM_WORKER"] = "2"
+    try:
+        kv = mx.kv.create("dist_async")
+        kv._set_updater(lambda i, g, w: w.__isub__(0.25 * g))
+        kv.init("w", nd.array(np.full((2, 3), 2.0, np.float32)))
+        proc = subprocess.Popen([sys.executable, "-c", worker_src],
+                                env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        out_text, _ = proc.communicate(timeout=120)
+        assert "WORKER_SAW_UPDATE" in out_text, out_text[-2000:]
+        # coordinator applied on arrival: 2.0 - 0.25*4.0 = 1.0
+        got = nd.zeros((2, 3))
+        kv.pull("w", out=got)
+        assert np.allclose(got.asnumpy(), 1.0), got.asnumpy()
+        kv.close()
+    finally:
+        for var in ("MXNET_KVSTORE_ASYNC_DIR", "DMLC_WORKER_ID",
+                    "DMLC_NUM_WORKER"):
+            os.environ.pop(var, None)

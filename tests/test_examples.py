@@ -260,3 +260,26 @@ def test_checkpoint_resume_roundtrip(tmp_path):
     # continues from (not below) the checkpointed level
     assert acc2 >= acc1 - 0.05, (acc1, acc2)
     assert "Resumed" in out2 or "load" in out2.lower()
+
+
+def test_cnn_text_classification():
+    out = run_example("cnn_text_classification/text_cnn.py",
+                      "--num-epochs", "8",
+                      done_marker="text-cnn done")
+    import re
+    m = re.search(r"final validation accuracy: ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_rcnn_lite_end2end():
+    out = run_example("rcnn/train_end2end.py",
+                      "--epochs", "60",
+                      done_marker="rcnn-lite done")
+    import re
+    m = re.search(r"loss ([0-9.]+) -> ([0-9.]+) \| mean IoU ([0-9.]+) \| "
+                  r"cls acc ([0-9.]+)%", out)
+    assert m, out[-1500:]
+    first, last, miou, acc = map(float, m.groups())
+    assert last < first * 0.5, (first, last)      # real learning signal
+    assert acc >= 70.0, acc                       # head classifies boxes
+    assert miou > 0.30, miou                      # proposals find objects

@@ -87,3 +87,43 @@ def test_rnn_unroll_shapes():
                              layout="NTC")
     _, outs, _ = outputs.infer_shape(data=(3, 5))
     assert outs[0] == (3, 5, 12)
+
+
+def test_typed_params_range_enforced():
+    """Typed op parameters (dmlc::Parameter analogue): bad values raise
+    MXNetError naming the op and the parameter, at call AND at symbol
+    construction."""
+    import pytest
+    import numpy as np
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="Convolution.*kernel"):
+        mx.sym.Convolution(mx.sym.var("d"), kernel=(-1, -1), num_filter=4)
+    with pytest.raises(MXNetError, match="Convolution.*num_filter"):
+        mx.sym.Convolution(mx.sym.var("d"), kernel=(3, 3), num_filter=0)
+    with pytest.raises(MXNetError, match="required parameter 'kernel'"):
+        mx.sym.Convolution(mx.sym.var("d"), num_filter=4)
+    with pytest.raises(MXNetError, match="Dropout.*p"):
+        mx.nd.Dropout(mx.nd.ones((2, 2)), p=1.5)
+    with pytest.raises(MXNetError, match="Activation.*act_type"):
+        mx.nd.Activation(mx.nd.ones((2, 2)), act_type="reluu")
+    with pytest.raises(MXNetError, match="Pooling.*pool_type"):
+        mx.sym.Pooling(mx.sym.var("d"), kernel=(2, 2), pool_type="median")
+    # valid calls still work, including string-coerced attrs
+    out = mx.nd.Convolution(mx.nd.ones((1, 3, 8, 8)), mx.nd.ones((4, 3, 3, 3)),
+                         mx.nd.zeros((4,)), kernel="(3,3)", num_filter=4,
+                         pad=(1, 1))
+    assert out.shape == (1, 4, 8, 8)
+
+
+def test_typed_params_in_docs():
+    """Generated docstrings render the declared table (types, defaults,
+    ranges), as dmlc __FIELDS__ docs did."""
+    from mxnet_tpu.ops.registry import get_op
+    doc = get_op("Convolution").gen_doc()
+    assert "kernel : tuple" in doc and "required" in doc
+    assert "num_group : int" in doc and "default=1" in doc
+    doc2 = get_op("Dropout").gen_doc()
+    assert "range=[0.0, 1.0]" in doc2
+    doc3 = get_op("Activation").gen_doc()
+    assert "'relu'" in doc3

@@ -33,6 +33,9 @@ def main():
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--optimizer", type=str, default=None,
                    help="set to e.g. sgd for update-on-kvstore timing")
+    p.add_argument("--count-dispatches", action="store_true",
+                   help="report compiled-program launches per step (the "
+                        "fused stores must be O(1) in the key count)")
     args = p.parse_args()
 
     kv = mx.kv.create(args.kv_store)
@@ -47,6 +50,11 @@ def main():
         kv.init(i, a)
     total_bytes = sum(4 * args.size for _ in shapes)
 
+    counter = {"n": 0}
+    unpatch = None
+    if args.count_dispatches:
+        unpatch = _patch_dispatch_counter(counter)
+
     # warmup (compiles the fused update under kvstore=tpu)
     for i in range(args.num_layers):
         kv.push(i, grads[i])
@@ -54,6 +62,7 @@ def main():
         kv.pull(i, out=outs[i])
     nd.waitall()
 
+    counter["n"] = 0
     t0 = time.time()
     for _ in range(args.iters):
         for i in range(args.num_layers):
@@ -63,11 +72,60 @@ def main():
     for o in outs:
         o.wait_to_read()
     dt = (time.time() - t0) / args.iters
+    if unpatch is not None:
+        unpatch()
     gb = total_bytes / 1e9
     print("kvstore=%s  layers=%d x %.1fM floats" %
           (kv.type, args.num_layers, args.size / 1e6))
     print("push+pull round: %.1f ms   effective %.2f GB/s per direction"
           % (dt * 1e3, gb / dt))
+    if args.count_dispatches:
+        print("dispatches/step: %.1f" % (counter["n"] / args.iters))
+
+
+def _patch_dispatch_counter(counter):
+    """Count device-program launches made by the kvstore path.
+
+    Two choke points cover them all: ``imperative.invoke``/``invoke_fn``
+    (every eager NDArray op — each is one jitted XLA program), and
+    ``jax.jit``-produced callables created from here on (the stores'
+    fused update / batched all-reduce programs).  The C++ fast path of
+    already-compiled jits cannot be hooked from Python, so the jit
+    wrapper is patched at the factory."""
+    import jax
+    from mxnet_tpu import imperative as _imp
+    from mxnet_tpu.ndarray import ndarray as _ndm
+
+    orig_invoke, orig_invoke_fn, orig_jit = \
+        _imp.invoke, _imp.invoke_fn, jax.jit
+
+    def counted_invoke(*a, **kw):
+        counter["n"] += 1
+        return orig_invoke(*a, **kw)
+
+    def counted_invoke_fn(*a, **kw):
+        counter["n"] += 1
+        return orig_invoke_fn(*a, **kw)
+
+    def counting_jit(*jargs, **jkw):
+        wrapped = orig_jit(*jargs, **jkw)
+
+        def run(*a, **kw):
+            counter["n"] += 1
+            return wrapped(*a, **kw)
+
+        return run
+
+    _imp.invoke, _imp.invoke_fn, jax.jit = \
+        counted_invoke, counted_invoke_fn, counting_jit
+    _ndm.invoke, _ndm.invoke_fn = counted_invoke, counted_invoke_fn
+
+    def unpatch():
+        _imp.invoke, _imp.invoke_fn, jax.jit = \
+            orig_invoke, orig_invoke_fn, orig_jit
+        _ndm.invoke, _ndm.invoke_fn = orig_invoke, orig_invoke_fn
+
+    return unpatch
 
 
 if __name__ == "__main__":
