@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Neural style transfer by input optimization.
+
+Reference: /root/reference/example/neural-style/nstyle.py — optimize
+the INPUT image so a conv net's deep features match a content image
+while Gram matrices of shallower features match a style image (VGG19
+there; a compact conv pyramid here, so the example runs in seconds
+without 500MB of downloaded weights).
+
+TPU-first notes: the optimized variable is the image itself —
+``autograd.record()`` + ``backward()`` differentiates through the whole
+feature pyramid to the pixels, and each Adam step on the image is the
+same fused-step machinery training uses for weights.  Gram matrices
+are (C, HW) @ (HW, C) MXU matmuls.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+SIZE = 64
+
+
+def make_images(rng):
+    """Content: a bright disc on dark ground.  Style: diagonal stripes."""
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE].astype(np.float32)
+    content = np.zeros((3, SIZE, SIZE), np.float32)
+    mask = ((yy - 32) ** 2 + (xx - 32) ** 2) < 18 ** 2
+    content[:, mask] = 0.9
+    content += rng.rand(3, SIZE, SIZE).astype(np.float32) * 0.05
+    style = np.zeros((3, SIZE, SIZE), np.float32)
+    stripes = (((yy + xx) // 8) % 2).astype(np.float32)
+    style[0] = stripes
+    style[2] = 1.0 - stripes
+    return content, style
+
+
+def build_extractor(rng):
+    """Fixed random conv pyramid (random filters give usable style/
+    content separation at this scale; reference uses trained VGG)."""
+    net = nn.HybridSequential()
+    for ch in (16, 32, 64):
+        net.add(nn.Conv2D(ch, 3, strides=2, padding=1),
+                nn.Activation("tanh"))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian", magnitude=2))
+    net(nd.zeros((1, 3, SIZE, SIZE)))
+    for p in net.collect_params().values():
+        p.grad_req = "null"          # features are frozen
+    return net
+
+
+def features(net, x):
+    """Activations after every conv stage."""
+    feats = []
+    h = x
+    for i, blk in enumerate(net):
+        h = blk(h)
+        if i % 2 == 1:               # after each activation
+            feats.append(h)
+    return feats
+
+
+def gram(f):
+    B, C, H, W = f.shape
+    m = f.reshape((C, H * W))
+    return nd.dot(m, m.T) / (C * H * W)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--style-weight", type=float, default=50.0)
+    ap.add_argument("--output", type=str, default=None)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    content_np, style_np = make_images(rng)
+    net = build_extractor(rng)
+
+    c_feats = [f.detach() for f in features(net, nd.array(content_np[None]))]
+    s_grams = [gram(f).detach()
+               for f in features(net, nd.array(style_np[None]))]
+
+    img = nd.array(content_np[None].copy())
+    img.attach_grad()
+    trainer_state = mx.optimizer.Adam(learning_rate=args.lr)
+    state = trainer_state.create_state(0, img)
+
+    first = last = None
+    for it in range(args.iters):
+        with autograd.record():
+            feats = features(net, img)
+            content_loss = ((feats[-1] - c_feats[-1]) ** 2).mean()
+            style_loss = 0.0
+            for f, sg in zip(feats[:-1], s_grams[:-1]):
+                g = gram(f)
+                style_loss = style_loss + ((g - sg) ** 2).sum()
+            loss = content_loss + args.style_weight * style_loss
+        loss.backward()
+        trainer_state.update(0, img, img.grad, state)
+        v = float(loss.asnumpy())
+        first = v if first is None else first
+        last = v
+        if it % 30 == 0:
+            print("iter %4d  loss %.5f (content %.5f style %.5f)"
+                  % (it, v, float(content_loss.asnumpy()),
+                     float(style_loss.asnumpy())))
+    print("loss %.5f -> %.5f" % (first, last))
+    if args.output:
+        out = np.clip(img.asnumpy()[0].transpose(1, 2, 0), 0, 1)
+        np.save(args.output, out)
+        print("wrote", args.output)
+    print("neural-style done")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,9 @@ register_env("MXNET_NATIVE_DISABLE", bool, False,
 register_env("MXNET_KVSTORE_HEARTBEAT_DIR", str, None,
              "shared directory for dist-kvstore worker heartbeats "
              "(enables get_num_dead_node)")
+register_env("MXNET_CONV_LAYOUT", str, None,
+             "set to NHWC to run 2-D conv/pool internally channel-last "
+             "(layout experiment; XLA folds the boundary transposes)")
 register_env("MXNET_KVSTORE_ASYNC_DIR", str, None,
              "shared spool directory for the dist_async parameter "
              "server (coordinator applies pushes on arrival)")

@@ -67,6 +67,11 @@ def _gemm(ins, attrs):
                                   no_bias=len(ins) == 2, flatten=True)
 
 
+def _fold(op2, ins):
+    from functools import reduce
+    return reduce(op2, ins)
+
+
 def _unsqueeze(x, axes):
     for ax in sorted(int(a) for a in axes):
         x = sym_mod.expand_dims(x, axis=ax)
@@ -187,10 +192,9 @@ _CONVERT_MAP = {
     "Ceil": lambda ins, attrs: sym_mod.ceil(ins[0]),
     "Reciprocal": lambda ins, attrs: 1.0 / ins[0],
     "Pow": lambda ins, attrs: sym_mod.broadcast_power(*ins),
-    "Max": lambda ins, attrs: sym_mod.maximum(*ins) if len(ins) == 2
-        else sym_mod.broadcast_maximum(*ins),
-    "Min": lambda ins, attrs: sym_mod.minimum(*ins) if len(ins) == 2
-        else sym_mod.broadcast_minimum(*ins),
+    # variadic per the ONNX spec: fold pairwise (1 input = identity)
+    "Max": lambda ins, attrs: _fold(sym_mod.broadcast_maximum, ins),
+    "Min": lambda ins, attrs: _fold(sym_mod.broadcast_minimum, ins),
     "Clip": lambda ins, attrs: sym_mod.clip(
         ins[0], a_min=float(attrs.get("min", -3.4e38)),
         a_max=float(attrs.get("max", 3.4e38))),
@@ -287,6 +291,32 @@ def import_graph_ir(graph):
                 node.attrs["value"])
             init_names.add(node.outputs[0])
             continue
+        if node.op_type == "Clip" and len(node.inputs) >= 2:
+            # opset>=11 carries the bounds as inputs; fold constant
+            # initializers into the attrs (dynamic bounds unsupported)
+            a = dict(node.attrs)
+            bound_names = node.inputs[1:3]
+            for bname, key in zip(bound_names, ("min", "max")):
+                if not bname:
+                    continue
+                if bname not in graph.initializers:
+                    raise MXNetError(
+                        "Clip with a non-constant %s input is not "
+                        "supported" % key)
+                consumed.add(bname)
+                a[key] = float(np.asarray(graph.initializers[bname]))
+            node = NodeIR("Clip", node.inputs[:1], node.outputs, a)
+        if node.op_type == "Upsample" and len(node.inputs) == 2:
+            # opset>=9 moves scales to an input
+            sname = node.inputs[1]
+            if sname not in graph.initializers:
+                raise MXNetError(
+                    "Upsample with non-constant scales is not supported")
+            consumed.add(sname)
+            node = NodeIR("Upsample", node.inputs[:1], node.outputs,
+                          {**node.attrs,
+                           "scales": [float(s) for s in
+                                      graph.initializers[sname]]})
         if node.op_type == "Reshape" and len(node.inputs) == 2 and \
                 node.inputs[1] in graph.initializers:
             # opset>=5 carries the target shape as an initializer input
@@ -345,6 +375,8 @@ def _onnx_to_ir(model):
             v = helper.get_attribute_value(a)
             if isinstance(v, TensorProto):
                 v = numpy_helper.to_array(v)   # Constant payloads etc.
+            elif isinstance(v, bytes):
+                v = v.decode("utf-8", "surrogateescape")  # string attrs
             attrs[a.name] = v
         nodes.append(NodeIR(n.op_type, list(n.input), list(n.output),
                             attrs))

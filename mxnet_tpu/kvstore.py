@@ -559,7 +559,9 @@ class KVStoreDistAsync(KVStore):
         import numpy as _np
         try:
             names = sorted(n for n in os.listdir(self._push_dir)
-                           if n.endswith(".npz"))
+                           if n.endswith(".npz")
+                           and not n.startswith(".")
+                           and not n.endswith(".tmp.npz"))
         except OSError:
             return False
         did = False
@@ -581,9 +583,14 @@ class KVStoreDistAsync(KVStore):
                         self._updater(self._key_int(k), g, self._store[k])
                     else:
                         self._store[k] += g
+                    if len(self._applied_log) >= 1000:
+                        del self._applied_log[:500]  # debug ring buffer
                     self._applied_log.append((k, name))
                     self._publish(k)
-            os.remove(path)
+            try:
+                os.remove(path)
+            except OSError:
+                pass  # a concurrent scan won the race; nothing to redo
             did = True
         return did
 
@@ -643,7 +650,10 @@ class KVStoreDistAsync(KVStore):
             self._push_seq += 1
             name = "%013d-%03d-%06d-%s" % (
                 _now_ms(), self._rank, self._push_seq, _san(k))
-            tmp = os.path.join(self._push_dir, "." + name)
+            # temp name must NOT match the server's *.npz scan (it would
+            # race the rename); savez appends .npz, so park it under a
+            # .tmp.npz suffix the scan filters out
+            tmp = os.path.join(self._push_dir, "." + name + ".tmp")
             _np.savez(tmp, key=_np.str_(k), grad=merged.asnumpy())
             os.replace(tmp + ".npz", os.path.join(self._push_dir,
                                                   name + ".npz"))

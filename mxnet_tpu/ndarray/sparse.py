@@ -279,6 +279,9 @@ def retain(arr, indices):
     Compact in, compact out: filters the stored (values, indices) pairs;
     the dense backing is never touched.
     """
+    if not isinstance(arr, BaseSparseNDArray):
+        # dense operand (the sparse_retain op accepts it): compact first
+        arr = RowSparseNDArray(arr._data)
     arr._fresh()
     idx = indices.asnumpy() if isinstance(indices, NDArray) \
         else np.asarray(indices)

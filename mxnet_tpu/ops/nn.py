@@ -31,6 +31,16 @@ from .registry import register, Param as P, normalize_tuple
 from ..base import MXNetError
 
 
+def _internal_nhwc():
+    """Layout experiment toggle (docs/faq/perf.md): run 2-D conv/pool
+    internally in NHWC with boundary transposes XLA folds away."""
+    from .. import config as _config
+    try:
+        return (_config.get("MXNET_CONV_LAYOUT") or "").upper() == "NHWC"
+    except KeyError:  # pragma: no cover - registry not loaded yet
+        return False
+
+
 # -- FullyConnected ---------------------------------------------------------
 @register("FullyConnected", params=[
     P("num_hidden", int, required=True, low=1,
@@ -165,6 +175,22 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = normalize_tuple(stride, nd) if stride else (1,) * nd
     dilate = normalize_tuple(dilate, nd) if dilate else (1,) * nd
     pad = normalize_tuple(pad, nd) if pad else (0,) * nd
+    if nd == 2 and layout in (None, "NCHW") and _internal_nhwc():
+        # layout experiment (MXNET_CONV_LAYOUT=NHWC): run the conv in
+        # NHWC with boundary transposes.  XLA folds the transposes
+        # between consecutive NHWC-internal ops, so a conv/pool stack
+        # becomes globally NHWC — the layout the TPU convolution units
+        # prefer — while the user-facing NCHW contract is unchanged.
+        x = jnp.transpose(data, (0, 2, 3, 1))
+        w = jnp.transpose(weight, (2, 3, 1, 0))           # OIHW -> HWIO
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=num_group)
+        if not no_bias and bias is not None:
+            out = out + bias
+        return jnp.transpose(out, (0, 3, 1, 2))
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     _conv_dn(nd, layout))
     # bf16 in -> bf16 out: the TPU MXU accumulates in fp32 internally, and
@@ -250,18 +276,39 @@ def _pooling(data, kernel=None, pool_type="max", stride=None, pad=None,
         kernel = normalize_tuple(kernel)
         stride = normalize_tuple(stride, nd) if stride else (1,) * nd
         pad = normalize_tuple(pad, nd) if pad else (0,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    if nd == 2 and _internal_nhwc():
+        x = jnp.transpose(data, (0, 2, 3, 1))
+        out = _pool_core(x, kernel, stride, pad, pool_type,
+                         pooling_convention, count_include_pad,
+                         global_pool, channel_last=True)
+        return jnp.transpose(out, (0, 3, 1, 2))
+    return _pool_core(data, kernel, stride, pad, pool_type,
+                      pooling_convention, count_include_pad, global_pool,
+                      channel_last=False)
+
+
+def _pool_core(data, kernel, stride, pad, pool_type, pooling_convention,
+               count_include_pad, global_pool, channel_last):
+    nd = len(kernel)
+    if channel_last:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        base_pad = [(0, 0)] + [(p, p) for p in pad] + [(0, 0)]
+        sdim = 1
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+        sdim = 2
     if pooling_convention == "full" and not global_pool:
         # ceil-mode: add extra right-pad so ceil((x+2p-k)/s)+1 windows fit
         for i in range(nd):
-            x = data.shape[2 + i]
+            x = data.shape[sdim + i]
             p, k, s = pad[i], kernel[i], stride[i]
             out_full = int(np.ceil((x + 2 * p - k) / s)) + 1
             need = (out_full - 1) * s + k - (x + 2 * p)
-            lo, hi = base_pad[2 + i]
-            base_pad[2 + i] = (lo, hi + max(need, 0))
+            lo, hi = base_pad[sdim + i]
+            base_pad[sdim + i] = (lo, hi + max(need, 0))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, base_pad)

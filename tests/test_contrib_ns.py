@@ -159,9 +159,11 @@ def test_onnx_import_graph_ir():
     assert np.abs(out - np.asarray(ref)).max() < 1e-4
 
 
-def test_onnx_import_model_requires_package():
+def test_onnx_import_model_hermetic():
+    # no onnx package needed: the hermetic wire decoder handles real
+    # .onnx files; a missing file surfaces as the OS error
     from mxnet_tpu.contrib.onnx import import_model
-    with pytest.raises(mx.MXNetError, match="onnx"):
+    with pytest.raises((OSError, mx.MXNetError)):
         import_model("/nonexistent.onnx")
 
 

@@ -1,0 +1,165 @@
+"""CoreML converter: spec correctness via a numpy interpreter.
+
+Reference analogue: tools/coreml/test/test_mxnet_converter.py runs each
+converted model through coremltools' CoreML runtime and diffs against
+the mxnet forward.  coremltools does not ship here, so the builder-spec
+(the converter's entire semantic content: layout, weight packing,
+padding, layer wiring) is executed by a small numpy interpreter and
+diffed against the source model — same oracle shape as the caffe
+converter's tests.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools", "coreml"))
+from mxnet_coreml_converter import convert_spec, write_mlmodel  # noqa: E402
+
+
+def _interp(spec, x):
+    """Execute a builder spec on NCHW input x (numpy)."""
+    blobs = {spec["input"]["name"]: x}
+
+    def conv2d(x, W, b, stride, pad):
+        B, Ci, H, Wd = x.shape
+        O, Cg, KH, KW = W.shape
+        xp = np.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+        OH = (H + 2 * pad[0] - KH) // stride[0] + 1
+        OW = (Wd + 2 * pad[1] - KW) // stride[1] + 1
+        out = np.zeros((B, O, OH, OW), np.float32)
+        for i in range(KH):
+            for j in range(KW):
+                patch = xp[:, :, i:i + OH * stride[0]:stride[0],
+                           j:j + OW * stride[1]:stride[1]]
+                out += np.einsum("bchw,oc->bohw", patch, W[:, :, i, j])
+        if b is not None:
+            out += np.asarray(b, np.float32)[None, :, None, None]
+        return out
+
+    for ly in spec["layers"]:
+        t = ly["type"]
+        xin = blobs[ly["input"]] if isinstance(ly["input"], str) else \
+            [blobs[i] for i in ly["input"]]
+        if t == "convolution":
+            out = conv2d(xin, np.asarray(ly["weights"], np.float32),
+                         ly["bias"], ly["stride"], ly["pad"])
+        elif t == "inner_product":
+            W = np.asarray(ly["weights"], np.float32)
+            h = xin.reshape(xin.shape[0], -1)
+            out = h @ W.T
+            if ly["bias"] is not None:
+                out = out + np.asarray(ly["bias"], np.float32)
+        elif t == "activation":
+            nl = ly["non_linearity"]
+            if nl == "RELU":
+                out = np.maximum(xin, 0)
+            elif nl == "TANH":
+                out = np.tanh(xin)
+            elif nl == "SIGMOID":
+                out = 1 / (1 + np.exp(-xin))
+            elif nl == "LEAKYRELU":
+                out = np.where(xin > 0, xin, ly["alpha"] * xin)
+            else:
+                raise AssertionError(nl)
+        elif t == "batchnorm":
+            g = np.asarray(ly["gamma"], np.float32)[None, :, None, None]
+            b = np.asarray(ly["beta"], np.float32)[None, :, None, None]
+            m = np.asarray(ly["mean"], np.float32)[None, :, None, None]
+            v = np.asarray(ly["variance"], np.float32)[None, :, None, None]
+            out = g * (xin - m) / np.sqrt(v + ly["epsilon"]) + b
+        elif t == "pooling":
+            if ly["global_pooling"]:
+                red = xin.max if ly["pool_type"] == "MAX" else xin.mean
+                out = red(axis=(2, 3), keepdims=True)
+            else:
+                KH, KW = ly["kernel"]
+                SH, SW = ly["stride"]
+                B, C, H, W = xin.shape
+                OH = (H - KH) // SH + 1
+                OW = (W - KW) // SW + 1
+                out = np.zeros((B, C, OH, OW), np.float32)
+                for oi in range(OH):
+                    for oj in range(OW):
+                        w = xin[:, :, oi * SH:oi * SH + KH,
+                                oj * SW:oj * SW + KW]
+                        out[:, :, oi, oj] = (w.max((2, 3))
+                                             if ly["pool_type"] == "MAX"
+                                             else w.mean((2, 3)))
+        elif t == "flatten":
+            out = xin.reshape(xin.shape[0], -1)
+        elif t == "softmax":
+            e = np.exp(xin - xin.max(-1, keepdims=True))
+            out = e / e.sum(-1, keepdims=True)
+        elif t == "add":
+            out = xin[0] + xin[1]
+        elif t == "concat":
+            out = np.concatenate(xin, axis=1)
+        elif t == "identity":
+            out = xin
+        else:
+            raise AssertionError(t)
+        blobs[ly["output"]] = out
+    return blobs[spec["output"][0]]
+
+
+def _build_model():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="c1")
+    b1 = mx.sym.BatchNorm(c1, fix_gamma=False, name="b1")
+    r1 = mx.sym.Activation(b1, act_type="relu", name="r1")
+    p1 = mx.sym.Pooling(r1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="p1")
+    c2 = mx.sym.Convolution(p1, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                            name="c2")
+    s = mx.sym.elemwise_add(c2, p1, name="res")     # residual add
+    g = mx.sym.Pooling(s, global_pool=True, pool_type="avg", kernel=(1, 1),
+                       name="gap")
+    f = mx.sym.Flatten(g, name="fl")
+    fc = mx.sym.FullyConnected(f, num_hidden=5, name="fc")
+    return mx.sym.softmax(fc, name="prob")
+
+
+def test_coreml_spec_matches_forward(tmp_path):
+    sym = _build_model()
+    exe = sym.simple_bind(mx.cpu(), data=(2, 3, 16, 16))
+    rng = np.random.RandomState(0)
+    for k in exe.arg_dict:
+        exe.arg_dict[k][:] = rng.rand(*exe.arg_dict[k].shape).astype(
+            np.float32) * 0.3
+    for k in exe.aux_dict:
+        v = rng.rand(*exe.aux_dict[k].shape).astype(np.float32)
+        exe.aux_dict[k][:] = v + (1.0 if "var" in k else 0.0)
+    x = rng.rand(2, 3, 16, 16).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=False)
+    ref = exe.outputs[0].asnumpy()
+
+    args = {k: nd.array(v.asnumpy()) for k, v in exe.arg_dict.items()
+            if k != "data"}
+    aux = {k: nd.array(v.asnumpy()) for k, v in exe.aux_dict.items()}
+    spec = convert_spec(sym, args, aux, (3, 16, 16))
+    got = _interp(spec, x)
+    assert np.allclose(got, ref, atol=1e-4), np.abs(got - ref).max()
+
+    # JSON spec file round-trips
+    out = write_mlmodel(spec, str(tmp_path / "m.mlmodel"))
+    back = json.load(open(out))
+    assert len(back["layers"]) == len(spec["layers"])
+    got2 = _interp(back, x)
+    assert np.allclose(got2, ref, atol=1e-4)
+
+
+def test_coreml_rejects_unsupported(tmp_path):
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    data = mx.sym.var("data")
+    s = mx.sym.take(mx.sym.var("w"), data)
+    with pytest.raises(MXNetError, match="does not support"):
+        convert_spec(s, {"w": nd.ones((4, 2))}, {}, (3,))

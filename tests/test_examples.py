@@ -283,3 +283,33 @@ def test_rcnn_lite_end2end():
     assert last < first * 0.5, (first, last)      # real learning signal
     assert acc >= 70.0, acc                       # head classifies boxes
     assert miou > 0.30, miou                      # proposals find objects
+
+
+def test_toy_nce():
+    out = run_example("nce-loss/toy_nce.py", "--steps", "300",
+                      done_marker="toy-nce done")
+    import re
+    m = re.search(r"full-softmax top-1 acc ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.8, out[-1500:]
+
+
+def test_lstm_ocr_ctc():
+    out = run_example("ctc/lstm_ocr_train.py", "--steps", "80",
+                      "--lr", "0.02",
+                      done_marker="lstm-ocr done")
+    import re
+    m = re.search(r"ctc loss ([0-9.]+) -> ([0-9.]+) \| "
+                  r"exact-sequence acc ([0-9.]+)", out)
+    assert m, out[-1500:]
+    first, last, acc = map(float, m.groups())
+    assert last < 1.0 and acc >= 0.8, (first, last, acc)
+
+
+def test_neural_style():
+    out = run_example("neural-style/nstyle.py", "--iters", "90",
+                      done_marker="neural-style done")
+    import re
+    m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", out)
+    assert m, out[-1500:]
+    first, last = map(float, m.groups())
+    assert last < first * 0.2, (first, last)

@@ -265,3 +265,34 @@ def test_dropout_modes():
     assert 0.2 < frac_zero < 0.4
     kept = m[m != 0]
     assert_almost_equal(kept, np.full_like(kept, 1 / 0.7), rtol=1e-4)
+
+
+def test_conv_layout_experiment_matches(monkeypatch):
+    """MXNET_CONV_LAYOUT=NHWC runs conv/pool internally channel-last;
+    outputs and gradients must be identical to the NCHW default."""
+    import numpy as np
+    from mxnet_tpu import autograd
+
+    def stack():
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.rand(2, 3, 10, 10).astype(np.float32))
+        x.attach_grad()
+        w = nd.array(rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2)
+        w.attach_grad()
+        b = nd.array(rng.randn(8).astype(np.float32) * 0.1)
+        with autograd.record():
+            h = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=8,
+                               pad=(1, 1))
+            h = nd.Pooling(h, kernel=(2, 2), stride=(2, 2),
+                           pool_type="max")
+            h = nd.Pooling(h, kernel=(2, 2), stride=(2, 2),
+                           pool_type="avg", pooling_convention="full")
+            loss = (h * h).sum()
+        loss.backward()
+        return h.asnumpy(), x.grad.asnumpy(), w.grad.asnumpy()
+
+    ref = stack()
+    monkeypatch.setenv("MXNET_CONV_LAYOUT", "NHWC")
+    got = stack()
+    for r, g in zip(ref, got):
+        assert np.allclose(r, g, atol=1e-5)

@@ -1,0 +1,50 @@
+"""Execute every tutorial's python blocks — docs are tested artifacts.
+
+Reference analogue: tests/tutorials/test_tutorials.py runs each
+tutorial notebook and fails on any exception; here the tutorials are
+markdown with ```python blocks, executed in order within one namespace
+per file (assertions inside the blocks are the checks).
+"""
+import os
+import re
+
+import pytest
+
+DOCS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "tutorials")
+
+_BLOCK = re.compile(r"```python\n(.*?)```", re.S)
+
+
+def _tutorials():
+    found = []
+    for root, _, files in os.walk(DOCS):
+        for f in sorted(files):
+            if f.endswith(".md"):
+                found.append(os.path.join(root, f))
+    return sorted(found)
+
+
+TUTORIALS = _tutorials()
+
+
+def test_tutorials_exist():
+    assert len(TUTORIALS) >= 6, TUTORIALS
+
+
+@pytest.mark.parametrize(
+    "path", TUTORIALS,
+    ids=[os.path.relpath(p, DOCS).replace(os.sep, "/") for p in TUTORIALS])
+def test_tutorial_executes(path, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)   # tutorials may write files
+    text = open(path).read()
+    blocks = _BLOCK.findall(text)
+    assert blocks, "tutorial %s has no python blocks" % path
+    ns = {}
+    for i, block in enumerate(blocks):
+        try:
+            exec(compile(block, "%s[block %d]" % (path, i), "exec"), ns)
+        except Exception as e:  # noqa: BLE001 - report with location
+            raise AssertionError(
+                "%s block %d failed: %s\n%s"
+                % (os.path.basename(path), i, e, block)) from e

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""Convert a trained model to Apple CoreML.
+
+Reference: /root/reference/tools/coreml/mxnet_coreml_converter.py +
+converter/_mxnet_converter.py/_layers.py — walks the symbol JSON graph
+and emits one CoreML layer per op via coremltools' NeuralNetworkBuilder.
+
+This build keeps the same two-stage shape with a hermetic core:
+
+1. ``convert_spec(sym, arg_params, aux_params, input_shape)`` walks the
+   graph into a CoreML *builder spec* — a list of layer dicts carrying
+   exactly the arguments the coremltools builder methods take
+   (add_convolution, add_inner_product, add_batchnorm, add_pooling,
+   add_activation, add_softmax, add_flatten, add_elementwise, ...).
+   This is where all converter semantics live (NCHW layout, weight
+   packing, padding conventions) and it is numerically verified against
+   the source model by tests/test_coreml_converter.py's spec
+   interpreter.
+2. ``convert(...)`` materializes a real ``.mlmodel`` THROUGH coremltools
+   when it is installed (same dependency the reference requires);
+   without it, the portable JSON spec (``.mlmodel.json``) is written so
+   the conversion result remains inspectable and testable offline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+sys.path.insert(0, _REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.base import MXNetError  # noqa: E402
+
+_SUPPORTED = {"Convolution", "FullyConnected", "Activation", "BatchNorm",
+              "Pooling", "Flatten", "SoftmaxOutput", "softmax", "Concat",
+              "elemwise_add", "_plus", "broadcast_add", "Dropout",
+              "LeakyReLU", "Reshape", "null"}
+
+
+def _attr(node, name, default=None):
+    from mxnet_tpu.ops.registry import coerce_attrs
+    return coerce_attrs(node.get("attrs", node.get("attr", {}) or {})).get(
+        name, default)
+
+
+def convert_spec(sym, arg_params, aux_params, input_shape,
+                 input_name="data", class_labels=None):
+    """Symbol graph -> CoreML builder-spec dict (layers in topo order)."""
+    graph = json.loads(sym.tojson())
+    nodes = graph["nodes"]
+    layers = []
+    names = {}  # node id -> output blob name
+
+    def arr(name):
+        if name in arg_params:
+            return arg_params[name].asnumpy()
+        if name in aux_params:
+            return aux_params[name].asnumpy()
+        raise MXNetError("parameter %r missing for conversion" % name)
+
+    for nid, node in enumerate(nodes):
+        op, name = node["op"], node["name"]
+        names[nid] = name
+        ins = [names[i[0]] for i in node["inputs"]]
+        in_names = [nodes[i[0]]["name"] for i in node["inputs"]]
+        if op == "null":
+            continue
+        if op not in _SUPPORTED:
+            raise MXNetError(
+                "CoreML conversion does not support op %r (node %r); "
+                "reference coverage is the same layer family"
+                % (op, name))
+        data_in = [n for n, i in zip(ins, node["inputs"])
+                   if nodes[i[0]]["op"] != "null" or
+                   nodes[i[0]]["name"] == input_name]
+        x = data_in[0] if data_in else ins[0]
+        if op == "Convolution":
+            W = arr(in_names[1])                     # (O, I, KH, KW)
+            no_bias = bool(_attr(node, "no_bias", False))
+            layers.append(dict(
+                type="convolution", name=name, input=x, output=name,
+                kernel=list(_attr(node, "kernel")),
+                stride=list(_attr(node, "stride", (1, 1)) or (1, 1)),
+                pad=list(_attr(node, "pad", (0, 0)) or (0, 0)),
+                groups=int(_attr(node, "num_group", 1)),
+                out_channels=int(_attr(node, "num_filter")),
+                weights=W.tolist(),
+                bias=None if no_bias else arr(in_names[2]).tolist()))
+        elif op == "FullyConnected":
+            W = arr(in_names[1])                     # (out, in)
+            no_bias = bool(_attr(node, "no_bias", False))
+            layers.append(dict(
+                type="inner_product", name=name, input=x, output=name,
+                out_units=int(_attr(node, "num_hidden")),
+                weights=W.tolist(),
+                bias=None if no_bias else arr(in_names[2]).tolist()))
+        elif op == "Activation":
+            act = {"relu": "RELU", "sigmoid": "SIGMOID", "tanh": "TANH",
+                   "softrelu": "SOFTPLUS"}[_attr(node, "act_type")]
+            layers.append(dict(type="activation", name=name, input=x,
+                               output=name, non_linearity=act))
+        elif op == "LeakyReLU":
+            layers.append(dict(type="activation", name=name, input=x,
+                               output=name, non_linearity="LEAKYRELU",
+                               alpha=float(_attr(node, "slope", 0.25))))
+        elif op == "BatchNorm":
+            eps = float(_attr(node, "eps", 1e-3))
+            fix_gamma = bool(_attr(node, "fix_gamma", True))
+            gamma = arr(in_names[1])
+            if fix_gamma:
+                gamma = np.ones_like(gamma)
+            layers.append(dict(
+                type="batchnorm", name=name, input=x, output=name,
+                channels=gamma.shape[0], epsilon=eps,
+                gamma=gamma.tolist(), beta=arr(in_names[2]).tolist(),
+                mean=arr(in_names[3]).tolist(),
+                variance=arr(in_names[4]).tolist()))
+        elif op == "Pooling":
+            global_pool = bool(_attr(node, "global_pool", False))
+            layers.append(dict(
+                type="pooling", name=name, input=x, output=name,
+                pool_type={"max": "MAX", "avg": "AVERAGE",
+                           "sum": "AVERAGE"}[_attr(node, "pool_type",
+                                                   "max")],
+                kernel=list(_attr(node, "kernel", (2, 2)) or (2, 2)),
+                stride=list(_attr(node, "stride") or
+                            _attr(node, "kernel", (2, 2)) or (2, 2)),
+                pad=list(_attr(node, "pad", (0, 0)) or (0, 0)),
+                global_pooling=global_pool))
+        elif op in ("Flatten", "Reshape"):
+            layers.append(dict(type="flatten", name=name, input=x,
+                               output=name))
+        elif op in ("softmax", "SoftmaxOutput"):
+            layers.append(dict(type="softmax", name=name, input=x,
+                               output=name))
+        elif op in ("elemwise_add", "_plus", "broadcast_add"):
+            layers.append(dict(type="add", name=name, input=list(data_in),
+                               output=name))
+        elif op == "Concat":
+            layers.append(dict(type="concat", name=name,
+                               input=list(data_in), output=name))
+        elif op == "Dropout":
+            layers.append(dict(type="identity", name=name, input=x,
+                               output=name))
+    heads = [nodes[h[0]]["name"] for h in graph["heads"]]
+    spec = dict(
+        format="coreml-builder-spec/1",
+        input=dict(name=input_name, shape=list(input_shape)),
+        output=heads,
+        class_labels=list(class_labels) if class_labels else None,
+        layers=layers)
+    return spec
+
+
+def write_mlmodel(spec, path):
+    """Materialize through coremltools when present; JSON spec always."""
+    json_path = path + ".json" if not path.endswith(".json") else path
+    with open(json_path, "w") as f:
+        json.dump(spec, f)
+    try:
+        import coremltools  # noqa: F401
+    except ImportError:
+        return json_path
+    from coremltools.models import datatypes
+    from coremltools.models.neural_network import NeuralNetworkBuilder
+    inp = [(spec["input"]["name"],
+            datatypes.Array(*spec["input"]["shape"]))]
+    outp = [(spec["output"][0], None)]
+    b = NeuralNetworkBuilder(inp, outp)
+    for ly in spec["layers"]:
+        t = ly["type"]
+        if t == "convolution":
+            W = np.asarray(ly["weights"], np.float32)
+            b.add_convolution(
+                name=ly["name"], kernel_channels=W.shape[1],
+                output_channels=ly["out_channels"],
+                height=ly["kernel"][0], width=ly["kernel"][1],
+                stride_height=ly["stride"][0], stride_width=ly["stride"][1],
+                border_mode="valid", groups=ly["groups"],
+                W=W.transpose(2, 3, 1, 0), b=ly["bias"],
+                has_bias=ly["bias"] is not None,
+                input_name=ly["input"], output_name=ly["output"],
+                padding_top=ly["pad"][0], padding_bottom=ly["pad"][0],
+                padding_left=ly["pad"][1], padding_right=ly["pad"][1])
+        elif t == "inner_product":
+            W = np.asarray(ly["weights"], np.float32)
+            b.add_inner_product(
+                name=ly["name"], W=W, b=ly["bias"],
+                input_channels=W.shape[1], output_channels=W.shape[0],
+                has_bias=ly["bias"] is not None,
+                input_name=ly["input"], output_name=ly["output"])
+        elif t == "activation":
+            b.add_activation(ly["name"], ly["non_linearity"], ly["input"],
+                             ly["output"],
+                             params=[ly.get("alpha", 0.0)])
+        elif t == "batchnorm":
+            b.add_batchnorm(ly["name"], ly["channels"],
+                            np.asarray(ly["gamma"], np.float32),
+                            np.asarray(ly["beta"], np.float32),
+                            np.asarray(ly["mean"], np.float32),
+                            np.asarray(ly["variance"], np.float32),
+                            ly["input"], ly["output"],
+                            epsilon=ly["epsilon"])
+        elif t == "pooling":
+            b.add_pooling(ly["name"], ly["kernel"][0], ly["kernel"][1],
+                          ly["stride"][0], ly["stride"][1],
+                          layer_type=ly["pool_type"],
+                          padding_type="VALID",
+                          input_name=ly["input"], output_name=ly["output"],
+                          is_global=ly["global_pooling"])
+        elif t == "flatten":
+            b.add_flatten(ly["name"], 0, ly["input"], ly["output"])
+        elif t == "softmax":
+            b.add_softmax(ly["name"], ly["input"], ly["output"])
+        elif t == "add":
+            b.add_elementwise(ly["name"], ly["input"], ly["output"], "ADD")
+        elif t == "concat":
+            b.add_elementwise(ly["name"], ly["input"], ly["output"],
+                              "CONCAT")
+    coremltools.models.MLModel(b.spec).save(path)
+    return path
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Convert a checkpoint to CoreML")
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--epoch", type=int, required=True)
+    ap.add_argument("--input-shape", type=str, required=True,
+                    help="e.g. 3,224,224 (no batch dim)")
+    ap.add_argument("--output-file", required=True)
+    ap.add_argument("--class-labels", type=str, default=None,
+                    help="path to a file with one label per line")
+    args = ap.parse_args()
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.epoch)
+    labels = None
+    if args.class_labels:
+        labels = [l.strip() for l in open(args.class_labels)]
+    shape = [int(s) for s in args.input_shape.split(",")]
+    spec = convert_spec(sym, arg_params, aux_params, shape,
+                        class_labels=labels)
+    out = write_mlmodel(spec, args.output_file)
+    print("wrote %s (%d layers)" % (out, len(spec["layers"])))
+
+
+if __name__ == "__main__":
+    main()
