@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Import an ONNX model and run inference.
+
+Reference: /root/reference/example/onnx-style usage of
+``mx.contrib.onnx.import_model`` (tutorials super_resolution flow:
+load .onnx, bind, predict).
+
+This example is fully self-contained: it first EXPORTS a small trained
+classifier to a real .onnx file via the hermetic wire codec
+(contrib/onnx/onnx_proto.py — works without the onnx package), then
+imports it back with ``import_model`` and checks the imported graph
+reproduces the source model's predictions.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.contrib.onnx import import_model  # noqa: E402
+from mxnet_tpu.contrib.onnx import onnx_proto  # noqa: E402
+
+
+def train_source_model(rng):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="r1")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    out = mx.sym.SoftmaxOutput(net, name="softmax")
+    X = rng.randn(300, 6).astype(np.float32)
+    Y = (X @ rng.randn(6, 3).astype(np.float32)).argmax(1).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=30, label_name="softmax_label")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="sgd", initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1})
+    return mod, X, Y
+
+
+def export_onnx(mod, path):
+    """Write the trained 2-layer MLP as a real .onnx file."""
+    params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    nodes = [
+        ("Gemm", ["data", "fc1_weight", "fc1_bias"], ["h1"],
+         {"transB": 1, "alpha": 1.0, "beta": 1.0}),
+        ("Relu", ["h1"], ["r1"], {}),
+        ("Gemm", ["r1", "fc2_weight", "fc2_bias"], ["logits"],
+         {"transB": 1, "alpha": 1.0, "beta": 1.0}),
+        ("Softmax", ["logits"], ["prob"], {"axis": 1}),
+    ]
+    blob = onnx_proto.write_model(nodes, params, ["data"], ["prob"])
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", type=str, default="/tmp/mlp.onnx")
+    args = ap.parse_args()
+    rng = np.random.RandomState(0)
+    mod, X, Y = train_source_model(rng)
+    export_onnx(mod, args.output)
+    print("exported", args.output, "(%d bytes)"
+          % os.path.getsize(args.output))
+
+    sym, arg_params, aux_params = import_model(args.output)
+    exe = sym.simple_bind(mx.cpu(), data=(30, 6))
+    for k, v in arg_params.items():
+        if k in exe.arg_dict:
+            exe.arg_dict[k][:] = v.asnumpy()
+    exe.arg_dict["data"][:] = X[:30]
+    exe.forward(is_train=False)
+    onnx_pred = exe.outputs[0].asnumpy().argmax(1)
+
+    it = mx.io.NDArrayIter(X[:30], Y[:30], batch_size=30,
+                           label_name="softmax_label")
+    src_pred = mod.predict(it).asnumpy().argmax(1)
+    agree = (onnx_pred == src_pred).mean()
+    print("prediction agreement source vs onnx-imported: %.3f" % agree)
+    print("onnx-inference done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""Deep Q-Network on CartPole.
+
+Reference: /root/reference/example/reinforcement-learning/dqn/ (DQN +
+replay buffer + target network over Atari/ALE).  At example scale the
+environment is a self-contained CartPole physics step (the classic
+Barto-Sutton dynamics, no gym dependency), keeping the algorithm —
+epsilon-greedy exploration, experience replay, target-network Bellman
+backup — intact.
+
+TPU-first notes: the Q-network train step (gather of chosen-action
+Q-values, Bellman target, Huber loss, Adam) runs as one fused autograd
+step; the replay batch is a single host->device transfer.
+"""
+import argparse
+import os
+import sys
+from collections import deque
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class CartPole:
+    """Classic cart-pole balancing dynamics (Barto et al. 1983)."""
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.s
+        force = 10.0 if action == 1 else -10.0
+        g, mc, mp, length = 9.8, 1.0, 0.1, 0.5
+        total = mc + mp
+        costh, sinth = np.cos(th), np.sin(th)
+        temp = (force + mp * length * th_dot ** 2 * sinth) / total
+        th_acc = (g * sinth - costh * temp) / \
+            (length * (4.0 / 3.0 - mp * costh ** 2 / total))
+        x_acc = temp - mp * length * th_acc * costh / total
+        tau = 0.02
+        self.s = np.array([x + tau * x_dot, x_dot + tau * x_acc,
+                           th + tau * th_dot, th_dot + tau * th_acc],
+                          np.float32)
+        done = bool(abs(self.s[0]) > 2.4 or abs(self.s[2]) > 0.2095)
+        return self.s.copy(), 1.0, done
+
+
+def build_q(hidden=64):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(hidden, activation="relu"),
+                nn.Dense(hidden, activation="relu"),
+                nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 4)))
+    return net
+
+
+def copy_params(src, dst):
+    for (ks, ps), (kd, pd) in zip(sorted(src.collect_params().items()),
+                                  sorted(dst.collect_params().items())):
+        pd.set_data(ps.data())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=120)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--target-sync", type=int, default=200)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    env = CartPole(rng)
+    q, q_target = build_q(), build_q()
+    copy_params(q, q_target)
+    trainer = gluon.Trainer(q.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    huber = gluon.loss.HuberLoss()
+    replay = deque(maxlen=10000)
+    eps, eps_min, eps_decay = 1.0, 0.05, 0.97
+    steps_done = 0
+    returns = []
+    for ep in range(args.episodes):
+        s = env.reset()
+        total = 0.0
+        for _ in range(200):
+            if rng.rand() < eps:
+                a = rng.randint(2)
+            else:
+                a = int(q(nd.array(s[None])).asnumpy().argmax())
+            s2, r, done = env.step(a)
+            replay.append((s, a, r, s2, done))
+            s = s2
+            total += r
+            steps_done += 1
+            if len(replay) >= args.batch_size and steps_done % 2 == 0:
+                batch = [replay[i] for i in
+                         rng.randint(0, len(replay), args.batch_size)]
+                S = nd.array(np.stack([b[0] for b in batch]))
+                A = np.array([b[1] for b in batch])
+                R = np.array([b[2] for b in batch], np.float32)
+                S2 = nd.array(np.stack([b[3] for b in batch]))
+                D = np.array([b[4] for b in batch], np.float32)
+                q_next = q_target(S2).asnumpy().max(1)
+                target = nd.array(R + args.gamma * q_next * (1.0 - D))
+                with autograd.record():
+                    qs = q(S)
+                    chosen = qs.pick(nd.array(A.astype(np.float32)),
+                                     axis=1)
+                    loss = huber(chosen, target).mean()
+                loss.backward()
+                trainer.step(1)
+            if steps_done % args.target_sync == 0:
+                copy_params(q, q_target)
+            if done:
+                break
+        returns.append(total)
+        eps = max(eps_min, eps * eps_decay)
+        if ep % 20 == 0:
+            print("episode %3d  return %5.1f  eps %.2f  (avg10 %.1f)"
+                  % (ep, total, eps, np.mean(returns[-10:])))
+    early = np.mean(returns[:10])
+    late = np.mean(returns[-10:])
+    best10 = max(np.mean(returns[i:i + 10])
+                 for i in range(0, max(1, len(returns) - 9)))
+    print("avg return first10 %.1f -> last10 %.1f | best10 %.1f"
+          % (early, late, best10))
+    print("dqn done")
+
+
+if __name__ == "__main__":
+    main()

@@ -119,6 +119,20 @@ bool decode_jpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
   return true;
 }
 
+// shorter-edge target dims; false when no resize applies (downscale
+// only — matches the PIL path's `min(w, h) > resize` guard)
+bool shorter_edge_dims(int h, int w, int resize_short, int* nh, int* nw) {
+  if (resize_short <= 0 || std::min(h, w) <= resize_short) return false;
+  if (h < w) {
+    *nh = resize_short;
+    *nw = std::max(1L, std::lround(double(w) * resize_short / h));
+  } else {
+    *nw = resize_short;
+    *nh = std::max(1L, std::lround(double(h) * resize_short / w));
+  }
+  return true;
+}
+
 // bilinear resize RGB HWC
 void resize_bilinear(const uint8_t* src, int sh, int sw, uint8_t* dst,
                      int dh, int dw) {
@@ -170,12 +184,10 @@ void decode_one(const Job& job, int i) {
     job.status[i] = 1;
     return;
   }
-  // optional shorter-edge resize
+  // optional shorter-edge resize (downscale only)
   std::vector<uint8_t> resized;
-  if (job.resize_short > 0 && std::min(h, w) != job.resize_short) {
-    int nh, nw;
-    if (h < w) { nh = job.resize_short; nw = std::max(1L, std::lround(double(w) * job.resize_short / h)); }
-    else { nw = job.resize_short; nh = std::max(1L, std::lround(double(h) * job.resize_short / w)); }
+  int nh, nw;
+  if (shorter_edge_dims(h, w, job.resize_short, &nh, &nw)) {
     resized.resize(size_t(nh) * nw * 3);
     resize_bilinear(img.data(), h, w, resized.data(), nh, nw);
     img.swap(resized);
@@ -212,9 +224,117 @@ void decode_one(const Job& job, int i) {
   job.status[i] = 0;
 }
 
+// re-encode RGB HWC to JPEG into a fixed-size arena slot; returns
+// encoded byte count, or -1 when the arena slot is too small.
+long encode_jpeg(const uint8_t* rgb, int h, int w, int quality,
+                 uint8_t* dst, size_t cap) {
+  jpeg_compress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  // volatile: modified between setjmp and a potential longjmp (C11
+  // 7.13.2.1 — a plain local would be indeterminate in the handler)
+  unsigned char* volatile mem = nullptr;
+  unsigned long mem_len = 0;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_compress(&cinfo);
+    if (mem) free(mem);
+    return -1;
+  }
+  jpeg_create_compress(&cinfo);
+  unsigned char* mem_raw = nullptr;
+  jpeg_mem_dest(&cinfo, &mem_raw, &mem_len);
+  mem = mem_raw;
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  JSAMPROW row;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    row = const_cast<uint8_t*>(rgb) +
+          size_t(cinfo.next_scanline) * w * 3;
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+  mem = mem_raw;  // dest manager may have reallocated
+  long out_len = -1;
+  if (mem_len <= cap) {
+    memcpy(dst, mem, mem_len);
+    out_len = long(mem_len);
+  }
+  free(mem);
+  return out_len;
+}
+
+struct TranscodeJob {
+  const uint8_t* blob;
+  const int64_t* offs;
+  const int64_t* lens;
+  int n, resize_short, quality;
+  uint8_t* out;            // arena; slot i = [out_offs[i], out_offs[i+1])
+  const int64_t* out_offs;  // n+1 entries
+  int64_t* out_lens;
+  int* status;             // 0 ok, 1 failed (python falls back per image)
+};
+
+void transcode_one(const TranscodeJob& job, int i) {
+  const uint8_t* src = job.blob + job.offs[i];
+  size_t len = size_t(job.lens[i]);
+  uint8_t* dst = job.out + size_t(job.out_offs[i]);
+  size_t cap = size_t(job.out_offs[i + 1] - job.out_offs[i]);
+  std::vector<uint8_t> img;
+  int h = 0, w = 0;
+  if (!decode_jpeg(src, len, &img, &h, &w)) {
+    job.status[i] = 1;
+    return;
+  }
+  // always re-encode at the requested quality and RGB color space —
+  // byte-for-byte the same SEMANTICS as the PIL fallback, so native
+  // availability never changes what a dataset contains
+  int nh = h, nw = w;
+  if (shorter_edge_dims(h, w, job.resize_short, &nh, &nw)) {
+    std::vector<uint8_t> resized(size_t(nh) * nw * 3);
+    resize_bilinear(img.data(), h, w, resized.data(), nh, nw);
+    img.swap(resized);
+  }
+  long elen = encode_jpeg(img.data(), nh, nw, job.quality, dst, cap);
+  if (elen < 0) { job.status[i] = 1; return; }
+  job.out_lens[i] = elen;
+  job.status[i] = 0;
+}
+
 }  // namespace
 
 extern "C" {
+
+// im2rec fast path (reference: tools/im2rec.cc): decode + shorter-edge
+// resize + JPEG re-encode a batch of image payloads on OS threads.
+// Unresized images pass through byte-identical.  Returns failed count.
+int img_transcode_batch(const uint8_t* blob, const int64_t* offs,
+                        const int64_t* lens, int n, int resize_short,
+                        int quality, uint8_t* out, const int64_t* out_offs,
+                        int64_t* out_lens, int* status, int nthreads) {
+  TranscodeJob job{blob, offs, lens, n, resize_short, quality,
+                   out, out_offs, out_lens, status};
+  nthreads = std::max(1, std::min(nthreads, n));
+  if (nthreads == 1) {
+    for (int i = 0; i < n; ++i) transcode_one(job, i);
+  } else {
+    std::vector<std::thread> pool;
+    for (int t = 0; t < nthreads; ++t)
+      pool.emplace_back([&job, t, nthreads, n] {
+        for (int i = t; i < n; i += nthreads) transcode_one(job, i);
+      });
+    for (auto& th : pool) th.join();
+  }
+  int failed = 0;
+  for (int i = 0; i < n; ++i) failed += status[i];
+  return failed;
+}
 
 // Decode + augment a batch of JPEG payloads on nthreads OS threads.
 // Returns the number of failed images (their status[i] == 1).

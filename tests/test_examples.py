@@ -313,3 +313,50 @@ def test_neural_style():
     assert m, out[-1500:]
     first, last = map(float, m.groups())
     assert last < first * 0.2, (first, last)
+
+
+def test_vae():
+    out = run_example("vae/vae.py", "--steps", "300",
+                      done_marker="vae done")
+    import re
+    m = re.search(r"cluster purity ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_sgld_posterior():
+    out = run_example("bayesian-methods/sgld.py", "--steps", "3000",
+                      "--burn-in", "800", done_marker="sgld done")
+    import re
+    m = re.search(r"mean_err ([0-9.]+) \| std_ratio ([0-9.]+)", out)
+    assert m, out[-1500:]
+    mean_err, std_ratio = map(float, m.groups())
+    # the SGLD cloud must match the EXACT conjugate posterior
+    assert mean_err < 0.1 and 0.6 < std_ratio < 1.6, (mean_err, std_ratio)
+
+
+def test_fcn_segmentation():
+    out = run_example("fcn-xs/fcn_train.py", "--epochs", "12",
+                      done_marker="fcn done")
+    import re
+    m = re.search(r"mean IoU ([0-9.]+) \| pixel acc ([0-9.]+)", out)
+    assert m, out[-1500:]
+    miou, acc = map(float, m.groups())
+    assert miou > 0.6 and acc > 0.9, (miou, acc)
+
+
+def test_dqn_cartpole():
+    out = run_example("reinforcement-learning/dqn_cartpole.py",
+                      "--episodes", "200", "--target-sync", "100",
+                      done_marker="dqn done", timeout=900)
+    import re
+    m = re.search(r"best10 ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 50.0, out[-1500:]
+
+
+def test_onnx_roundtrip_example(tmp_path):
+    out = run_example("onnx/onnx_inference.py",
+                      "--output", str(tmp_path / "m.onnx"),
+                      done_marker="onnx-inference done")
+    import re
+    m = re.search(r"agreement source vs onnx-imported: ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.95, out[-1500:]

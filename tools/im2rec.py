@@ -155,13 +155,51 @@ def _worker(payload):
         return item[0], None
 
 
+def _native_stream(args, items, batch=64):
+    """im2rec fast path (reference: tools/im2rec.cc): batch the raw file
+    payloads through the C++ decode/resize/re-encode core (OS threads,
+    no GIL); images the core rejects fall back to the PIL path."""
+    from mxnet_tpu import native
+    for i in range(0, len(items), batch):
+        chunk = items[i:i + batch]
+        payloads = []
+        for idx, relpath, labels in chunk:
+            with open(os.path.join(args.root, relpath), "rb") as f:
+                payloads.append(f.read())
+        res = native.transcode_jpeg_batch(
+            payloads, args.resize or 0, quality=args.quality,
+            nthreads=max(args.num_thread, 1))
+        if res is None:           # no native lib: PIL for the whole chunk
+            for it in chunk:
+                yield _worker((args, it))
+            continue
+        outs, _failed = res
+        for it, out in zip(chunk, outs):
+            if out is None:       # non-JPEG/corrupt: PIL fallback
+                yield _worker((args, it))
+            else:
+                idx, _, labels = it
+                header = recordio.IRHeader(
+                    0, labels[0] if len(labels) == 1 else labels, idx, 0)
+                yield idx, recordio.pack(header, out)
+
+
 def write_rec(args, lst_path):
     prefix = os.path.splitext(lst_path)[0]
     items = list(read_list(lst_path))
     record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
     t0 = time.time()
     done = 0
-    if args.num_thread > 1:
+    use_native = (getattr(args, "use_native", True)
+                  and not args.pass_through and not args.center_crop
+                  and args.color != 0 and args.encoding != ".png")
+    if use_native:
+        from mxnet_tpu import native
+        use_native = native.get_lib() is not None
+    if use_native:
+        pool = None
+        stream = _native_stream(args, items)
+    elif args.num_thread > 1:
         pool = Pool(args.num_thread)
         stream = pool.imap(_worker, ((args, it) for it in items),
                            chunksize=16)
@@ -207,6 +245,10 @@ def main():
                    help="resize shorter edge to this size before packing")
     r.add_argument("--center-crop", action="store_true")
     r.add_argument("--quality", type=int, default=95)
+    r.add_argument("--no-native", dest="use_native", action="store_false",
+                   default=True,
+                   help="disable the C++ transcode fast path "
+                        "(reference im2rec.cc analogue)")
     r.add_argument("--num-thread", type=int, default=1,
                    help="encoding worker processes")
     r.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
