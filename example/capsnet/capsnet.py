@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Capsule network with dynamic routing.
+
+Reference: /root/reference/example/capsnet/ (Sabour et al.: primary
+capsules -> digit capsules via routing-by-agreement, margin loss on
+capsule lengths).
+
+TPU-first notes: the routing iterations are a FIXED small unroll (3
+rounds) of batched einsum/softmax — no data-dependent control flow, so
+the whole routed forward compiles into one program; the prediction
+tensor u_hat (B, in_caps, out_caps, dim) is computed once and reused
+across rounds.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+CLASSES = 4
+PRIM_CAPS = 32       # primary capsules
+PRIM_DIM = 4
+OUT_DIM = 8
+
+
+def make_data(rng, n):
+    X = rng.rand(n, 1, 16, 16).astype(np.float32) * 0.2
+    y = rng.randint(0, CLASSES, n)
+    for i in range(n):
+        c = y[i]
+        if c == 0:
+            X[i, 0, 2:14, 7:9] += 0.8
+        elif c == 1:
+            X[i, 0, 7:9, 2:14] += 0.8
+        elif c == 2:
+            for d in range(12):
+                X[i, 0, 2 + d, 2 + d] += 0.8       # diagonal
+        else:
+            X[i, 0, 4:12, 4:12] += 0.8             # block
+    return X, y.astype(np.float32)
+
+
+def squash(s, axis=-1):
+    n2 = (s ** 2).sum(axis=axis, keepdims=True)
+    return (n2 / (1.0 + n2)) * s / (n2 + 1e-9).sqrt()
+
+
+class CapsNet(gluon.nn.HybridBlock):
+    def __init__(self, routing_iters=3, **kw):
+        super().__init__(**kw)
+        self.routing_iters = routing_iters
+        with self.name_scope():
+            self.conv = nn.Conv2D(32, 5, strides=2, activation="relu")
+            self.prim = nn.Conv2D(32, 3, strides=2)  # -> (B,32,2,2)=128
+            # routing weights: (in_caps, out_caps, out_dim, in_dim)
+            self.W = self.params.get(
+                "routing_weight",
+                shape=(PRIM_CAPS, CLASSES, OUT_DIM, PRIM_DIM),
+                init=mx.init.Xavier())
+
+    def forward(self, x):
+        B = x.shape[0]
+        h = self.prim(self.conv(x))                  # (B, C', H', W')
+        u = h.reshape((B, -1))
+        # trim/pad to the primary capsule grid
+        need = PRIM_CAPS * PRIM_DIM
+        u = u.slice_axis(axis=1, begin=0, end=need)
+        u = squash(u.reshape((B, PRIM_CAPS, PRIM_DIM)))
+        W = self.W.data()                            # (P, K, D_out, D_in)
+        # u_hat[b,p,k,:] = W[p,k] @ u[b,p]
+        u_exp = u.expand_dims(2).expand_dims(3)      # (B, P, 1, 1, D_in)
+        Wb = W.expand_dims(0)                        # (1, P, K, D_out, D_in)
+        u_hat = (Wb * u_exp).sum(axis=4)             # (B, P, K, D_out)
+        # routing by agreement
+        b_logits = nd.zeros((B, PRIM_CAPS, CLASSES))
+        for _ in range(self.routing_iters):
+            c = nd.softmax(b_logits, axis=2)         # (B, P, K)
+            s = (c.expand_dims(3) * u_hat).sum(axis=1)   # (B, K, D_out)
+            v = squash(s)                            # (B, K, D_out)
+            b_logits = b_logits + (u_hat * v.expand_dims(1)).sum(axis=3)
+        return v
+
+    def lengths(self, x):
+        v = self.forward(x)
+        return ((v ** 2).sum(axis=2) + 1e-9).sqrt()  # (B, K)
+
+
+def margin_loss(lengths, y_onehot):
+    pos = nd.maximum(0.9 - lengths, 0.0) ** 2
+    neg = nd.maximum(lengths - 0.1, 0.0) ** 2
+    return (y_onehot * pos + 0.5 * (1 - y_onehot) * neg).sum(axis=1).mean()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    net = CapsNet()
+    net.initialize(mx.init.Xavier())
+    net.lengths(nd.zeros((2, 1, 16, 16)))       # materialize shapes
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    first = last = None
+    for step in range(args.steps):
+        X, y = make_data(rng, args.batch_size)
+        onehot = np.eye(CLASSES, dtype=np.float32)[y.astype(int)]
+        with autograd.record():
+            lens = net.lengths(nd.array(X))
+            loss = margin_loss(lens, nd.array(onehot))
+        loss.backward()
+        trainer.step(1)
+        v = float(loss.asnumpy())
+        first = v if first is None else first
+        last = v
+        if step % 50 == 0:
+            print("step %4d  margin loss %.4f" % (step, v))
+    Xt, yt = make_data(np.random.RandomState(9), 200)
+    pred = net.lengths(nd.array(Xt)).asnumpy().argmax(1)
+    acc = (pred == yt).mean()
+    print("loss %.4f -> %.4f | capsule-length acc %.3f"
+          % (first, last, acc))
+    print("capsnet done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+#!/usr/bin/env python3
+"""Deep Embedded Clustering (DEC).
+
+Reference: /root/reference/example/deep-embedded-clustering/dec.py
+(Xie et al.: pretrain an autoencoder, initialize centroids with
+k-means in the latent space, then refine by minimizing KL(P || Q)
+between the Student-t soft assignment Q and its sharpened target P).
+
+TPU-first notes: the soft-assignment Q, target P, and KL objective are
+a handful of broadcasted ops that fuse into one program with the
+encoder; centroids are just another parameter tensor updated by the
+same Adam step.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+DIM = 20
+K = 3
+
+
+def make_data(rng, n):
+    """Three Gaussian clusters embedded in DIM dims via a random map."""
+    mix = np.random.RandomState(3)
+    centers = mix.randn(K, 4) * 3.0
+    proj = mix.randn(4, DIM).astype(np.float32)
+    y = rng.randint(0, K, n)
+    z = centers[y] + rng.randn(n, 4) * 0.6
+    X = np.tanh(z @ proj).astype(np.float32)
+    return X, y
+
+
+def cluster_accuracy(pred, y):
+    """Best 1-1 label matching (DEC's standard metric, greedy here)."""
+    acc = 0
+    used = set()
+    for c in range(K):
+        best, best_lbl = -1, None
+        for lbl in range(K):
+            if lbl in used:
+                continue
+            hits = int(((pred == c) & (y == lbl)).sum())
+            if hits > best:
+                best, best_lbl = hits, lbl
+        used.add(best_lbl)
+        acc += best
+    return acc / len(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pretrain-steps", type=int, default=300)
+    ap.add_argument("--dec-steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, y = make_data(rng, 600)
+
+    enc = nn.HybridSequential()
+    dec_net = nn.HybridSequential()
+    with enc.name_scope():
+        enc.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    with dec_net.name_scope():
+        dec_net.add(nn.Dense(32, activation="relu"), nn.Dense(DIM))
+    enc.initialize(mx.init.Xavier())
+    dec_net.initialize(mx.init.Xavier())
+    ae_params = list(enc.collect_params().values()) + \
+        list(dec_net.collect_params().values())
+    trainer = gluon.Trainer(
+        {p.name: p for p in ae_params}, "adam",
+        {"learning_rate": args.lr * 3})
+    l2 = gluon.loss.L2Loss()
+    for step in range(args.pretrain_steps):
+        idx = rng.randint(0, len(X), 128)
+        xb = nd.array(X[idx])
+        with autograd.record():
+            loss = l2(dec_net(enc(xb)), xb).mean()
+        loss.backward()
+        trainer.step(1)
+    print("autoencoder pretrain loss %.4f" % float(loss.asnumpy()))
+
+    # centroid init: k-means (a few Lloyd iterations) in latent space
+    Z = enc(nd.array(X)).asnumpy()
+    cent = Z[rng.choice(len(Z), K, replace=False)].copy()
+    for _ in range(10):
+        d = ((Z[:, None] - cent[None]) ** 2).sum(-1)
+        assign = d.argmin(1)
+        for c in range(K):
+            if (assign == c).any():
+                cent[c] = Z[assign == c].mean(0)
+    print("k-means init purity %.3f" % cluster_accuracy(assign, y))
+
+    centroids = nd.array(cent)
+    centroids.attach_grad()
+    dec_trainer = gluon.Trainer(enc.collect_params(), "adam",
+                                {"learning_rate": args.lr})
+    cent_opt = mx.optimizer.Adam(learning_rate=args.lr)
+    cent_state = cent_opt.create_state(0, centroids)
+    for step in range(args.dec_steps):
+        idx = rng.randint(0, len(X), 256)
+        xb = nd.array(X[idx])
+        with autograd.record():
+            z = enc(xb)                                   # (B, 2)
+            # Student-t soft assignment
+            d2 = ((z.expand_dims(1) - centroids.expand_dims(0)) ** 2
+                  ).sum(axis=2)
+            q = 1.0 / (1.0 + d2)
+            q = q / q.sum(axis=1, keepdims=True)
+            # sharpened target (constant w.r.t. the step)
+            qd = q.detach()
+            p = (qd ** 2) / qd.sum(axis=0, keepdims=True)
+            p = p / p.sum(axis=1, keepdims=True)
+            kl = (p * ((p + 1e-8).log() - (q + 1e-8).log())).sum(
+                axis=1).mean()
+        kl.backward()
+        dec_trainer.step(1)
+        cent_opt.update(0, centroids, centroids.grad, cent_state)
+    Z = enc(nd.array(X)).asnumpy()
+    d = ((Z[:, None] - centroids.asnumpy()[None]) ** 2).sum(-1)
+    final = cluster_accuracy(d.argmin(1), y)
+    print("kl %.5f | final cluster purity %.3f" % (float(kl.asnumpy()),
+                                                   final))
+    print("dec done")
+
+
+if __name__ == "__main__":
+    main()

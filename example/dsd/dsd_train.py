@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Dense-Sparse-Dense training (DSD).
+
+Reference: /root/reference/example/dsd/ (Han et al.: train dense ->
+prune the smallest weights and retrain under the sparsity mask ->
+release the mask and retrain dense; the final dense model beats the
+first dense pass).
+
+TPU-first notes: the sparsity mask is a constant multiplier applied to
+the weight after every update (mask * w rebinds the parameter) — the
+masked step stays one compiled program; no dynamic sparsity structure
+is needed for DSD, whose point is the OPTIMIZATION trajectory, not
+storage.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def make_data(rng, n, d=32, classes=5):
+    W = np.random.RandomState(5).randn(d, classes).astype(np.float32)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ W + 0.5 * np.tanh(X[:, :classes])).argmax(1)
+    return X, y.astype(np.float32)
+
+
+def build(classes=5):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"),
+                nn.Dense(64, activation="relu"),
+                nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 32)))
+    return net
+
+
+def run_phase(net, rng, steps, lr, masks=None, log=print, tag=""):
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for step in range(steps):
+        X, y = make_data(rng, 64)
+        with autograd.record():
+            loss = sce(net(nd.array(X)), nd.array(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        if masks is not None:
+            # re-impose the sparsity pattern after the update
+            for name, m in masks.items():
+                p = net.collect_params()[name]
+                p.set_data(p.data() * m)
+        if step % 100 == 0:
+            log("%s step %4d loss %.4f" % (tag, step, float(loss.asnumpy())))
+    Xt, yt = make_data(np.random.RandomState(123), 1000)
+    return (net(nd.array(Xt)).asnumpy().argmax(1) == yt).mean()
+
+
+def prune_masks(net, sparsity):
+    """Magnitude pruning: zero the smallest |w| fraction per layer."""
+    masks = {}
+    for name, p in net.collect_params().items():
+        if "weight" not in name:
+            continue
+        w = p.data().asnumpy()
+        k = int(w.size * sparsity)
+        thresh = np.partition(np.abs(w).ravel(), k)[k]
+        m = (np.abs(w) > thresh).astype(np.float32)
+        masks[name] = nd.array(m)
+        p.set_data(p.data() * masks[name])
+    return masks
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--sparsity", type=float, default=0.5)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    net = build()
+    acc_dense = run_phase(net, rng, args.steps, args.lr, tag="dense")
+    print("phase 1 (dense)  acc %.4f" % acc_dense)
+
+    masks = prune_masks(net, args.sparsity)
+    nnz = {k: float(m.asnumpy().mean()) for k, m in masks.items()}
+    print("pruned to density:", {k: round(v, 2) for k, v in nnz.items()})
+    acc_sparse = run_phase(net, rng, args.steps, args.lr / 2, masks=masks,
+                           tag="sparse")
+    print("phase 2 (sparse) acc %.4f" % acc_sparse)
+
+    acc_redense = run_phase(net, rng, args.steps, args.lr / 10,
+                            tag="re-dense")
+    print("phase 3 (re-dense) acc %.4f" % acc_redense)
+    print("dsd: %.4f -> %.4f -> %.4f" % (acc_dense, acc_sparse, acc_redense))
+    print("dsd done")
+
+
+if __name__ == "__main__":
+    main()

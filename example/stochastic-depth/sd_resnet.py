@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""ResNet with stochastic depth.
+
+Reference: /root/reference/example/stochastic-depth/ (Huang et al.:
+residual blocks are randomly DROPPED during training — identity path
+only — with linearly-decaying survival probability; at test time every
+block runs, scaled by its survival probability).
+
+TPU-first notes: the per-block Bernoulli gate is sampled on host per
+step and enters the traced graph as a scalar multiplier, so the
+compiled step stays shape-static (no control flow inside jit) — the
+dropped block's compute is masked, the classic XLA-friendly rendering
+of stochastic depth.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class ResBlock(gluon.nn.HybridBlock):
+    def __init__(self, channels, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = nn.Conv2D(channels, 3, padding=1)
+            self.b1 = nn.BatchNorm()
+            self.c2 = nn.Conv2D(channels, 3, padding=1)
+            self.b2 = nn.BatchNorm()
+
+    def hybrid_forward(self, F, x, gate):
+        h = F.Activation(self.b1(self.c1(x)), act_type="relu")
+        h = self.b2(self.c2(h))
+        return F.Activation(x + h * gate, act_type="relu")
+
+
+class SDResNet(gluon.nn.HybridBlock):
+    def __init__(self, num_blocks=6, channels=16, classes=4, p_last=0.5,
+                 **kw):
+        super().__init__(**kw)
+        self.num_blocks = num_blocks
+        # linear decay: block l survives with prob 1 - l/L * (1-p_last)
+        self.p_survive = [1.0 - (l / num_blocks) * (1.0 - p_last)
+                          for l in range(1, num_blocks + 1)]
+        with self.name_scope():
+            self.stem = nn.Conv2D(channels, 3, padding=1)
+            self.blocks = [ResBlock(channels) for _ in range(num_blocks)]
+            for i, b in enumerate(self.blocks):
+                self.register_child(b)
+            self.head = nn.HybridSequential()
+            self.head.add(nn.GlobalAvgPool2D(), nn.Flatten(),
+                          nn.Dense(classes))
+
+    def forward_with_gates(self, x, gates):
+        h = self.stem(x)
+        for blk, g in zip(self.blocks, gates):
+            h = blk(h, g)
+        return self.head(h)
+
+    def hybrid_forward(self, F, x):
+        # inference: every block on, scaled by its survival probability
+        gates = [nd.array(np.array([p], np.float32))
+                 for p in self.p_survive]
+        return self.forward_with_gates(x, gates)
+
+
+def make_data(rng, n):
+    """Class = which channel carries a bright patch (3 classes) or none
+    (class 3) — a signal that survives global average pooling."""
+    X = rng.rand(n, 3, 16, 16).astype(np.float32) * 0.2
+    y = rng.randint(0, 4, n)
+    for i in range(n):
+        if y[i] < 3:
+            r, c = rng.randint(0, 8, 2)
+            X[i, y[i], r:r + 8, c:c + 8] += 0.8
+    return X, y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--num-blocks", type=int, default=6)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    net = SDResNet(num_blocks=args.num_blocks)
+    net.initialize(mx.init.Xavier())
+    net(nd.zeros((1, 3, 16, 16)))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    dropped_total = 0
+    first = last = None
+    for step in range(args.steps):
+        X, y = make_data(rng, args.batch_size)
+        survive = (rng.rand(args.num_blocks) <
+                   np.asarray(net.p_survive)).astype(np.float32)
+        dropped_total += int((survive == 0).sum())
+        gates = [nd.array(np.array([s], np.float32)) for s in survive]
+        with autograd.record():
+            out = net.forward_with_gates(nd.array(X), gates)
+            loss = sce(out, nd.array(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        v = float(loss.asnumpy())
+        first = v if first is None else first
+        last = v
+        if step % 50 == 0:
+            print("step %4d  loss %.4f  (blocks dropped so far: %d)"
+                  % (step, v, dropped_total))
+    Xt, yt = make_data(np.random.RandomState(42), 200)
+    pred = net(nd.array(Xt)).asnumpy().argmax(1)
+    acc = (pred == yt).mean()
+    print("loss %.3f -> %.3f | dropped %d block-steps | test acc %.3f"
+          % (first, last, dropped_total, acc))
+    print("stochastic-depth done")
+
+
+if __name__ == "__main__":
+    main()

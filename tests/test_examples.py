@@ -5,6 +5,7 @@ tutorial-execution suite — examples are executable documentation and
 break silently unless exercised.
 """
 import os
+import re
 import signal
 import subprocess
 import sys
@@ -266,7 +267,6 @@ def test_cnn_text_classification():
     out = run_example("cnn_text_classification/text_cnn.py",
                       "--num-epochs", "8",
                       done_marker="text-cnn done")
-    import re
     m = re.search(r"final validation accuracy: ([0-9.]+)", out)
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
@@ -275,7 +275,6 @@ def test_rcnn_lite_end2end():
     out = run_example("rcnn/train_end2end.py",
                       "--epochs", "60",
                       done_marker="rcnn-lite done")
-    import re
     m = re.search(r"loss ([0-9.]+) -> ([0-9.]+) \| mean IoU ([0-9.]+) \| "
                   r"cls acc ([0-9.]+)%", out)
     assert m, out[-1500:]
@@ -288,7 +287,6 @@ def test_rcnn_lite_end2end():
 def test_toy_nce():
     out = run_example("nce-loss/toy_nce.py", "--steps", "300",
                       done_marker="toy-nce done")
-    import re
     m = re.search(r"full-softmax top-1 acc ([0-9.]+)", out)
     assert m and float(m.group(1)) > 0.8, out[-1500:]
 
@@ -297,7 +295,6 @@ def test_lstm_ocr_ctc():
     out = run_example("ctc/lstm_ocr_train.py", "--steps", "80",
                       "--lr", "0.02",
                       done_marker="lstm-ocr done")
-    import re
     m = re.search(r"ctc loss ([0-9.]+) -> ([0-9.]+) \| "
                   r"exact-sequence acc ([0-9.]+)", out)
     assert m, out[-1500:]
@@ -308,7 +305,6 @@ def test_lstm_ocr_ctc():
 def test_neural_style():
     out = run_example("neural-style/nstyle.py", "--iters", "90",
                       done_marker="neural-style done")
-    import re
     m = re.search(r"loss ([0-9.]+) -> ([0-9.]+)", out)
     assert m, out[-1500:]
     first, last = map(float, m.groups())
@@ -318,7 +314,6 @@ def test_neural_style():
 def test_vae():
     out = run_example("vae/vae.py", "--steps", "300",
                       done_marker="vae done")
-    import re
     m = re.search(r"cluster purity ([0-9.]+)", out)
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
@@ -326,7 +321,6 @@ def test_vae():
 def test_sgld_posterior():
     out = run_example("bayesian-methods/sgld.py", "--steps", "3000",
                       "--burn-in", "800", done_marker="sgld done")
-    import re
     m = re.search(r"mean_err ([0-9.]+) \| std_ratio ([0-9.]+)", out)
     assert m, out[-1500:]
     mean_err, std_ratio = map(float, m.groups())
@@ -337,7 +331,6 @@ def test_sgld_posterior():
 def test_fcn_segmentation():
     out = run_example("fcn-xs/fcn_train.py", "--epochs", "12",
                       done_marker="fcn done")
-    import re
     m = re.search(r"mean IoU ([0-9.]+) \| pixel acc ([0-9.]+)", out)
     assert m, out[-1500:]
     miou, acc = map(float, m.groups())
@@ -348,7 +341,6 @@ def test_dqn_cartpole():
     out = run_example("reinforcement-learning/dqn_cartpole.py",
                       "--episodes", "200", "--target-sync", "100",
                       done_marker="dqn done", timeout=900)
-    import re
     m = re.search(r"best10 ([0-9.]+)", out)
     assert m and float(m.group(1)) > 50.0, out[-1500:]
 
@@ -357,6 +349,60 @@ def test_onnx_roundtrip_example(tmp_path):
     out = run_example("onnx/onnx_inference.py",
                       "--output", str(tmp_path / "m.onnx"),
                       done_marker="onnx-inference done")
-    import re
     m = re.search(r"agreement source vs onnx-imported: ([0-9.]+)", out)
     assert m and float(m.group(1)) > 0.95, out[-1500:]
+
+
+def test_stochastic_depth():
+    out = run_example("stochastic-depth/sd_resnet.py", "--steps", "150",
+                      done_marker="stochastic-depth done")
+    m = re.search(r"dropped (\d+) block-steps \| test acc ([0-9.]+)", out)
+    assert m, out[-1500:]
+    dropped, acc = int(m.group(1)), float(m.group(2))
+    assert dropped > 50 and acc > 0.9, (dropped, acc)
+
+
+def test_dsd_training():
+    out = run_example("dsd/dsd_train.py", "--steps", "250",
+                      done_marker="dsd done")
+    m = re.search(r"dsd: ([0-9.]+) -> ([0-9.]+) -> ([0-9.]+)", out)
+    assert m, out[-1500:]
+    dense, sparse_, redense = map(float, m.groups())
+    assert redense >= dense - 0.02, (dense, redense)   # DSD must not hurt
+    assert sparse_ > 0.5                               # sparse net works
+
+
+def test_lstnet_forecast():
+    out = run_example("multivariate_time_series/lstnet.py",
+                      "--steps", "200",
+                      done_marker="lstnet done", timeout=900)
+    m = re.search(r"ratio ([0-9.]+)", out)
+    assert m and float(m.group(1)) < 0.85, out[-1500:]  # beats persistence
+
+
+def test_deep_embedded_clustering():
+    out = run_example("deep-embedded-clustering/dec.py",
+                      done_marker="dec done")
+    m = re.search(r"final cluster purity ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_caffe_example():
+    out = run_example("caffe/caffe_to_mxnet.py", "--num-epochs", "8",
+                      done_marker="caffe-example done")
+    m = re.search(r"caffe-converted net accuracy: ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_capsnet_routing():
+    out = run_example("capsnet/capsnet.py", "--steps", "80",
+                      done_marker="capsnet done")
+    m = re.search(r"capsule-length acc ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_speech_keyword_spotting():
+    out = run_example("speech_recognition/speech_commands.py",
+                      "--steps", "60", done_marker="speech done")
+    m = re.search(r"keyword acc ([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9, out[-1500:]
