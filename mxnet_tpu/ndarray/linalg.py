@@ -16,18 +16,22 @@ def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, **k
                    "alpha": alpha, "beta": beta})
 
 
-def potrf(A, **kwargs):
-    return invoke("_linalg_potrf", [A])
+def potrf(A, lower=True, **kwargs):
+    return invoke("_linalg_potrf", [A], {"lower": lower})
 
 
-def trsm(A, B, transpose=False, rightside=False, alpha=1.0, **kwargs):
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+         **kwargs):
     return invoke("_linalg_trsm", [A, B],
-                  {"transpose": transpose, "rightside": rightside, "alpha": alpha})
+                  {"transpose": transpose, "rightside": rightside,
+                   "lower": lower, "alpha": alpha})
 
 
-def trmm(A, B, transpose=False, rightside=False, alpha=1.0, **kwargs):
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0,
+         **kwargs):
     return invoke("_linalg_trmm", [A, B],
-                  {"transpose": transpose, "rightside": rightside, "alpha": alpha})
+                  {"transpose": transpose, "rightside": rightside,
+                   "lower": lower, "alpha": alpha})
 
 
 def syrk(A, transpose=False, alpha=1.0, **kwargs):

@@ -363,8 +363,10 @@ def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False,
 
 
 @register("_linalg_potrf", aliases=("linalg_potrf",))
-def _linalg_potrf(A, **attrs):
-    return jnp.linalg.cholesky(A)
+def _linalg_potrf(A, lower=True, **attrs):
+    L = jnp.linalg.cholesky(A)
+    # upper factor U = L^T satisfies A = U^T U (reference lower=false)
+    return L if lower else jnp.swapaxes(L, -1, -2)
 
 
 @register("_linalg_trsm", aliases=("linalg_trsm",))

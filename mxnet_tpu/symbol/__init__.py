@@ -118,6 +118,7 @@ def _attach_symbol_methods():
 _attach_symbol_methods()
 
 from . import contrib  # noqa: E402,F401  (needs populated registry)
+from . import linalg  # noqa: E402,F401  (needs _make_sym_func defined)
 
 
 def zeros(shape, dtype="float32", **kwargs):

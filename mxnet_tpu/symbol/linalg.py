@@ -1,0 +1,28 @@
+"""mx.sym.linalg namespace (reference: python/mxnet/symbol/linalg.py).
+
+Generated from the op registry: every registered ``_linalg_*`` operator
+(kernels in ops/matrix.py, the ``_linalg_gemm``/``potrf``/``trsm``...
+family) is exposed here under its short name through the same
+``_make_sym_func`` codegen as the main symbol namespace — full attr
+pass-through (``lower``, ``name=``, docs) with no hand-copied
+signatures to drift.
+"""
+from __future__ import annotations
+
+import sys
+
+from ..ops.registry import _OP_REGISTRY
+
+
+def _populate():
+    mod = sys.modules[__name__]
+    from . import _make_sym_func
+    for opn, opdef in _OP_REGISTRY.items():
+        if not opn.startswith("_linalg_"):
+            continue
+        short = opn[len("_linalg_"):]
+        if not hasattr(mod, short):
+            setattr(mod, short, _make_sym_func(opn, opdef))
+
+
+_populate()

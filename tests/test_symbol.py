@@ -158,3 +158,29 @@ def test_symbol_op_methods_attached():
     # chained layout methods compose and keep names listable
     z = a.flatten().clip(0, 1).zeros_like()
     assert z.list_arguments() == ["a"]
+
+
+def test_symbol_linalg_namespace():
+    """mx.sym.linalg mirrors mx.nd.linalg (reference symbol/linalg.py)."""
+    import numpy as np
+    A = mx.sym.Variable("A")
+    B = mx.sym.Variable("B")
+    out = mx.sym.linalg.gemm2(A, B, transpose_b=True, alpha=2.0)
+    exe = out.simple_bind(mx.cpu(), A=(3, 4), B=(5, 4))
+    a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    b = np.random.RandomState(1).rand(5, 4).astype(np.float32)
+    exe.arg_dict["A"][:] = a
+    exe.arg_dict["B"][:] = b
+    exe.forward()
+    assert np.allclose(exe.outputs[0].asnumpy(), 2 * a @ b.T, atol=1e-5)
+    # factorization + solve round-trip
+    S = mx.sym.Variable("S")
+    tri = mx.sym.linalg.potrf(S)
+    logdet = mx.sym.linalg.sumlogdiag(tri)
+    e2 = logdet.simple_bind(mx.cpu(), S=(3, 3))
+    s = np.random.RandomState(2).rand(3, 3).astype(np.float32)
+    spd = s @ s.T + 3 * np.eye(3, dtype=np.float32)
+    e2.arg_dict["S"][:] = spd
+    e2.forward()
+    ref = 0.5 * np.log(np.linalg.det(spd))
+    assert np.allclose(e2.outputs[0].asnumpy(), ref, atol=1e-4)
