@@ -406,3 +406,16 @@ def test_speech_keyword_spotting():
                       "--steps", "60", done_marker="speech done")
     m = re.search(r"keyword acc ([0-9.]+)", out)
     assert m and float(m.group(1)) > 0.9, out[-1500:]
+
+
+def test_python_howto():
+    out = run_example("python-howto/howto.py",
+                      done_marker="python-howto done")
+    assert "multiple_outputs: both heads returned" in out
+
+
+def test_rnn_time_major():
+    out = run_example("rnn-time-major/rnn_cell_demo.py",
+                      done_marker="rnn-time-major done")
+    m = re.search(r"TNC vs NTC max diff: ([0-9.e+-]+)", out)
+    assert m and float(m.group(1)) < 1e-5, out[-1500:]
