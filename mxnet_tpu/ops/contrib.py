@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 
 
 # ---------------------------------------------------------------------------
@@ -257,7 +257,14 @@ def _box_iou(lhs, rhs, format="corner", **attrs):
     return _corner_iou(L, R).reshape(lhs.shape[:-1] + rhs.shape[:-1])
 
 
-@register("_contrib_box_nms",
+@register("_contrib_box_nms", params=[
+    P("overlap_thresh", float, default=0.5, low=0.0, high=1.0),
+    P("valid_thresh", float, default=0.0),
+    P("topk", int, default=-1),
+    P("coord_start", int, default=2),
+    P("score_index", int, default=1),
+    P("id_index", int, default=-1),
+    P("force_suppress", bool, default=False)],
           aliases=("_contrib_box_non_maximum_suppression",))
 def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
              coord_start=2, score_index=1, id_index=-1,
@@ -451,7 +458,10 @@ def _roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0, **attrs):
     return jax.vmap(one)(rois)
 
 
-@register("_contrib_ROIAlign")
+@register("_contrib_ROIAlign", params=[
+    P("pooled_size", tuple, required=True, low=1),
+    P("spatial_scale", float, required=True, low=0.0),
+    P("sample_ratio", int, default=2)])
 def _roi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
                sample_ratio=2, **attrs):
     """ROIAlign with bilinear sampling (successor to ROIPooling; matches

@@ -530,7 +530,15 @@ def _rnn_nout(attrs):
     return 1
 
 
-@register("RNN", needs_is_train=True, needs_rng=True, num_outputs=_rnn_nout)
+@register("RNN", needs_is_train=True, needs_rng=True, num_outputs=_rnn_nout,
+          params=[
+    P("state_size", int, required=True, low=1),
+    P("num_layers", int, required=True, low=1),
+    P("mode", ("rnn_relu", "rnn_tanh", "lstm", "gru"), required=True),
+    P("bidirectional", bool, default=False),
+    P("p", float, default=0.0, low=0.0, high=1.0,
+      doc="dropout between stacked layers"),
+    P("state_outputs", bool, default=False)])
 def _rnn(data, params, state, state_cell=None, mode="lstm", state_size=None,
          num_layers=1, bidirectional=False, p=0.0, state_outputs=False,
          __is_train__=False, __rng__=None, **attrs):

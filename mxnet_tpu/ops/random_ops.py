@@ -16,7 +16,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 from ..base import dtype_np
 
 
@@ -26,13 +26,17 @@ def _shape(shape):
     return normalize_tuple(shape)
 
 
-@register("_random_uniform", aliases=("uniform", "random_uniform"), needs_rng=True)
+@register("_random_uniform", aliases=("uniform", "random_uniform"),
+          needs_rng=True, params=[
+    P("low", float, default=0.0), P("high", float, default=1.0)])
 def _uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
              __rng__=None, **attrs):
     return jax.random.uniform(__rng__, _shape(shape), dtype_np(dtype), low, high)
 
 
-@register("_random_normal", aliases=("normal", "random_normal"), needs_rng=True)
+@register("_random_normal", aliases=("normal", "random_normal"),
+          needs_rng=True, params=[
+    P("loc", float, default=0.0), P("scale", float, default=1.0, low=0.0)])
 def _normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
             __rng__=None, **attrs):
     return loc + scale * jax.random.normal(__rng__, _shape(shape), dtype_np(dtype))
