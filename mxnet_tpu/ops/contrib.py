@@ -264,7 +264,9 @@ def _box_iou(lhs, rhs, format="corner", **attrs):
     P("coord_start", int, default=2),
     P("score_index", int, default=1),
     P("id_index", int, default=-1),
-    P("force_suppress", bool, default=False)],
+    P("force_suppress", bool, default=False),
+    P("in_format", ("corner", "center"), default="corner"),
+    P("out_format", ("corner", "center"), default="corner")],
           aliases=("_contrib_box_non_maximum_suppression",))
 def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
              coord_start=2, score_index=1, id_index=-1,
