@@ -13,39 +13,44 @@ from ..imperative import invoke
 from ..ops.registry import _OP_REGISTRY
 
 
-def _array_param_order(opdef):
-    """Positional parameter names of the op fn, in declaration order, so
-    keyword-passed array inputs bind to the right slots (the reference
-    binds by the op's declared input names, c_api_ndarray.cc)."""
-    import inspect
-    names = []
-    for p in inspect.signature(opdef.fn).parameters.values():
-        if p.kind == inspect.Parameter.VAR_POSITIONAL:
-            return None  # variadic op: keep call order
-        if p.kind == inspect.Parameter.VAR_KEYWORD:
-            continue
-        names.append(p.name)
-    return names
+def _split_call_kwargs(opdef, kwargs):
+    """Split user kwargs into (array inputs, attrs) using the op's
+    signature classification (registry.SigSplit): values under array-input
+    names are tensor data even when passed as numpy arrays / lists /
+    scalars (the reference binds by the op's declared input names,
+    c_api_ndarray.cc); NDArrays under any other name are inputs too."""
+    from .ndarray import NDArray
+    input_names = opdef.sig.array_names()
+    attrs, nd_kwargs = {}, {}
+    for k, v in kwargs.items():
+        if isinstance(v, NDArray) or k in input_names:
+            nd_kwargs[k] = v
+        else:
+            attrs[k] = v
+    return nd_kwargs, attrs
+
+
+def _slot_named_arrays(opdef, nd_inputs, nd_kwargs):
+    """Append keyword-passed arrays in the fn's declared slot order."""
+    order = opdef.sig.array_order()
+    if nd_kwargs and order is not None:
+        rest = [pn for pn in order[len(nd_inputs):] if pn in nd_kwargs]
+        unknown = set(nd_kwargs) - set(rest)
+        if unknown:  # aliasing: reference calls every first input `data`
+            rest = sorted(nd_kwargs, key=lambda k: order.index(k)
+                          if k in order else len(order))
+        nd_inputs += [nd_kwargs[pn] for pn in rest]
+    else:
+        nd_inputs += list(nd_kwargs.values())
+    return nd_inputs
 
 
 def _make_op_func(name, opdef):
-    param_order = _array_param_order(opdef)
-
     def op_func(*args, out=None, name=None, **kwargs):
         from .ndarray import NDArray
         nd_inputs = [a for a in args if isinstance(a, NDArray)]
-        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, NDArray)}
-        nd_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
-        if nd_kwargs and param_order is not None:
-            # slot named arrays by the fn's declared order after positionals
-            rest = [pn for pn in param_order[len(nd_inputs):] if pn in nd_kwargs]
-            unknown = set(nd_kwargs) - set(rest)
-            if unknown:  # aliasing: reference calls every first input `data`
-                rest = sorted(nd_kwargs, key=lambda k: param_order.index(k)
-                              if k in param_order else len(param_order))
-            nd_inputs += [nd_kwargs[pn] for pn in rest]
-        else:
-            nd_inputs += list(nd_kwargs.values())
+        nd_kwargs, attrs = _split_call_kwargs(opdef, kwargs)
+        nd_inputs = _slot_named_arrays(opdef, nd_inputs, nd_kwargs)
         return invoke(opdef, nd_inputs, attrs, out=out)
 
     op_func.__name__ = name
@@ -86,26 +91,14 @@ def attach_methods(nd_class):
         if opdef is None or hasattr(nd_class, opname):
             continue
 
-        param_order = _array_param_order(opdef)
-
-        def method(self, *args, _op=opdef, _order=param_order, **kwargs):
+        def method(self, *args, _op=opdef, **kwargs):
             # positionals are always inputs (raw numpy/scalars included,
             # as the generated reference methods accept); kwargs split
-            # into NDArray inputs vs attrs the same way _make_op_func
-            # does, so x.take(indices=idx) binds idx as an input
-            from .ndarray import NDArray
-            attrs = {k: v for k, v in kwargs.items()
-                     if not isinstance(v, NDArray)}
-            nd_kwargs = {k: v for k, v in kwargs.items()
-                         if isinstance(v, NDArray)}
-            inputs = [self, *args]
-            if nd_kwargs and _order is not None:
-                names = sorted(nd_kwargs,
-                               key=lambda k: _order.index(k)
-                               if k in _order else len(_order))
-                inputs += [nd_kwargs[k] for k in names]
-            else:
-                inputs += list(nd_kwargs.values())
+            # by the shared signature classification, so
+            # x.take(indices=idx) binds idx as an input even when idx is
+            # a numpy array or list
+            nd_kwargs, attrs = _split_call_kwargs(_op, kwargs)
+            inputs = _slot_named_arrays(_op, [self, *args], nd_kwargs)
             return invoke(_op, inputs, attrs)
 
         method.__name__ = opname

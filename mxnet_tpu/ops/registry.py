@@ -26,6 +26,7 @@ declared by the op via ``needs_is_train`` / ``needs_rng`` flags.
 from __future__ import annotations
 
 import ast
+import inspect
 
 from ..base import MXNetError
 
@@ -34,6 +35,20 @@ __all__ = ["OpDef", "Param", "register", "get_op", "list_ops",
 
 _OP_REGISTRY: dict[str, "OpDef"] = {}
 
+# Optional ARRAY inputs: keyword-with-default fn parameters that are
+# tensors, not attrs (filled positionally by the dispatcher).  Single
+# source of truth — symbol composition imports this to decide which
+# variables to auto-create.
+OPTIONAL_ARRAY_INPUTS = frozenset({
+    "bias", "gamma", "state_cell", "sequence_length",
+    "data_lengths", "label_lengths", "trans"})
+
+# Framework metadata attrs that ride along with any op call and are not
+# op parameters (reference: node attrs like `name` live on the NNVM node,
+# not in the dmlc::Parameter struct).  `__*__` attrs (scope attrs such as
+# __lr_mult__, runtime injections __is_train__/__rng__) also pass through.
+_PASSTHROUGH_ATTRS = frozenset({"name", "ctx_group"})
+
 
 class Param:
     """Declarative typed op parameter — the native analogue of a
@@ -41,16 +56,24 @@ class Param:
     dmlc-core parameter.h): type, default, range, and doc in one place,
     enforced at call time and rendered into the generated docstring.
 
-    ptype: one of int/float/bool/str/tuple (python types) or a tuple of
-    allowed strings (an enum).  ``low``/``high`` bound numeric values —
-    for tuple params they bound every element.
+    ptype: one of int/float/bool/str/tuple (python types), a tuple of
+    allowed strings (an enum), or None meaning "any value" (name-checked
+    but not type-checked).  ``low``/``high`` bound numeric values — for
+    tuple params they bound every element.  ``elem`` sets the element
+    type of tuple params (int, float, or None for pass-through);
+    defaults to int, the reference's TShape behaviour.
+
+    ``derived`` marks a table entry auto-derived from the op fn's
+    signature rather than hand-declared (see ``OpDef``): it still gates
+    the set of accepted kwarg names and applies inferred type checks,
+    but carries no range/enum constraints.
     """
 
     __slots__ = ("name", "ptype", "default", "low", "high", "required",
-                 "doc")
+                 "doc", "elem", "derived")
 
     def __init__(self, name, ptype, default=None, low=None, high=None,
-                 required=False, doc=""):
+                 required=False, doc="", elem=int, derived=False):
         self.name = name
         self.ptype = ptype
         self.default = default
@@ -58,11 +81,17 @@ class Param:
         self.high = high
         self.required = required
         self.doc = doc
+        self.elem = elem
+        self.derived = derived
 
     # -- rendering ------------------------------------------------------
     def describe(self):
-        if isinstance(self.ptype, tuple):
+        if self.ptype is None:
+            ty = "any"
+        elif isinstance(self.ptype, tuple):
             ty = "{%s}" % ", ".join(repr(v) for v in self.ptype)
+        elif self.ptype is tuple and self.elem is not None:
+            ty = "tuple of %s" % self.elem.__name__
         else:
             ty = self.ptype.__name__
         parts = ["%s : %s" % (self.name, ty)]
@@ -89,6 +118,8 @@ class Param:
         if value is None:
             if self.required:
                 fail("a value is required")
+            return value
+        if self.ptype is None:                      # any: name-gated only
             return value
         if isinstance(self.ptype, tuple):           # enum
             if value not in self.ptype:
@@ -117,17 +148,25 @@ class Param:
                 fail("expected a string")
             return value
         if self.ptype is tuple:
+            # None elements pass through: dmlc::optional<int> parity
+            # (reference slice begin/end/step accept per-axis None,
+            # src/operator/tensor/matrix_op-inl.h SliceParam)
+            cast = self.elem if self.elem is not None else (lambda v: v)
+            what = ("a tuple of %ss" % self.elem.__name__
+                    if self.elem is not None else "a tuple")
             if isinstance(value, (int, float)) and not \
                     isinstance(value, bool):
-                value = (int(value),)
+                value = (cast(value),)
             if not isinstance(value, (tuple, list)):
-                fail("expected a tuple of integers")
+                fail("expected %s" % what)
             try:
-                t = tuple(int(v) for v in value)
+                t = tuple(None if v is None else cast(v) for v in value)
             except (TypeError, ValueError):
-                fail("expected a tuple of integers")
-            for v in t:
-                self._range(fail, v)
+                fail("expected %s" % what)
+            if self.elem is not None:
+                for v in t:
+                    if v is not None:
+                        self._range(fail, v)
             return t
         return value  # pragma: no cover - unknown ptype passes through
 
@@ -138,12 +177,114 @@ class Param:
             fail("above the allowed maximum %s" % self.high)
 
 
+def _infer_param(name, default):
+    """One signature-derived Param: type inferred from the default value.
+
+    `dtype` params stay untyped (users pass strings, numpy dtypes, or
+    type objects interchangeably); `None` defaults carry no type
+    information and stay untyped too — the entry still gates the kwarg
+    NAME, which is what kills silent typos."""
+    if name == "dtype" or default is None:
+        return Param(name, None, default=default, derived=True)
+    if isinstance(default, bool):
+        return Param(name, bool, default=default, derived=True)
+    if isinstance(default, int):
+        return Param(name, int, default=default, derived=True)
+    if isinstance(default, float):
+        return Param(name, float, default=default, derived=True)
+    if isinstance(default, str):
+        return Param(name, str, default=default, derived=True)
+    if isinstance(default, (tuple, list)):
+        elem = (float if any(isinstance(v, float) for v in default)
+                else int)
+        return Param(name, tuple, default=tuple(default), elem=elem,
+                     derived=True)
+    return Param(name, None, default=default, derived=True)
+
+
+class SigSplit:
+    """Classification of an op fn's named parameters — the ONE source of
+    truth shared by the nd dispatcher, NDArray method codegen, symbol
+    composition, and param-table derivation (each previously re-walked
+    the signature with hand-copied rules).
+
+    required:  positional array inputs (no default), declaration order
+    optional:  optional array inputs (OPTIONAL_ARRAY_INPUTS ∩ signature)
+    attrs:     {name: default} for keyword attrs (``__*__`` excluded)
+    variadic:  fn takes *args (e.g. Concat) — array binding is by call
+               order, named slotting does not apply
+    """
+
+    __slots__ = ("required", "optional", "attrs", "variadic",
+                 "_order", "_names")
+
+    def __init__(self, fn):
+        self.required, self.optional = [], []
+        self.attrs = {}
+        self.variadic = False
+        self._order = self._names = None
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):  # pragma: no cover - builtins
+            return
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                self.variadic = True
+                continue
+            if p.kind == inspect.Parameter.VAR_KEYWORD:
+                continue
+            if p.default is inspect.Parameter.empty:
+                if p.kind == inspect.Parameter.KEYWORD_ONLY:
+                    # keyword-only without default: an attr, not an
+                    # array slot (arrays always bind positionally)
+                    self.attrs[p.name] = None
+                else:
+                    self.required.append(p.name)
+            elif p.name in OPTIONAL_ARRAY_INPUTS:
+                self.optional.append(p.name)
+            elif not p.name.startswith("__"):
+                self.attrs[p.name] = p.default
+
+    def array_order(self):
+        """Array-input names in declaration order (None for variadic ops
+        — those bind by call order only).  Cached: this runs on every
+        imperative dispatch."""
+        if self._order is None and not self.variadic:
+            self._order = self.required + self.optional
+        return self._order
+
+    def array_names(self):
+        if self._names is None:
+            self._names = frozenset(self.required) | frozenset(self.optional)
+        return self._names
+
+
+def _derive_params(split, declared, mutate_aux, attr_defaults):
+    """Complete an op's parameter table from its fn signature — the
+    scripted leg of the dmlc::Parameter migration (reference declares a
+    Parameter struct per op, e.g. src/operator/nn/convolution-inl.h:50-100;
+    here the fn signature IS the declaration, so the table is derived
+    from it).  Hand-declared entries win; keyword-with-default fn
+    parameters fill the rest.  Optional ARRAY inputs (bias, gamma, ...)
+    and reserved ``__*__`` runtime injections are not attrs."""
+    derived = {}
+    for n, default in split.attrs.items():
+        if n in mutate_aux or n in declared:
+            continue
+        derived[n] = _infer_param(n, attr_defaults.get(n, default))
+    for n, v in attr_defaults.items():
+        if n not in derived and n not in declared and not n.startswith("__"):
+            derived[n] = _infer_param(n, v)
+    return derived
+
+
 class OpDef:
     """Metadata + implementation for one operator."""
 
     def __init__(self, name, fn, *, num_outputs=1, aliases=(),
                  needs_is_train=False, needs_rng=False,
-                 mutate_aux=(), attr_defaults=None, doc=None, params=None):
+                 mutate_aux=(), attr_defaults=None, doc=None, params=None,
+                 free_attrs=False):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -155,24 +296,38 @@ class OpDef:
         self.mutate_aux = tuple(mutate_aux)
         self.attr_defaults = dict(attr_defaults or {})
         self.doc = doc or (fn.__doc__ or "")
-        # declared typed parameters (dmlc::Parameter analogue); ops
-        # without a table keep free-form coerced kwargs
+        # typed parameter table (dmlc::Parameter analogue): hand-declared
+        # entries (types/ranges/enums/docs) merged over signature-derived
+        # ones, so EVERY op has a complete table of accepted kwarg names.
         self.params = {p.name: p for p in (params or ())}
+        self.free_attrs = free_attrs
+        self.sig = SigSplit(fn)
+        if not free_attrs:
+            self.params.update(_derive_params(
+                self.sig, self.params, self.mutate_aux, self.attr_defaults))
 
     def validate_attrs(self, attrs):
-        """Enforce the declared parameter table on user attrs.
+        """Enforce the parameter table on user attrs.
 
-        Reserved runtime attrs (``__*__``) and framework metadata pass
-        through untouched; required params missing from attrs raise.
-        No-op for ops without a table."""
-        if not self.params:
-            return attrs
+        Unknown kwargs raise, naming the op and the nearest valid
+        parameter (reference: dmlc::Parameter Init() throws on unknown
+        keys).  Reserved runtime/scope attrs (``__*__``) and framework
+        metadata (``name``, ``ctx_group``) pass through untouched;
+        required params missing from attrs raise."""
         for k, v in attrs.items():
-            if k.startswith("__") or k in ("name", "ctx_group"):
+            if k.startswith("__") or k in _PASSTHROUGH_ATTRS:
                 continue
             spec = self.params.get(k)
             if spec is None:
-                continue  # free-form extras stay allowed (scope attrs)
+                if self.free_attrs:
+                    continue
+                import difflib
+                close = difflib.get_close_matches(k, self.params, n=1)
+                hint = "; did you mean %r?" % close[0] if close else ""
+                raise MXNetError(
+                    "%s: unknown parameter %r%s  (valid parameters: %s)"
+                    % (self.name, k, hint,
+                       ", ".join(sorted(self.params)) or "<none>"))
             attrs[k] = spec.check(self.name, v)
         for spec in self.params.values():
             if spec.required and attrs.get(spec.name) is None:
@@ -187,37 +342,24 @@ class OpDef:
         return self.num_outputs
 
     def gen_doc(self):
-        """Render the op's parameter table from its fn signature — the
-        native stand-in for dmlc::Parameter's declarative field docs
-        (__FIELDS__ rendered into every op docstring in the reference;
-        dmlc-core parameter.h).  Cached after first render."""
+        """Render the op's docstring: array inputs from the signature,
+        then the typed parameter table — the native stand-in for
+        dmlc::Parameter's declarative field docs (__FIELDS__ rendered
+        into every op docstring in the reference; dmlc-core
+        parameter.h).  Cached after first render."""
         if getattr(self, "_doc_cache", None) is not None:
             return self._doc_cache
-        import inspect
         lines = [self.doc.strip() or "%s operator." % self.name, "",
                  "Parameters", "----------"]
-        if self.params:
-            # declared table wins: typed fields with defaults/ranges/docs
-            lines += [p.describe() for p in self.params.values()]
-            self._doc_cache = "\n".join(lines)
-            return self._doc_cache
-        try:
-            params = inspect.signature(self.fn).parameters.values()
-        except (TypeError, ValueError):  # pragma: no cover
-            params = []
-        for p in params:
-            if p.kind == inspect.Parameter.VAR_KEYWORD:
-                continue
-            if p.kind == inspect.Parameter.VAR_POSITIONAL:
-                lines.append("*%s : NDArray/Symbol (variadic input)"
-                             % p.name)
-            elif p.default is inspect.Parameter.empty:
-                kind = ("aux state" if p.name in self.mutate_aux
-                        else "required input")
-                lines.append("%s : NDArray/Symbol (%s)" % (p.name, kind))
-            else:
-                lines.append("%s : optional, default=%r"
-                             % (p.name, p.default))
+        for n in self.sig.required:
+            kind = ("aux state" if n in self.mutate_aux
+                    else "required input")
+            lines.append("%s : NDArray/Symbol (%s)" % (n, kind))
+        if self.sig.variadic:
+            lines.append("*data : NDArray/Symbol (variadic input)")
+        for n in self.sig.optional:
+            lines.append("%s : NDArray/Symbol (optional input)" % n)
+        lines += [p.describe() for p in self.params.values()]
         if not callable(self.num_outputs) and self.num_outputs > 1:
             lines.append("")
             lines.append("Outputs: %d (%s aux write-back)"
@@ -233,14 +375,17 @@ class OpDef:
 
 def register(name, *, num_outputs=1, aliases=(), needs_is_train=False,
              needs_rng=False, mutate_aux=(), attr_defaults=None,
-             params=None):
-    """Decorator: register a pure jax function as an operator."""
+             params=None, free_attrs=False):
+    """Decorator: register a pure jax function as an operator.
+
+    ``free_attrs=True`` opts the op out of unknown-kwarg rejection
+    (reserved for genuinely open-ended attr surfaces)."""
 
     def _wrap(fn):
         op = OpDef(name, fn, num_outputs=num_outputs, aliases=aliases,
                    needs_is_train=needs_is_train, needs_rng=needs_rng,
                    mutate_aux=mutate_aux, attr_defaults=attr_defaults,
-                   params=params)
+                   params=params, free_attrs=free_attrs)
         for n in (name,) + tuple(aliases):
             if n in _OP_REGISTRY:
                 raise MXNetError("duplicate op registration: %s" % n)
@@ -292,10 +437,12 @@ def coerce_attrs(attrs):
 
 
 def normalize_tuple(x, n=None):
-    """'(2,2)' | 2 | (2,2) -> tuple; broadcast scalars to length n."""
+    """'(2,2)' | 2 | (2,2) -> tuple; broadcast scalars to length n.
+    None elements pass through (dmlc::optional<int> parity — reference
+    slice begin/end/step accept per-axis None, matrix_op-inl.h)."""
     x = _coerce(x)
     if isinstance(x, (list, tuple)):
-        t = tuple(int(i) for i in x)
+        t = tuple(None if i is None else int(i) for i in x)
     else:
         t = (int(x),)
     if n is not None and len(t) == 1:

@@ -14,7 +14,6 @@ MXNet's bidirectional weight-shape inference (FInferShape).
 """
 from __future__ import annotations
 
-import inspect
 import json
 
 import numpy as np
@@ -56,21 +55,11 @@ def _fn_input_names(op: OpDef):
 
     Parameters without defaults are required array inputs; a few known
     optional-array names are included when present (bias etc.)."""
-    sig = inspect.signature(op.fn)
-    required, optional = [], []
-    _optional_arrays = {"bias", "gamma", "state_cell", "sequence_length",
-                       "data_lengths", "label_lengths", "trans"}
-    for p in sig.parameters.values():
-        if p.kind in (inspect.Parameter.VAR_POSITIONAL,):
-            required.append("*data")
-            break
-        if p.kind == inspect.Parameter.VAR_KEYWORD:
-            continue
-        if p.default is inspect.Parameter.empty:
-            required.append(p.name)
-        elif p.name in _optional_arrays:
-            optional.append(p.name)
-    return required, optional
+    if op.sig.variadic:
+        # leading named inputs (e.g. Crop's `data, *like`) keep their
+        # slots; the variadic tail binds by call order
+        return list(op.sig.required) + ["*data"], []
+    return list(op.sig.required), list(op.sig.optional)
 
 
 def _op_input_names(op: OpDef, attrs):

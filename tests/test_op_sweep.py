@@ -255,3 +255,76 @@ def test_optimizer_update_kernels():
     assert np.allclose(w32.asnumpy(), w0 - 0.5 * g0, rtol=1e-2)
     assert np.allclose(w16.asnumpy(), (w0 - 0.5 * g0).astype(np.float16),
                        rtol=1e-2)
+
+
+# -- typed-parameter tables (dmlc::Parameter parity) ------------------------
+# Reference: every op declares a dmlc::Parameter struct whose Init()
+# throws on unknown keys (src/operator/nn/convolution-inl.h:50-100,
+# dmlc-core parameter.h).  Here every registered op must carry a
+# parameter table (hand-declared entries merged over signature-derived
+# ones) and reject unknown kwargs naming the nearest valid parameter.
+
+def test_every_op_has_param_table():
+    import inspect
+    from mxnet_tpu.ops.registry import _OP_REGISTRY, OPTIONAL_ARRAY_INPUTS
+    ops = {o.name: o for o in _OP_REGISTRY.values()}
+    # completeness: every keyword attr the op fn accepts is in the table
+    incomplete = []
+    for n, o in ops.items():
+        sig_attrs = {
+            p.name for p in inspect.signature(o.fn).parameters.values()
+            if p.kind not in (inspect.Parameter.VAR_POSITIONAL,
+                              inspect.Parameter.VAR_KEYWORD)
+            and p.default is not inspect.Parameter.empty
+            and not p.name.startswith("__")
+            and p.name not in OPTIONAL_ARRAY_INPUTS
+            and p.name not in o.mutate_aux}
+        if not sig_attrs <= set(o.params):
+            incomplete.append((n, sorted(sig_attrs - set(o.params))))
+    assert not incomplete, "ops with attrs missing from table: %s" % incomplete
+    free = [n for n, o in ops.items() if o.free_attrs]
+    assert not free, "unexpected free-attr ops (must be documented): %s" % free
+
+
+def test_every_op_rejects_unknown_kwarg():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ops.registry import _OP_REGISTRY
+    ops = {o.name: o for o in _OP_REGISTRY.values()}
+    accepted = []
+    for n, op in ops.items():
+        try:
+            op.validate_attrs({"zz_bogus_attr": 1})
+            accepted.append(n)
+        except MXNetError as e:
+            assert n in str(e) and "zz_bogus_attr" in str(e)
+    assert not accepted, "ops silently accepting unknown kwargs: %s" % accepted
+
+
+def test_unknown_kwarg_suggests_nearest_param():
+    from mxnet_tpu.base import MXNetError
+    # imperative path
+    with pytest.raises(MXNetError, match=r"no_bias"):
+        nd.FullyConnected(nd.ones((2, 3)), nd.ones((4, 3)), nd.ones((4,)),
+                          num_hidden=4, no_bais=True)
+    # symbolic path fails at graph-construction time, same message
+    import mxnet_tpu.symbol as sym
+    with pytest.raises(MXNetError, match=r"no_bias"):
+        sym.FullyConnected(sym.var("d"), num_hidden=4, no_bais=True)
+    # typo'd kernel on Convolution names the op
+    with pytest.raises(MXNetError, match=r"Convolution.*kernal.*kernel"):
+        sym.Convolution(sym.var("d"), kernal=(3, 3), num_filter=8)
+
+
+def test_derived_params_type_checked():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ops.registry import get_op
+    conv = get_op("Convolution")
+    # cudnn-compat kwargs come from the signature, not the declared table
+    assert "cudnn_off" in conv.params and conv.params["cudnn_off"].derived
+    # bool-typed derived entry rejects a non-boolean
+    with pytest.raises(MXNetError, match=r"cudnn_off"):
+        conv.validate_attrs({"kernel": (3, 3), "num_filter": 8,
+                             "cudnn_off": "sometimes"})
+    # scope/framework attrs still pass through untouched
+    conv.validate_attrs({"kernel": (3, 3), "num_filter": 8,
+                         "name": "c0", "__lr_mult__": "2.0"})
