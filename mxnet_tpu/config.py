@@ -100,3 +100,10 @@ register_env("MXNET_CONV_LAYOUT", str, None,
 register_env("MXNET_KVSTORE_ASYNC_DIR", str, None,
              "shared spool directory for the dist_async parameter "
              "server (coordinator applies pushes on arrival)")
+register_env("MXNET_KVSTORE_ASYNC_MAX_PENDING", int, 64,
+             "dist_async spool capacity: push blocks while this many "
+             "spooled gradients await the server (bounds staleness and "
+             "spool growth; 0 disables backpressure)")
+register_env("MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT", float, 120.0,
+             "seconds a dist_async push may block on a full spool "
+             "before raising (a dead server thread, not staleness)")

@@ -172,7 +172,15 @@ class KVStore:
 
     def set_optimizer(self, optimizer):
         """Run optimizer on the store (update-on-kvstore; reference
-        kvstore.py:443 + server-side optimizer)."""
+        kvstore.py:443 + server-side optimizer).
+
+        row_sparse gradients: optimizers with a lazy path (SGD, Adam
+        ``lazy_update=True``) consume the compact payload; any other
+        optimizer densifies the gradient DEVICE-side (an O(dense) HBM
+        scatter, no host transfer) before its dense kernel — the same
+        fallback the reference takes for optimizers without an RspRsp
+        kernel (optimizer_op-inl.h).  See
+        docs/architecture/note_host_sync_boundaries.md."""
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
 
@@ -367,6 +375,8 @@ class KVStoreDist(KVStoreTPU):
         from .parallel import distributed
         distributed.init_distributed()
         self._jit_cache = {}
+        self._stage_fn = None   # lead-shard reshaper (jit caches per avals)
+        self._zero_shards = {}  # (shape, dtype) -> persistent zero shards
         self._hb_dir = None
         from . import config as _config
         hb = _config.get("MXNET_KVSTORE_HEARTBEAT_DIR")
@@ -419,8 +429,23 @@ class KVStoreDist(KVStoreTPU):
         program for the whole list, so a step's dispatch count does not
         scale with the number of parameters.
 
+        Device-resident data plane (reference analogue: ZPush writes
+        straight into the engine's comm buffer, kvstore_dist.h:387): a
+        step performs ZERO host-staged copies.  Shard layout per key:
+        local device 0 carries the process's value as a (1, ...) lead
+        shard, every other local device a (1, ...) zero shard, so the
+        global axis-0 sum is exactly the sum over processes.  The zero
+        shards are allocated ONCE per (shape, dtype) and reused every
+        step (they are never donated to the reduce program, so their
+        buffers stay live); the lead shards for ALL keys are produced by
+        one compiled reshape program, and assembling the global arrays
+        from resident shards is metadata-only.  The lead-shard reshape
+        is an HBM copy of the gradients — the same class of cost as the
+        reference's copy into the ps-lite send buffer — but nothing
+        crosses the host boundary.
+
         root_only: contribute zeros unless this is process 0 — the
-        broadcast used by ``init``.
+        broadcast used by ``init`` (staging cost is irrelevant there).
         """
         import jax
         import jax.numpy as jnp
@@ -431,20 +456,29 @@ class KVStoreDist(KVStoreTPU):
         mesh = self._global_mesh()
         local = mesh.local_devices
         n_global = len(mesh.devices.ravel())
+        key = tuple((a.shape, str(a.dtype)) for a in arrs) + (len(local),)
+
+        if root_only and jax.process_index() != 0:
+            arrs = [jnp.zeros_like(a) for a in arrs]
+        # one program reshapes every key's value to its (1, ...) lead
+        # shard on device; device_put to local[0] is a no-op when the
+        # value is already resident there (the common case)
+        if self._stage_fn is None:
+            self._stage_fn = jax.jit(lambda xs: [x[None] for x in xs])
+        leads = [jax.device_put(l, local[0])
+                 for l in self._stage_fn(list(arrs))]
+
         garrs = []
-        for arr in arrs:
-            if root_only and jax.process_index() != 0:
-                arr = jnp.zeros_like(arr)
-            # shard layout: one (1, ...) slice per local device; device 0
-            # carries the process's value, other local devices zeros, so
-            # the global sum is exactly sum over processes (no rescale)
-            zero = jnp.zeros_like(arr)
-            shards = [jax.device_put(arr[None] if i == 0 else zero[None], d)
-                      for i, d in enumerate(local)]
+        for arr, lead in zip(arrs, leads):
+            sig = (arr.shape, str(arr.dtype))
+            zeros = self._zero_shards.get(sig)
+            if zeros is None:
+                z = jnp.zeros((1,) + arr.shape, arr.dtype)
+                zeros = [jax.device_put(z, d) for d in local[1:]]
+                self._zero_shards[sig] = zeros
             garrs.append(jax.make_array_from_single_device_arrays(
                 (n_global,) + arr.shape, NamedSharding(mesh, P("w")),
-                shards))
-        key = tuple((a.shape, str(a.dtype)) for a in arrs) + (len(local),)
+                [lead] + list(zeros)))
         if key not in self._jit_cache:
             self._jit_cache[key] = jax.jit(
                 lambda xs: [jnp.sum(x, axis=0) for x in xs],
@@ -554,16 +588,22 @@ class KVStoreDistAsync(KVStore):
             if not self._apply_arrivals():
                 time.sleep(0.01)
 
+    def _spool_files(self):
+        """Completed spool files in arrival order — the one scan
+        predicate shared by the server, backpressure, and drain (it must
+        mirror push()'s temp naming: '.'+name+'.tmp' -> .tmp.npz)."""
+        try:
+            return sorted(n for n in os.listdir(self._push_dir)
+                          if n.endswith(".npz")
+                          and not n.startswith(".")
+                          and not n.endswith(".tmp.npz"))
+        except OSError:
+            return []
+
     def _apply_arrivals(self):
         """Apply every spooled push in arrival order; True if any."""
         import numpy as _np
-        try:
-            names = sorted(n for n in os.listdir(self._push_dir)
-                           if n.endswith(".npz")
-                           and not n.startswith(".")
-                           and not n.endswith(".tmp.npz"))
-        except OSError:
-            return False
+        names = self._spool_files()
         did = False
         for name in names:
             path = os.path.join(self._push_dir, name)
@@ -638,14 +678,53 @@ class KVStoreDistAsync(KVStore):
                 time.sleep(0.01)  # mid-replace; retry
         raise MXNetError("dist_async: cannot read weight %r" % (k,))
 
+    def _spool_backpressure(self, headroom=1):
+        """Block while the spool is at capacity, so bounded staleness is
+        actually bounded: workers outrunning the server thread (or a
+        slow shared filesystem) cannot grow the spool without limit.
+        The bound is cap + (num_workers - 1): the capacity check and the
+        spool write are not one atomic step, so each concurrent worker
+        can land one extra file past a just-full spool.  Raises after
+        MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT — a spool pinned at
+        capacity that long means the server thread is dead, not merely
+        behind.
+
+        Returns how many files may be spooled before the next scan is
+        needed (``headroom`` asks for more than one — push() uses this
+        to pay ONE directory scan per call, not per key)."""
+        import time
+
+        from . import config as _config
+        cap = _config.get("MXNET_KVSTORE_ASYNC_MAX_PENDING")
+        if not cap or cap <= 0:
+            return headroom
+        deadline = time.time() + \
+            _config.get("MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT")
+        while True:
+            pending = len(self._spool_files())
+            if pending < cap:
+                return max(1, min(headroom, cap - pending))
+            if time.time() > deadline:
+                raise MXNetError(
+                    "dist_async: push spool held %d pending gradients "
+                    "past the backpressure timeout — is the coordinator "
+                    "server thread alive?" % pending)
+            time.sleep(0.005)
+
     def push(self, key, value, priority=0):
         """Spool the merged gradient and RETURN — no barrier, no wait;
-        the server applies it on arrival."""
+        the server applies it on arrival.  A full spool blocks first
+        (``_spool_backpressure``)."""
         import numpy as _np
         keys, vals = _ctype_key_value(key, value)
+        budget = 0
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))
+            if budget <= 0:
+                budget = self._spool_backpressure(
+                    headroom=len(keys))
+            budget -= 1
             merged = self._reduce(k, vlist)
             self._push_seq += 1
             name = "%013d-%03d-%06d-%s" % (
@@ -682,8 +761,7 @@ class KVStoreDistAsync(KVStore):
         import time
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if not any(n.endswith(".npz")
-                       for n in os.listdir(self._push_dir)):
+            if not self._spool_files():
                 return True
             time.sleep(0.01)
         return False

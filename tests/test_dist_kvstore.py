@@ -187,3 +187,116 @@ else:
         for var in ("MXNET_KVSTORE_ASYNC_DIR", "DMLC_WORKER_ID",
                     "DMLC_NUM_WORKER"):
             os.environ.pop(var, None)
+
+
+STAGING_WORKER = r"""
+import os, sys
+import numpy as np
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, %(repo)r)
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank = kv.rank
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+keys = list(range(3))
+shapes = [(64, 8), (128,), (16, 4, 4)]
+for k, s in zip(keys, shapes):
+    kv.init(k, nd.zeros(s))
+grads = [nd.array(np.full(s, float(rank + 1), np.float32)) for s in shapes]
+outs = [nd.zeros(s) for s in shapes]
+
+# warmup: compiles stage/reduce/update programs, allocates zero shards
+for k, g in zip(keys, grads):
+    kv.push(k, g)
+for k, o in zip(keys, outs):
+    kv.pull(k, out=o)
+nd.waitall()
+
+# steady state: count bytes device_put actually moves (non-resident
+# operands) using the SAME counter the bandwidth tool ships.  The
+# device-resident data plane must move ZERO.
+sys.path.insert(0, os.path.join(%(repo)r, "tools"))
+from bandwidth import _patch_staging_counter
+staged = {"bytes": 0}
+unpatch = _patch_staging_counter(staged)
+for k, g in zip(keys, grads):
+    kv.push(k, g)
+for k, o in zip(keys, outs):
+    kv.pull(k, out=o)
+nd.waitall()
+unpatch()
+
+assert staged["bytes"] == 0, "host-staged bytes in steady state: %%d" %% staged["bytes"]
+# numerics: two sgd steps on grad summed over ranks (1+2)=3 -> w = -0.6
+assert np.allclose(outs[0].asnumpy(), -0.6, atol=1e-5), outs[0].asnumpy()[0, :3]
+print("STAGING_OK rank=%%d" %% rank)
+"""
+
+
+@pytest.mark.slow
+def test_dist_sync_zero_host_staging(tmp_path):
+    """Steady-state dist_sync push moves zero host-staged bytes: the
+    lead shard is produced on device, zero shards are persistent, and
+    global assembly is metadata-only (VERDICT r3 #3; reference ZPush
+    writes into the engine's comm buffer, kvstore_dist.h:387)."""
+    script = tmp_path / "staging_worker.py"
+    script.write_text(STAGING_WORKER % {"repo": REPO})
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": "9431",
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_NUM_WORKER": "2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "worker %d failed:\n%s" % (rank, out[-3000:])
+        assert "STAGING_OK" in out
+
+
+def test_dist_async_spool_bounded_under_stalled_server(tmp_path):
+    """With the coordinator's server thread stalled, pushes hit the
+    spool capacity and block, then raise after the backpressure timeout
+    — the spool is bounded by MXNET_KVSTORE_ASYNC_MAX_PENDING plus at
+    most one in-flight file per concurrent worker (VERDICT r3 #9)."""
+    import glob
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+
+    os.environ["MXNET_KVSTORE_ASYNC_DIR"] = str(tmp_path)
+    os.environ["MXNET_KVSTORE_ASYNC_MAX_PENDING"] = "3"
+    os.environ["MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT"] = "0.5"
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", nd.zeros((2, 2)))
+        # stall the server: stop the thread after init's publish
+        kv._stop.set()
+        kv._server.join(timeout=5)
+        g = nd.array(np.ones((2, 2), np.float32))
+        with pytest.raises(MXNetError, match="backpressure|server thread"):
+            for _ in range(10):
+                kv.push("w", g)
+        spooled = glob.glob(str(tmp_path / "push" / "*.npz"))
+        assert len(spooled) <= 3, "spool exceeded capacity: %d" % len(spooled)
+    finally:
+        for var in ("MXNET_KVSTORE_ASYNC_DIR",
+                    "MXNET_KVSTORE_ASYNC_MAX_PENDING",
+                    "MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT"):
+            os.environ.pop(var, None)
