@@ -46,17 +46,54 @@ def _pair(v):
     return tuple(v if len(v) > 1 else v * 2)
 
 
+def _sym_pad(attrs, op):
+    """ONNX pads (x1b, x2b, x1e, x2e) -> symmetric (x1, x2); asymmetric
+    padding has no Convolution/Deconvolution equivalent — fail loudly
+    rather than silently shift the output."""
+    pads = list(attrs.get("pads", (0, 0, 0, 0)))
+    if len(pads) < 2:
+        return (0, 0)
+    half = len(pads) // 2
+    if pads[:half] != pads[half:]:
+        raise MXNetError(
+            "%s import requires symmetric pads, got %s (auto_pad-style "
+            "asymmetric padding is not supported)" % (op, pads))
+    return tuple(pads[:2])
+
+
 def _conv(ins, attrs):
     kernel = _pair(attrs.get("kernel_shape", (1, 1)))
     strides = _pair(attrs.get("strides", (1, 1)))
     dil = _pair(attrs.get("dilations", (1, 1)))
-    pads = list(attrs.get("pads", (0, 0, 0, 0)))
-    pad = (pads[0], pads[1]) if len(pads) >= 2 else (0, 0)
+    pad = _sym_pad(attrs, "Conv")
     group = int(attrs.get("group", 1))
     num_filter = attrs["__num_filter__"]
     return sym_mod.Convolution(
         *ins, kernel=kernel, stride=strides, dilate=dil, pad=pad,
         num_group=group, num_filter=num_filter, no_bias=len(ins) == 2)
+
+
+def _conv_transpose(ins, attrs):
+    kernel = _pair(attrs.get("kernel_shape", (1, 1)))
+    strides = _pair(attrs.get("strides", (1, 1)))
+    dil = _pair(attrs.get("dilations", (1, 1)))
+    pad = _sym_pad(attrs, "ConvTranspose")
+    group = int(attrs.get("group", 1))
+    adj = _pair(attrs.get("output_padding", (0, 0)))
+    return sym_mod.Deconvolution(
+        *ins, kernel=kernel, stride=strides, dilate=dil, pad=pad,
+        adj=adj, num_group=group, num_filter=attrs["__num_filter__"],
+        no_bias=len(ins) == 2)
+
+
+def _fc(ins, attrs):
+    # legacy caffe2-era FC node: Y = X.W^T + b, flattening from `axis`
+    if int(attrs.get("axis", 1)) != 1 or \
+            int(attrs.get("axis_w", 1)) != 1:
+        raise MXNetError("FC import supports axis=1/axis_w=1 only")
+    return sym_mod.FullyConnected(
+        *ins, num_hidden=attrs["__num_hidden__"],
+        no_bias=len(ins) == 2, flatten=True)
 
 
 def _gemm(ins, attrs):
@@ -82,8 +119,7 @@ def _pool(kind):
     def conv(ins, attrs):
         kernel = _pair(attrs.get("kernel_shape", (2, 2)))
         strides = _pair(attrs.get("strides", kernel))
-        pads = list(attrs.get("pads", (0, 0, 0, 0)))
-        pad = (pads[0], pads[1]) if len(pads) >= 2 else (0, 0)
+        pad = _sym_pad(attrs, "%sPool" % kind.capitalize())
         return sym_mod.Pooling(ins[0], kernel=kernel, stride=strides,
                                pad=pad, pool_type=kind)
     return conv
@@ -162,11 +198,28 @@ def _reduce(op, default_keep=1):
     return conv
 
 
+_ONNX_DTYPES = {1: "float32", 6: "int32", 7: "int64", 10: "float16",
+                11: "float64"}
+
+
 def _cast(ins, attrs):
     to = int(attrs.get("to", 1))
-    dt = {1: "float32", 6: "int32", 7: "int64", 10: "float16",
-          11: "float64"}.get(to, "float32")
-    return sym_mod.Cast(ins[0], dtype=dt)
+    return sym_mod.Cast(ins[0], dtype=_ONNX_DTYPES.get(to, "float32"))
+
+
+def _rand_dtype(attrs):
+    """ONNX Random* dtype attr -> framework dtype string."""
+    dt = int(attrs.get("dtype", 1))
+    if dt not in _ONNX_DTYPES:
+        raise MXNetError("Random* import: unsupported dtype enum %d" % dt)
+    return _ONNX_DTYPES[dt]
+
+
+def _cast_if(sym, attrs):
+    """Apply the optional Random*Like dtype override via Cast."""
+    if "dtype" in attrs:
+        return sym_mod.Cast(sym, dtype=_rand_dtype(attrs))
+    return sym
 
 
 def _split(ins, attrs):
@@ -181,7 +234,9 @@ def _split(ins, attrs):
 
 _CONVERT_MAP = {
     "Conv": _conv,
+    "ConvTranspose": _conv_transpose,
     "Gemm": _gemm,
+    "FC": _fc,
     # elementwise family
     "Exp": lambda ins, attrs: sym_mod.exp(ins[0]),
     "Log": lambda ins, attrs: sym_mod.log(ins[0]),
@@ -234,6 +289,9 @@ _CONVERT_MAP = {
     "ArgMax": lambda ins, attrs: sym_mod.argmax(
         ins[0], axis=int(attrs.get("axis", 0)),
         keepdims=bool(attrs.get("keepdims", 1))),
+    "ArgMin": lambda ins, attrs: sym_mod.argmin(
+        ins[0], axis=int(attrs.get("axis", 0)),
+        keepdims=bool(attrs.get("keepdims", 1))),
     "Gather": lambda ins, attrs: sym_mod.take(
         ins[0], ins[1], axis=int(attrs.get("axis", 0))),
     "LogSoftmax": lambda ins, attrs: sym_mod.log_softmax(
@@ -253,6 +311,25 @@ _CONVERT_MAP = {
     "GlobalMaxPool": _global_pool("max"),
     "GlobalAveragePool": _global_pool("avg"),
     "BatchNormalization": _batchnorm,
+    "SpatialBN": _batchnorm,   # legacy caffe2-era alias
+    # random family (seed attr dropped: keys are framework-managed)
+    "RandomUniform": lambda ins, attrs: sym_mod.random_uniform(
+        low=float(attrs.get("low", 0.0)), high=float(attrs.get("high", 1.0)),
+        shape=tuple(int(s) for s in attrs["shape"]),
+        dtype=_rand_dtype(attrs)),
+    "RandomNormal": lambda ins, attrs: sym_mod.random_normal(
+        loc=float(attrs.get("mean", 0.0)),
+        scale=float(attrs.get("scale", 1.0)),
+        shape=tuple(int(s) for s in attrs["shape"]),
+        dtype=_rand_dtype(attrs)),
+    "RandomUniformLike": lambda ins, attrs: _cast_if(
+        sym_mod.random_uniform_like(
+            ins[0], low=float(attrs.get("low", 0.0)),
+            high=float(attrs.get("high", 1.0))), attrs),
+    "RandomNormalLike": lambda ins, attrs: _cast_if(
+        sym_mod.random_normal_like(
+            ins[0], loc=float(attrs.get("mean", 0.0)),
+            scale=float(attrs.get("scale", 1.0))), attrs),
     "Flatten": lambda ins, attrs: sym_mod.Flatten(ins[0]),
     "Reshape": _reshape,
     "Concat": lambda ins, attrs: sym_mod.concat(
@@ -333,7 +410,12 @@ def import_graph_ir(graph):
         if node.op_type == "Conv" and len(node.inputs) >= 2:
             attrs["__num_filter__"] = int(
                 graph.initializers[node.inputs[1]].shape[0])
-        if node.op_type == "Gemm" and len(node.inputs) >= 2:
+        if node.op_type == "ConvTranspose" and len(node.inputs) >= 2:
+            # weight layout (C_in, C_out/group, kH, kW)
+            attrs["__num_filter__"] = int(
+                graph.initializers[node.inputs[1]].shape[1]
+                * int(node.attrs.get("group", 1)))
+        if node.op_type in ("Gemm", "FC") and len(node.inputs) >= 2:
             attrs["__num_hidden__"] = int(
                 graph.initializers[node.inputs[1]].shape[0])
         if node.op_type == "Split":
@@ -350,7 +432,7 @@ def import_graph_ir(graph):
             outs = [out]
         for name, o in zip(node.outputs, outs):
             tensors[name] = o
-        if node.op_type == "BatchNormalization":
+        if node.op_type in ("BatchNormalization", "SpatialBN"):
             # running stats are aux, not args (reference convention)
             for aux_name in node.inputs[3:5]:
                 aux_params[aux_name] = nd.array(

@@ -42,6 +42,24 @@ def _normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
     return loc + scale * jax.random.normal(__rng__, _shape(shape), dtype_np(dtype))
 
 
+@register("_random_uniform_like", aliases=("random_uniform_like",),
+          needs_rng=True, params=[
+    P("low", float, default=0.0), P("high", float, default=1.0)])
+def _uniform_like(data, low=0.0, high=1.0, __rng__=None, **attrs):
+    """Sample U(low, high) with the input's shape/dtype (reference:
+    sample_op.cc _random_uniform_like)."""
+    return jax.random.uniform(__rng__, data.shape, data.dtype, low, high)
+
+
+@register("_random_normal_like", aliases=("random_normal_like",),
+          needs_rng=True, params=[
+    P("loc", float, default=0.0), P("scale", float, default=1.0, low=0.0)])
+def _normal_like(data, loc=0.0, scale=1.0, __rng__=None, **attrs):
+    """Sample N(loc, scale) with the input's shape/dtype (reference:
+    sample_op.cc _random_normal_like)."""
+    return loc + scale * jax.random.normal(__rng__, data.shape, data.dtype)
+
+
 @register("_random_gamma", aliases=("random_gamma",), needs_rng=True)
 def _gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
            __rng__=None, **attrs):
