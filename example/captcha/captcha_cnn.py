@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""4-digit captcha CNN with four softmax heads (reference:
+/root/reference/example/captcha/mxnet_captcha.R).
+
+A shared conv backbone reads the (1, 16, 64) image; four Dense heads
+each classify one digit position; the loss is the sum of the four
+cross-entropies — identical to the reference's mx.symbol.Group of four
+SoftmaxOutputs.
+
+TPU-first notes: all four heads share one backbone forward, and the
+whole step (backbone + 4 heads + 4 losses) fuses into a single XLA
+program under the autograd tape.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, gluon, autograd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+H, W, DIGITS = 16, 64, 4
+
+# 5x3 digit glyphs (same trick as tools/im2rec tests): rows of 3 bits
+_GLYPHS = {
+    0: "111101101101111", 1: "010110010010111", 2: "111001111100111",
+    3: "111001111001111", 4: "101101111001001", 5: "111100111001111",
+    6: "111100111101111", 7: "111001001001001", 8: "111101111101111",
+    9: "111101111001111",
+}
+
+
+def render(rng, digits):
+    img = rng.rand(H, W).astype(np.float32) * 0.25
+    for pos, d in enumerate(digits):
+        g = np.array([int(c) for c in _GLYPHS[d]], np.float32).reshape(5, 3)
+        g = np.kron(g, np.ones((2, 3), np.float32))        # 10x9
+        r = rng.randint(0, H - 10)
+        c = pos * (W // DIGITS) + rng.randint(0, W // DIGITS - 9)
+        img[r:r + 10, c:c + 9] = np.maximum(img[r:r + 10, c:c + 9], g)
+    return img
+
+
+def make_data(rng, n):
+    ys = rng.randint(0, 10, (n, DIGITS))
+    X = np.stack([render(rng, y) for y in ys])[:, None]    # (N,1,H,W)
+    return X.astype(np.float32), ys
+
+
+class CaptchaNet(nn.HybridBlock):
+    def __init__(self):
+        super().__init__()
+        self.backbone = nn.HybridSequential()
+        self.backbone.add(
+            nn.Conv2D(16, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(32, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(), nn.Dense(128, activation="relu"))
+        self.heads = [nn.Dense(10) for _ in range(DIGITS)]
+        for i, h in enumerate(self.heads):
+            self.register_child(h, "head%d" % i)
+
+    def hybrid_forward(self, F, x):
+        f = self.backbone(x)
+        return [h(f) for h in self.heads]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X, ys = make_data(rng, 1500)
+    net = CaptchaNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    nb = len(X) // args.batch_size
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(X))
+        tot = 0.0
+        for b in range(nb):
+            sel = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            xb = nd.array(X[sel])
+            yb = [nd.array(ys[sel, i].astype(np.float32))
+                  for i in range(DIGITS)]
+            with autograd.record():
+                outs = net(xb)
+                loss = sum(ce(o, y).mean() for o, y in zip(outs, yb))
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy())
+        print("epoch %d  loss=%.4f" % (epoch, tot / nb))
+
+    # evaluate: per-digit and whole-captcha accuracy on fresh captchas
+    Xt, yt = make_data(np.random.RandomState(1), 256)
+    outs = net(nd.array(Xt))
+    pred = np.stack([o.asnumpy().argmax(1) for o in outs], axis=1)
+    per_digit = (pred == yt).mean()
+    whole = (pred == yt).all(axis=1).mean()
+    print("FINAL per-digit acc: %.4f  whole-captcha acc: %.4f"
+          % (per_digit, whole))
+    assert whole > 0.8, (per_digit, whole)
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

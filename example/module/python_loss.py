@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""PythonLossModule: a loss whose gradient is computed in numpy
+(reference: /root/reference/example/module/python_loss.py — multiclass
+hinge gradient via numba; numpy is plenty here).  The compiled MLP
+module and the Python loss are chained with SequentialModule.
+
+TPU-first note: the scores round-trip to the host every step — that is
+the point of the example (arbitrary Python in the loop), not the fast
+path; prefer compiled losses for production.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def mc_hinge_grad(scores, labels):
+    """d/ds of the Crammer-Singer multiclass hinge loss."""
+    scores = scores.asnumpy()
+    labels = labels.asnumpy().astype(int)
+    n, _ = scores.shape
+    grad = np.zeros_like(scores)
+    margin = 1.0 + scores - scores[np.arange(n), labels][:, None]
+    margin[np.arange(n), labels] = 0.0
+    pred = margin.argmax(1)
+    viol = margin[np.arange(n), pred] > 0
+    grad[viol, labels[viol]] -= 1.0
+    grad[viol, pred[viol]] += 1.0
+    return grad / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n_class, dim, n = 10, 128, 2000
+    centers = rng.randn(n_class, dim).astype(np.float32) * 2.0
+    y = rng.randint(0, n_class, n)
+    X = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=100,
+                              shuffle=True, label_name="softmax_label")
+
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+        act_type="relu")
+    scores = mx.sym.FullyConnected(h, num_hidden=n_class, name="fc2")
+    mlp = mx.mod.Module(scores, label_names=[])
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+
+    mod = mx.mod.SequentialModule()
+    mod.add(mlp).add(loss, take_labels=True, auto_wiring=True)
+    mod.fit(train, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    metric = mx.metric.Accuracy()
+    acc = dict(mod.score(mx.io.NDArrayIter(
+        X, y.astype(np.float32), batch_size=100,
+        label_name="softmax_label"), metric))["accuracy"]
+    print("FINAL train accuracy: %.4f" % acc)
+    assert acc > 0.95, acc
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

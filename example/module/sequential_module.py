@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Two Modules chained with SequentialModule (reference:
+/root/reference/example/module/sequential_module.py): the feature MLP
+and the classifier head are SEPARATE modules; `auto_wiring` feeds module
+1's outputs to module 2's data, `take_labels` routes labels to the stage
+that owns the loss.  Trained end-to-end with fit on synthetic blobs.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    n_class, dim, n = 10, 128, 2000
+    centers = rng.randn(n_class, dim).astype(np.float32) * 2.0
+    y = rng.randint(0, n_class, n)
+    X = (centers[y] + rng.randn(n, dim)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=100,
+                              shuffle=True, label_name="softmax_label")
+
+    # module 1: feature extractor (no labels)
+    data = mx.sym.var("data")
+    net1 = mx.sym.Activation(
+        mx.sym.FullyConnected(data, num_hidden=64, name="fc1"),
+        act_type="relu", name="relu1")
+    mod1 = mx.mod.Module(net1, label_names=[])
+
+    # module 2: classifier head (owns the loss)
+    feat = mx.sym.var("data")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(feat, num_hidden=n_class, name="fc2"),
+        name="softmax")
+    mod2 = mx.mod.Module(net2, label_names=["softmax_label"])
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+
+    seq.fit(train, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5 / 100},
+            initializer=mx.init.Xavier(), eval_metric="acc")
+    metric = mx.metric.Accuracy()
+    score = seq.score(mx.io.NDArrayIter(X, y.astype(np.float32),
+                                        batch_size=100,
+                                        label_name="softmax_label"), metric)
+    acc = dict(score)["accuracy"]
+    print("FINAL train accuracy: %.4f" % acc)
+    assert acc > 0.95, acc
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,7 +58,7 @@ def _sym_pad(attrs, op):
         raise MXNetError(
             "%s import requires symmetric pads, got %s (auto_pad-style "
             "asymmetric padding is not supported)" % (op, pads))
-    return tuple(pads[:2])
+    return tuple(pads[:half])
 
 
 def _conv(ins, attrs):
@@ -207,19 +207,27 @@ def _cast(ins, attrs):
     return sym_mod.Cast(ins[0], dtype=_ONNX_DTYPES.get(to, "float32"))
 
 
+_RAND_DTYPES = frozenset(("float32", "float16", "float64"))
+
+
 def _rand_dtype(attrs):
-    """ONNX Random* dtype attr -> framework dtype string."""
-    dt = int(attrs.get("dtype", 1))
-    if dt not in _ONNX_DTYPES:
-        raise MXNetError("Random* import: unsupported dtype enum %d" % dt)
-    return _ONNX_DTYPES[dt]
+    """ONNX Random* dtype attr -> framework dtype string (floats only —
+    the samplers cannot produce integer dtypes)."""
+    dt = _ONNX_DTYPES.get(int(attrs.get("dtype", 1)))
+    if dt not in _RAND_DTYPES:
+        raise MXNetError(
+            "Random* import: unsupported dtype enum %s (need a float)"
+            % attrs.get("dtype"))
+    return dt
 
 
-def _cast_if(sym, attrs):
-    """Apply the optional Random*Like dtype override via Cast."""
+def _rand_like_input(ins, attrs):
+    """The tensor whose SHAPE the *Like sampler copies: the dtype attr
+    overrides the input's dtype (ONNX spec), and sampling must happen in
+    a float dtype, so cast first when an override is present."""
     if "dtype" in attrs:
-        return sym_mod.Cast(sym, dtype=_rand_dtype(attrs))
-    return sym
+        return sym_mod.Cast(ins[0], dtype=_rand_dtype(attrs))
+    return ins[0]
 
 
 def _split(ins, attrs):
@@ -322,14 +330,12 @@ _CONVERT_MAP = {
         scale=float(attrs.get("scale", 1.0)),
         shape=tuple(int(s) for s in attrs["shape"]),
         dtype=_rand_dtype(attrs)),
-    "RandomUniformLike": lambda ins, attrs: _cast_if(
-        sym_mod.random_uniform_like(
-            ins[0], low=float(attrs.get("low", 0.0)),
-            high=float(attrs.get("high", 1.0))), attrs),
-    "RandomNormalLike": lambda ins, attrs: _cast_if(
-        sym_mod.random_normal_like(
-            ins[0], loc=float(attrs.get("mean", 0.0)),
-            scale=float(attrs.get("scale", 1.0))), attrs),
+    "RandomUniformLike": lambda ins, attrs: sym_mod.random_uniform_like(
+        _rand_like_input(ins, attrs), low=float(attrs.get("low", 0.0)),
+        high=float(attrs.get("high", 1.0))),
+    "RandomNormalLike": lambda ins, attrs: sym_mod.random_normal_like(
+        _rand_like_input(ins, attrs), loc=float(attrs.get("mean", 0.0)),
+        scale=float(attrs.get("scale", 1.0))),
     "Flatten": lambda ins, attrs: sym_mod.Flatten(ins[0]),
     "Reshape": _reshape,
     "Concat": lambda ins, attrs: sym_mod.concat(

@@ -419,3 +419,39 @@ def test_rnn_time_major():
                       done_marker="rnn-time-major done")
     m = re.search(r"TNC vs NTC max diff: ([0-9.e+-]+)", out)
     assert m and float(m.group(1)) < 1e-5, out[-1500:]
+
+
+def test_module_mnist_mlp_example():
+    out = run_example("module/mnist_mlp.py", "--epochs", "3",
+                      done_marker="DONE")
+    assert "FINAL train accuracy" in out and "DONE" in out
+
+
+def test_module_sequential_example():
+    out = run_example("module/sequential_module.py", "--epochs", "8",
+                      done_marker="DONE")
+    assert "FINAL train accuracy" in out and "DONE" in out
+
+
+def test_module_python_loss_example():
+    out = run_example("module/python_loss.py", "--epochs", "8",
+                      done_marker="DONE")
+    assert "FINAL train accuracy" in out and "DONE" in out
+
+
+def test_adversarial_vae_example():
+    out = run_example("mxnet_adversarial_vae/vaegan.py",
+                      "--epochs", "20", done_marker="DONE")
+    assert "latent linear separation" in out and "DONE" in out
+
+
+def test_chinese_text_cnn_example():
+    out = run_example("cnn_chinese_text_classification/text_cnn.py",
+                      "--epochs", "8", done_marker="DONE")
+    assert "FINAL train accuracy" in out and "DONE" in out
+
+
+def test_captcha_example():
+    out = run_example("captcha/captcha_cnn.py", "--epochs", "10",
+                      done_marker="DONE")
+    assert "whole-captcha acc" in out and "DONE" in out
