@@ -245,7 +245,8 @@ def _greedy_nms_keep(boxes, scores, valid, iou_thresh, same_class_ok=None):
     return keep
 
 
-@register("_contrib_box_iou")
+@register("_contrib_box_iou", params=[
+    P("format", ("corner", "center"), default="corner")])
 def _box_iou(lhs, rhs, format="corner", **attrs):
     """Pairwise IoU over the last axis of 4 (reference:
     bounding_box-inl.h box_iou).  Output shape lhs.shape[:-1] +

@@ -132,19 +132,23 @@ def _make_loss_core(data, grad_scale, norm_batch):
 
 
 def _make_loss_fwd(data, grad_scale, norm_batch):
-    return data, (data.shape, data.dtype)
+    # no residual: the cotangent itself carries the shape/dtype (a numpy
+    # dtype object in the residual pytree would break under jit)
+    return data, None
 
 
 def _make_loss_bwd(grad_scale, norm_batch, res, g):
-    shape, dtype = res
-    scale = grad_scale / (shape[0] if norm_batch else 1)
-    return (jnp.full(shape, scale, dtype=dtype),)
+    scale = grad_scale / (g.shape[0] if norm_batch else 1)
+    return (jnp.full(g.shape, scale, dtype=g.dtype),)
 
 
 _make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
 
 
-@register("MakeLoss", aliases=("make_loss",))
+@register("MakeLoss", aliases=("make_loss",), params=[
+    P("grad_scale", float, default=1.0),
+    P("valid_thresh", float, default=0.0),
+    P("normalization", ("null", "batch", "valid"), default="null")])
 def _make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null", **attrs):
     """Reference: src/operator/make_loss-inl.h."""
     return _make_loss_core(data, float(grad_scale), normalization == "batch")
@@ -183,7 +187,11 @@ def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
                      float(regularization_coefficient), bool(use_linear))
 
 
-@register("_contrib_ctc_loss", aliases=("ctc_loss", "CTCLoss", "_contrib_CTCLoss"))
+@register("_contrib_ctc_loss",
+          aliases=("ctc_loss", "CTCLoss", "_contrib_CTCLoss"), params=[
+    P("use_data_lengths", bool, default=False),
+    P("use_label_lengths", bool, default=False),
+    P("blank_label", ("first", "last"), default="first")])
 def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
               use_data_lengths=False, use_label_lengths=False,
               blank_label="first", **attrs):

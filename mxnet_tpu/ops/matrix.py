@@ -255,10 +255,24 @@ def _batch_take(a, indices, **attrs):
         a, indices.astype(jnp.int32)[:, None], axis=1).squeeze(1)
 
 
-@register("pick")
+@register("pick", params=[
+    P("axis", int, default=-1),
+    P("keepdims", bool, default=False),
+    P("mode", ("clip", "wrap"), default="clip")])
 def _pick(data, index, axis=-1, keepdims=False, mode="clip", **attrs):
-    idx = jnp.expand_dims(index.astype(jnp.int32), axis if axis is not None else -1)
-    out = jnp.take_along_axis(data, idx, axis=axis)
+    """Reference: broadcast_reduce_op_index.cc pick — out-of-range
+    indices clip or wrap (never NaN); axis=None picks w.r.t. the
+    flattened input."""
+    idx = index.astype(jnp.int32)
+    if axis is None:
+        flat = data.reshape(-1)
+        n = flat.shape[0]
+        idx = idx % n if mode == "wrap" else jnp.clip(idx, 0, n - 1)
+        out = jnp.take(flat, idx)
+        return out[..., None] if keepdims else out
+    dim = data.shape[axis]
+    idx = idx % dim if mode == "wrap" else jnp.clip(idx, 0, dim - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
     if not keepdims:
         out = jnp.squeeze(out, axis=axis)
     return out
@@ -400,7 +414,9 @@ def _linalg_sumlogdiag(A, **attrs):
     return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
 
 
-@register("L2Normalization")
+@register("L2Normalization", params=[
+    P("eps", float, default=1e-10, low=0.0),
+    P("mode", ("instance", "channel", "spatial"), default="instance")])
 def _l2_normalization(x, eps=1e-10, mode="instance", **attrs):
     """Reference: src/operator/l2_normalization-inl.h."""
     if mode == "instance":

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 
 
 @register("reshape_like")
@@ -240,7 +240,8 @@ def _sparse_retain_op(data, indices, **attrs):
     return retain_rows(data, indices)
 
 
-@register("cast_storage")
+@register("cast_storage", params=[
+    P("stype", ("default", "row_sparse", "csr"), default="default")])
 def _cast_storage_op(data, stype="default", **attrs):
     """Reference: src/operator/tensor/cast_storage-inl.h.  At the XLA
     value level all storage types share the dense backing, so the graph

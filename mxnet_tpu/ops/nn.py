@@ -127,7 +127,8 @@ def _log_softmax(data, axis=-1, temperature=None, **attrs):
     return jax.nn.log_softmax(data, axis=axis)
 
 
-@register("SoftmaxActivation")
+@register("SoftmaxActivation", params=[
+    P("mode", ("instance", "channel"), default="instance")])
 def _softmax_activation(data, mode="instance", **attrs):
     if mode == "channel":
         return jax.nn.softmax(data, axis=1)
@@ -350,7 +351,8 @@ def _bilinear_resize(data, height=None, width=None, scale_height=None,
 @register("UpSampling", params=[
     P("scale", int, required=True, low=1),
     P("sample_type", ("nearest", "bilinear"), default="nearest"),
-    P("num_filter", int, default=0, low=0)])
+    P("num_filter", int, default=0, low=0),
+    P("multi_input_mode", ("concat", "sum"), default="concat")])
 def _upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
                 num_args=1, multi_input_mode="concat", workspace=None, **attrs):
     """Reference: src/operator/upsampling-inl.h."""
@@ -595,7 +597,10 @@ def _rnn(data, params, state, state_cell=None, mode="lstm", state_size=None,
     return x
 
 
-@register("SpatialTransformer")
+@register("SpatialTransformer", params=[
+    P("transform_type", ("affine",), default="affine"),
+    P("sampler_type", ("bilinear",), default="bilinear"),
+    P("target_shape", tuple, required=True, low=1)])
 def _spatial_transformer(data, loc, target_shape=None, transform_type="affine",
                          sampler_type="bilinear", **attrs):
     """Reference: src/operator/spatial_transformer-inl.h."""
@@ -638,10 +643,17 @@ def _bilinear_sampler(data, grid, **attrs):
     return _bilinear_sample(data, grid)
 
 
-@register("GridGenerator")
+@register("GridGenerator", params=[
+    P("transform_type", ("affine", "warp"), default="affine"),
+    P("target_shape", tuple, default=None, low=1)])
 def _grid_generator(data, transform_type="affine", target_shape=None, **attrs):
-    th, tw = normalize_tuple(target_shape, 2)
     if transform_type == "affine":
+        # warp mode needs no target_shape (the flow field carries it)
+        if target_shape is None:
+            raise MXNetError(
+                "GridGenerator: target_shape is required when "
+                "transform_type='affine'")
+        th, tw = normalize_tuple(target_shape, 2)
         n = data.shape[0]
         theta = data.reshape(n, 2, 3)
         ys = jnp.linspace(-1, 1, th)

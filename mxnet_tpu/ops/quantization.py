@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 
 INT8_MAX = 127.0
 INT32_MAX = float(2 ** 31 - 1)
@@ -36,7 +36,9 @@ def _range_of(min_r, max_r):
     return jnp.maximum(jnp.abs(min_r), jnp.abs(max_r)).reshape(())
 
 
-@register("_contrib_quantize", num_outputs=3)
+@register("_contrib_quantize", num_outputs=3, params=[
+    P("out_type", ("int8",), default="int8",
+      doc="TPU quantization is symmetric int8")])
 def _quantize(data, min_range, max_range, out_type="int8", **attrs):
     """Quantize float data given min/max range tensors (reference:
     quantize-inl.h QuantizeCompute)."""
@@ -65,7 +67,8 @@ def _quantize_v2(data, min_calib_range=None, max_calib_range=None,
     return q.astype(jnp.int8), (-r).reshape(1), r.reshape(1)
 
 
-@register("_contrib_dequantize")
+@register("_contrib_dequantize", params=[
+    P("out_type", ("float32",), default="float32")])
 def _dequantize(data, min_range, max_range, out_type="float32", **attrs):
     """int8/int32 -> float (reference: dequantize-inl.h)."""
     r = _range_of(min_range, max_range)
@@ -118,7 +121,15 @@ def _quantized_fc(data, weight, data_min, data_max, weight_min, weight_max,
     return out, omin, omax
 
 
-@register("_contrib_quantized_conv", num_outputs=3)
+@register("_contrib_quantized_conv", num_outputs=3, params=[
+    P("kernel", tuple, default=(1, 1), low=1),
+    P("stride", tuple, default=(1, 1), low=1),
+    P("pad", tuple, default=(0, 0), low=0),
+    P("dilate", tuple, default=(1, 1), low=1),
+    P("num_filter", int, default=1, low=1),
+    P("num_group", int, default=1, low=1),
+    P("no_bias", bool, default=True),
+    P("layout", ("NCHW",), default="NCHW")])
 def _quantized_conv(data, weight, data_min, data_max, weight_min, weight_max,
                     kernel=(1, 1), stride=(1, 1), pad=(0, 0), dilate=(1, 1),
                     num_filter=1, num_group=1, no_bias=True, layout="NCHW",
@@ -140,7 +151,12 @@ def _quantized_conv(data, weight, data_min, data_max, weight_min, weight_max,
     return out, omin, omax
 
 
-@register("_contrib_quantized_pooling", num_outputs=3)
+@register("_contrib_quantized_pooling", num_outputs=3, params=[
+    P("kernel", tuple, default=(2, 2), low=1),
+    P("stride", tuple, default=None, low=1),
+    P("pad", tuple, default=(0, 0), low=0),
+    P("pool_type", ("max", "avg"), default="max"),
+    P("global_pool", bool, default=False)])
 def _quantized_pooling(data, data_min, data_max, kernel=(2, 2),
                        stride=None, pad=(0, 0), pool_type="max",
                        global_pool=False, **attrs):

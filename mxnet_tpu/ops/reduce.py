@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, normalize_tuple
+from .registry import register, Param as P, normalize_tuple
 
 
 def _norm_axis(axis, ndim, exclude=False):
@@ -82,10 +82,18 @@ def _topk_nout(attrs):
     return 2 if ret_typ == "both" else 1
 
 
-@register("topk", num_outputs=_topk_nout)
+@register("topk", num_outputs=_topk_nout, params=[
+    P("axis", int, default=-1),
+    P("k", int, default=1, low=1),
+    P("ret_typ", ("indices", "value", "mask", "both"), default="indices"),
+    P("is_ascend", bool, default=False)])
 def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **attrs):
-    """Reference: src/operator/tensor/ordering_op-inl.h TopK."""
-    axis = x.ndim - 1 if axis is None else axis % x.ndim
+    """Reference: src/operator/tensor/ordering_op-inl.h TopK.
+    axis=None ranks the FLATTENED array (reference semantics)."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    axis = axis % x.ndim
     xm = jnp.moveaxis(x, axis, -1)
     if is_ascend:
         vals, idx = lax.top_k(-xm, k)
