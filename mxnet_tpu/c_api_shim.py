@@ -1,0 +1,129 @@
+"""Python backing for the native core C API (native/c_api.cpp).
+
+Reference contract: ``include/mxnet/c_api.h`` — the 178-function FFI
+surface over the C++ engine.  Here the runtime IS Python/XLA, so the
+native library embeds CPython and calls these shims; each shim is one
+C-API function's semantics expressed over the real framework objects.
+Everything crossing the boundary is a plain bytes/str/int/list so the
+C side never touches framework internals.
+
+Handle model: the C library holds a ``PyObject*`` to whatever a shim
+returns (an NDArray, a Symbol); freeing a handle releases that
+reference.  dtype enums follow the reference
+(``include/mxnet/tensor_blob.h``: 0=f32 1=f64 2=f16 3=u8 4=i32 5=i8
+6=i64).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "version", "nd_create", "nd_shape", "nd_dtype_enum", "nd_from_bytes",
+    "nd_to_bytes", "nd_wait", "wait_all", "nd_save", "nd_load",
+    "list_op_names", "imperative_invoke", "sym_from_json", "sym_to_json",
+    "sym_list_arguments", "sym_list_outputs", "sym_list_aux",
+]
+
+_DTYPE_BY_ENUM = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64"}
+_ENUM_BY_DTYPE = {v: k for k, v in _DTYPE_BY_ENUM.items()}
+
+
+def version():
+    """MXGetVersion: reference-compatible version number (1.x line)."""
+    return 10600
+
+
+def nd_create(shape, dtype_enum):
+    """MXNDArrayCreateEx: a zero-initialized device array."""
+    from . import nd
+    dt = _DTYPE_BY_ENUM.get(int(dtype_enum))
+    if dt is None:
+        raise ValueError("unknown dtype enum %r" % (dtype_enum,))
+    return nd.zeros(tuple(int(s) for s in shape), dtype=dt)
+
+
+def nd_shape(arr):
+    return [int(s) for s in arr.shape]
+
+
+def nd_dtype_enum(arr):
+    return _ENUM_BY_DTYPE[str(np.dtype(arr.dtype))]
+
+
+def nd_from_bytes(arr, raw):
+    """MXNDArraySyncCopyFromCPU: rebind from a host buffer (the size was
+    validated C-side against shape x itemsize)."""
+    host = np.frombuffer(raw, dtype=np.dtype(arr.dtype)).reshape(arr.shape)
+    arr[:] = host
+    return None
+
+
+def nd_to_bytes(arr):
+    """MXNDArraySyncCopyToCPU: fetch the value as raw host bytes."""
+    return arr.asnumpy().tobytes()
+
+
+def nd_wait(arr):
+    arr.wait_to_read()
+    return None
+
+
+def wait_all():
+    from . import nd
+    nd.waitall()
+    return None
+
+
+def nd_save(fname, arrs, keys):
+    from . import nd
+    if keys:
+        nd.save(fname, dict(zip(keys, arrs)))
+    else:
+        nd.save(fname, list(arrs))
+    return None
+
+
+def nd_load(fname):
+    """Returns (list of arrays, list of keys — empty for list files)."""
+    from . import nd
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        ks = sorted(data)
+        return [data[k] for k in ks], list(ks)
+    return list(data), []
+
+
+def list_op_names():
+    from .ops.registry import list_ops
+    return list_ops()
+
+
+def imperative_invoke(op_name, inputs, keys, vals):
+    """MXImperativeInvoke: run a registered op on NDArray handles with
+    string-valued attrs (coerced exactly like symbol JSON attrs)."""
+    from .imperative import invoke
+    attrs = dict(zip([k for k in keys], [v for v in vals]))
+    out = invoke(op_name, list(inputs), attrs)
+    return out if isinstance(out, list) else [out]
+
+
+def sym_from_json(json_str):
+    from . import symbol as sym_mod
+    return sym_mod.load_json(json_str)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def sym_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def sym_list_aux(sym):
+    return list(sym.list_auxiliary_states())

@@ -20,35 +20,57 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "recordio_core.cpp")
 _SO = os.path.join(_HERE, "librecordio_core.so")
 
-_LOCK = threading.Lock()
-_LIB = None
-_TRIED = False
+
+class _LazyNativeLib:
+    """ONE lazy build-and-load scaffold for every native library here:
+    thread-safe single attempt, MXNET_NATIVE_DISABLE gate, mtime-based
+    rebuild into .tmp + atomic replace, and blanket-except to None so
+    callers fall back to their pure-Python paths."""
+
+    def __init__(self, src, so, extra_cmd=(), python_inc=False,
+                 dlopen_mode=None, declare=None):
+        self._src = src
+        self._so = so
+        self._extra = list(extra_cmd)
+        self._python_inc = python_inc
+        self._mode = dlopen_mode
+        self._declare = declare
+        self._lock = threading.Lock()
+        self._lib = None
+        self._tried = False
+
+    def get(self):
+        if self._lib is not None or self._tried:
+            return self._lib
+        with self._lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            try:
+                from .. import config as _config
+                if _config.get("MXNET_NATIVE_DISABLE"):
+                    return self._lib
+                if (not os.path.exists(self._so)
+                        or os.path.getmtime(self._so)
+                        < os.path.getmtime(self._src)):
+                    cmd = ["g++", "-O2", "-fPIC", "-shared", self._src,
+                           "-o", self._so + ".tmp"] + self._extra
+                    if self._python_inc:
+                        import sysconfig
+                        cmd.append("-I" + sysconfig.get_paths()["include"])
+                    subprocess.run(cmd, check=True, capture_output=True)
+                    os.replace(self._so + ".tmp", self._so)
+                lib = ctypes.CDLL(self._so) if self._mode is None \
+                    else ctypes.CDLL(self._so, mode=self._mode)
+                if self._declare is not None:
+                    self._declare(lib)
+                self._lib = lib
+            except Exception:
+                self._lib = None
+        return self._lib
 
 
-def _build():
-    cmd = ["g++", "-O2", "-fPIC", "-shared", _SRC, "-o", _SO + ".tmp",
-           "-ljpeg", "-pthread"]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
-
-
-def get_lib():
-    """The loaded native library, or None when unavailable."""
-    global _LIB, _TRIED
-    if _LIB is not None or _TRIED:
-        return _LIB
-    with _LOCK:
-        if _LIB is not None or _TRIED:
-            return _LIB
-        _TRIED = True
-        try:
-            from .. import config as _config
-            if _config.get("MXNET_NATIVE_DISABLE"):
-                return _LIB
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                _build()
-            lib = ctypes.CDLL(_SO)
+def _declare_recordio(lib):
             lib.rio_scan.restype = ctypes.c_long
             lib.rio_scan.argtypes = [
                 ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
@@ -69,10 +91,15 @@ def get_lib():
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_int), ctypes.c_int]
-            _LIB = lib
-        except Exception:
-            _LIB = None
-    return _LIB
+
+
+_RECORDIO = _LazyNativeLib(_SRC, _SO, extra_cmd=("-ljpeg", "-pthread"),
+                           declare=_declare_recordio)
+
+
+def get_lib():
+    """The loaded native data-pipeline library, or None when unavailable."""
+    return _RECORDIO.get()
 
 
 def scan_record_spans(path):
@@ -135,61 +162,98 @@ def decode_jpeg_batch(payloads, out_hw, resize_short=0, rand_crop=False,
 # -- c_predict_api (deployment C ABI) ---------------------------------------
 _PRED_SRC = os.path.join(_HERE, "c_predict_api.cpp")
 _PRED_SO = os.path.join(_HERE, "libmxnet_predict.so")
-_PRED_LOCK = threading.Lock()
-_PRED_LIB = None
-_PRED_TRIED = False
 
 
-def _build_predict_api():
-    import sysconfig
-    inc = sysconfig.get_paths()["include"]
-    cmd = ["g++", "-O2", "-fPIC", "-shared", _PRED_SRC,
-           "-I" + inc, "-o", _PRED_SO + ".tmp"]
-    # linking libpython is only needed for non-Python host programs;
-    # undefined CPython symbols resolve from the running interpreter
-    # when loaded via ctypes
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_PRED_SO + ".tmp", _PRED_SO)
+def _declare_predict(lib):
+    u = ctypes.c_uint
+    up = ctypes.POINTER(u)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXPredCreate.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, u, ctypes.POINTER(ctypes.c_char_p), up, up,
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXPredSetInput.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float), u]
+    lib.MXPredForward.argtypes = [ctypes.c_void_p]
+    lib.MXPredGetOutputShape.argtypes = [
+        ctypes.c_void_p, u, ctypes.POINTER(up), up]
+    lib.MXPredGetOutput.argtypes = [
+        ctypes.c_void_p, u, ctypes.POINTER(ctypes.c_float), u]
+    lib.MXPredFree.argtypes = [ctypes.c_void_p]
+
+
+# RTLD_GLOBAL: a non-Python host links this .so and resolves CPython
+# symbols from it
+_PREDICT = _LazyNativeLib(_PRED_SRC, _PRED_SO, python_inc=True,
+                          dlopen_mode=ctypes.RTLD_GLOBAL,
+                          declare=_declare_predict)
 
 
 def get_predict_lib():
     """The c_predict_api shared library (reference: c_predict_api.h ABI),
     built on demand; None when no toolchain is available."""
-    global _PRED_LIB, _PRED_TRIED
-    if _PRED_LIB is not None or _PRED_TRIED:
-        return _PRED_LIB
-    with _PRED_LOCK:
-        if _PRED_LIB is not None or _PRED_TRIED:
-            return _PRED_LIB
-        _PRED_TRIED = True
-        try:
-            from .. import config as _config
-            if _config.get("MXNET_NATIVE_DISABLE"):
-                return _PRED_LIB
-            if (not os.path.exists(_PRED_SO)
-                    or os.path.getmtime(_PRED_SO) < os.path.getmtime(_PRED_SRC)):
-                _build_predict_api()
-            lib = ctypes.CDLL(_PRED_SO, mode=ctypes.RTLD_GLOBAL)
-            u = ctypes.c_uint
-            up = ctypes.POINTER(u)
-            lib.MXGetLastError.restype = ctypes.c_char_p
-            lib.MXPredCreate.argtypes = [
-                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
-                ctypes.c_int, u, ctypes.POINTER(ctypes.c_char_p), up, up,
-                ctypes.POINTER(ctypes.c_void_p)]
-            lib.MXPredSetInput.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p,
-                ctypes.POINTER(ctypes.c_float), u]
-            lib.MXPredForward.argtypes = [ctypes.c_void_p]
-            lib.MXPredGetOutputShape.argtypes = [
-                ctypes.c_void_p, u, ctypes.POINTER(up), up]
-            lib.MXPredGetOutput.argtypes = [
-                ctypes.c_void_p, u, ctypes.POINTER(ctypes.c_float), u]
-            lib.MXPredFree.argtypes = [ctypes.c_void_p]
-            _PRED_LIB = lib
-        except Exception:
-            _PRED_LIB = None
-    return _PRED_LIB
+    return _PREDICT.get()
+
+
+# -- c_api (core framework C ABI) -------------------------------------------
+_CAPI_SRC = os.path.join(_HERE, "c_api.cpp")
+_CAPI_SO = os.path.join(_HERE, "libmxnet_capi.so")
+
+
+def _declare_c_api(lib):
+    u, up = ctypes.c_uint, ctypes.POINTER(ctypes.c_uint)
+    vp = ctypes.c_void_p
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    lib.MXGetVersion.argtypes = [ctypes.POINTER(ctypes.c_int)]
+    lib.MXNDArrayCreateEx.argtypes = [
+        up, u, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(vp)]
+    lib.MXNDArrayCreate.argtypes = [
+        up, u, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(vp)]
+    lib.MXNDArrayFree.argtypes = [vp]
+    lib.MXNDArrayGetShape.argtypes = [vp, up, ctypes.POINTER(up)]
+    lib.MXNDArrayGetDType.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXNDArraySyncCopyFromCPU.argtypes = [
+        vp, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArraySyncCopyToCPU.argtypes = [
+        vp, ctypes.c_void_p, ctypes.c_size_t]
+    lib.MXNDArrayWaitToRead.argtypes = [vp]
+    lib.MXNDArraySave.argtypes = [
+        ctypes.c_char_p, u, ctypes.POINTER(vp),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXNDArrayLoad.argtypes = [
+        ctypes.c_char_p, up, ctypes.POINTER(ctypes.POINTER(vp)),
+        up, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    lib.MXListAllOpNames.argtypes = [
+        up, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    lib.MXImperativeInvokeByName.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(vp),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(vp)), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXSymbolCreateFromJSON.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(vp)]
+    lib.MXSymbolSaveToJSON.argtypes = [
+        vp, ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXSymbolFree.argtypes = [vp]
+    for f in (lib.MXSymbolListArguments, lib.MXSymbolListOutputs,
+              lib.MXSymbolListAuxiliaryStates):
+        f.argtypes = [
+            vp, up, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+
+
+_CAPI = _LazyNativeLib(_CAPI_SRC, _CAPI_SO, python_inc=True,
+                       declare=_declare_c_api)
+
+
+def get_c_api_lib():
+    """The core c_api shared library (reference: c_api.h ABI subset —
+    NDArray / imperative invoke / Symbol JSON), built on demand; None
+    when no toolchain is available."""
+    return _CAPI.get()
 
 
 def transcode_jpeg_batch(payloads, resize_short, quality=95, nthreads=4):

@@ -1,0 +1,470 @@
+// c_api — native C ABI for the core framework surface.
+//
+// Reference contract: include/mxnet/c_api.h (the NDArray block at
+// :490-780, MXImperativeInvoke:150, the Symbol JSON block at :960-1100;
+// every call returns int, 0 = success, last error via MXGetLastError).
+// The reference backs this with the C++ engine; here the runtime IS
+// Python/XLA, so this library embeds CPython (exactly like
+// c_predict_api.cpp) and drives mxnet_tpu.c_api_shim — same ABI shape,
+// usable from any C/C++ host linked against libpython, or loaded into a
+// running interpreter via ctypes/cffi.
+//
+// Scope: the core subset FFI consumers actually exercise — NDArray
+// create/copy/shape/dtype/save/load/wait, imperative op invocation by
+// registered name (which reaches the ENTIRE op registry), and Symbol
+// JSON round-trips.  The remaining reference functions are executor /
+// KVStore / IO plumbing whose deployment story here is the Python API
+// or c_predict_api (SURVEY §2.13 scope note).
+//
+// Build (native/__init__.py get_c_api_lib):
+//   g++ -O2 -fPIC -shared c_api.cpp -o libmxnet_capi.so -I$(python-inc)
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void capture_py_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    PyEval_SaveThread();
+  }
+}
+
+class GIL {
+ public:
+  GIL() { ensure_python(); state_ = PyGILState_Ensure(); }
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* shim() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.c_api_shim");
+  }
+  return mod;
+}
+
+// Call a shim function with already-built args; returns new reference
+// or nullptr with the error captured.
+PyObject* shim_call(const char* fn, PyObject* args) {
+  PyObject* mod = shim();
+  if (mod == nullptr) {
+    capture_py_error();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    capture_py_error();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) capture_py_error();
+  return out;
+}
+
+// Every handle owns one Python object plus scratch buffers so the
+// pointers this ABI hands back stay valid until the handle is freed
+// (the reference keeps equivalent scratch on its NDArray/Symbol
+// structures).
+struct Handle {
+  PyObject* obj;
+  std::vector<uint32_t> shape;          // MXNDArrayGetShape scratch
+  std::string text;                     // MXSymbolSaveToJSON scratch
+  std::vector<std::string> strs;        // string-list scratch
+  std::vector<const char*> ptrs;
+};
+
+Handle* wrap(PyObject* obj) {
+  Handle* h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+int fill_str_list(Handle* h, PyObject* list, uint32_t* out_size,
+                  const char*** out_array) {
+  Py_ssize_t n = PyList_Size(list);
+  h->strs.clear();
+  h->strs.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(list, i));
+    if (c == nullptr) {
+      capture_py_error();
+      return -1;
+    }
+    h->strs.emplace_back(c);
+  }
+  h->ptrs.clear();
+  for (const std::string& s : h->strs) h->ptrs.push_back(s.c_str());
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = h->ptrs.data();
+  return 0;
+}
+
+// module-lifetime scratch for handle-less string lists (op names)
+thread_local std::vector<std::string> g_name_strs;
+thread_local std::vector<const char*> g_name_ptrs;
+
+}  // namespace
+
+extern "C" {
+
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXGetVersion(int* out) {
+  GIL gil;
+  PyObject* v = shim_call("version", PyTuple_New(0));
+  if (v == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(v));
+  Py_DECREF(v);
+  return 0;
+}
+
+// -- NDArray ---------------------------------------------------------------
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  (void)dev_type; (void)dev_id; (void)delay_alloc;  // XLA owns placement
+  GIL gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (uint32_t i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  }
+  PyObject* nd = shim_call("nd_create",
+                           Py_BuildValue("(Ni)", shp, dtype));
+  if (nd == nullptr) return -1;
+  *out = wrap(nd);
+  return 0;
+}
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           /*dtype=float32*/ 0, out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* shp = shim_call("nd_shape", Py_BuildValue("(O)", h->obj));
+  if (shp == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(shp);
+  h->shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->shape.push_back(static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyList_GetItem(shp, i))));
+  }
+  Py_DECREF(shp);
+  *out_dim = static_cast<uint32_t>(n);
+  *out_pdata = h->shape.data();
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* v = shim_call("nd_dtype_enum", Py_BuildValue("(O)", h->obj));
+  if (v == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(v));
+  Py_DECREF(v);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  // size is the ELEMENT count (reference c_api.h:545); scale by itemsize
+  PyObject* raw = nullptr;
+  {
+    int dt = 0;
+    if (MXNDArrayGetDType(handle, &dt) != 0) return -1;
+    static const size_t kItem[] = {4, 8, 2, 1, 4, 1, 8};
+    raw = PyBytes_FromStringAndSize(static_cast<const char*>(data),
+                                    size * kItem[dt]);
+  }
+  PyObject* r = shim_call("nd_from_bytes",
+                          Py_BuildValue("(ON)", h->obj, raw));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* raw = shim_call("nd_to_bytes", Py_BuildValue("(O)", h->obj));
+  if (raw == nullptr) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
+    capture_py_error();
+    Py_DECREF(raw);
+    return -1;
+  }
+  int dt = 0;
+  if (MXNDArrayGetDType(handle, &dt) != 0) {
+    Py_DECREF(raw);
+    return -1;
+  }
+  static const size_t kItem[] = {4, 8, 2, 1, 4, 1, 8};
+  size_t want = size * kItem[dt];
+  if (want > static_cast<size_t>(len)) {
+    set_error("SyncCopyToCPU: requested more elements than the array has");
+    Py_DECREF(raw);
+    return -1;
+  }
+  std::memcpy(data, buf, want);
+  Py_DECREF(raw);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("nd_wait", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  GIL gil;
+  PyObject* r = shim_call("wait_all", PyTuple_New(0));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySave(const char* fname, uint32_t num_args,
+                  NDArrayHandle* args, const char** keys) {
+  GIL gil;
+  PyObject* arrs = PyList_New(num_args);
+  PyObject* ks = PyList_New(keys == nullptr ? 0 : num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject* o = static_cast<Handle*>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(arrs, i, o);
+    if (keys != nullptr) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    }
+  }
+  PyObject* r = shim_call("nd_save",
+                          Py_BuildValue("(sNN)", fname, arrs, ks));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char* fname, uint32_t* out_size,
+                  NDArrayHandle** out_arr, uint32_t* out_name_size,
+                  const char*** out_names) {
+  GIL gil;
+  PyObject* pair = shim_call("nd_load", Py_BuildValue("(s)", fname));
+  if (pair == nullptr) return -1;
+  PyObject* arrs = PyTuple_GetItem(pair, 0);
+  PyObject* names = PyTuple_GetItem(pair, 1);
+  Py_ssize_t n = PyList_Size(arrs);
+  // the returned handle array + name pointers live until the next load
+  // on this thread (reference keeps them in a per-call ret store)
+  static thread_local std::vector<NDArrayHandle> handles;
+  handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(arrs, i);
+    Py_INCREF(o);
+    handles.push_back(wrap(o));
+  }
+  g_name_strs.clear();
+  g_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+    if (c == nullptr) {
+      capture_py_error();
+      Py_DECREF(pair);
+      return -1;
+    }
+    g_name_strs.emplace_back(c);
+  }
+  for (const std::string& s : g_name_strs) {
+    g_name_ptrs.push_back(s.c_str());
+  }
+  Py_DECREF(pair);
+  *out_size = static_cast<uint32_t>(n);
+  *out_arr = handles.data();
+  *out_name_size = static_cast<uint32_t>(g_name_strs.size());
+  *out_names = g_name_ptrs.data();
+  return 0;
+}
+
+// -- op registry / imperative invoke ---------------------------------------
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  GIL gil;
+  PyObject* names = shim_call("list_op_names", PyTuple_New(0));
+  if (names == nullptr) return -1;
+  g_name_strs.clear();
+  g_name_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+    if (c == nullptr) {
+      capture_py_error();
+      Py_DECREF(names);
+      return -1;
+    }
+    g_name_strs.emplace_back(c);
+  }
+  Py_DECREF(names);
+  for (const std::string& s : g_name_strs) {
+    g_name_ptrs.push_back(s.c_str());
+  }
+  *out_size = static_cast<uint32_t>(g_name_strs.size());
+  *out_array = g_name_ptrs.data();
+  return 0;
+}
+
+// Name-addressed variant of the reference's creator-handle invoke
+// (c_api.h MXImperativeInvoke:150): ops are addressed by registered
+// name — the registry lookup the creator handle stood for.
+int MXImperativeInvokeByName(const char* op_name, int num_inputs,
+                             NDArrayHandle* inputs, int* num_outputs,
+                             NDArrayHandle** outputs, int num_params,
+                             const char** param_keys,
+                             const char** param_vals) {
+  GIL gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* o = static_cast<Handle*>(inputs[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(ins, i, o);
+  }
+  PyObject* ks = PyList_New(num_params);
+  PyObject* vs = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject* outs = shim_call(
+      "imperative_invoke", Py_BuildValue("(sNNN)", op_name, ins, ks, vs));
+  if (outs == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(outs);
+  static thread_local std::vector<NDArrayHandle> ret;
+  ret.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(outs, i);
+    Py_INCREF(o);
+    ret.push_back(wrap(o));
+  }
+  Py_DECREF(outs);
+  *num_outputs = static_cast<int>(n);
+  *outputs = ret.data();
+  return 0;
+}
+
+// -- Symbol ----------------------------------------------------------------
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  GIL gil;
+  PyObject* s = shim_call("sym_from_json", Py_BuildValue("(s)", json));
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* s = shim_call("sym_to_json", Py_BuildValue("(O)", h->obj));
+  if (s == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(s);
+  if (c == nullptr) {
+    capture_py_error();
+    Py_DECREF(s);
+    return -1;
+  }
+  h->text = c;
+  Py_DECREF(s);
+  *out_json = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) { return MXNDArrayFree(handle); }
+
+int MXSymbolListArguments(SymbolHandle handle, uint32_t* out_size,
+                          const char*** out_array) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* l = shim_call("sym_list_arguments",
+                          Py_BuildValue("(O)", h->obj));
+  if (l == nullptr) return -1;
+  int rc = fill_str_list(h, l, out_size, out_array);
+  Py_DECREF(l);
+  return rc;
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, uint32_t* out_size,
+                        const char*** out_array) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* l = shim_call("sym_list_outputs", Py_BuildValue("(O)", h->obj));
+  if (l == nullptr) return -1;
+  int rc = fill_str_list(h, l, out_size, out_array);
+  Py_DECREF(l);
+  return rc;
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, uint32_t* out_size,
+                                const char*** out_array) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* l = shim_call("sym_list_aux", Py_BuildValue("(O)", h->obj));
+  if (l == nullptr) return -1;
+  int rc = fill_str_list(h, l, out_size, out_array);
+  Py_DECREF(l);
+  return rc;
+}
+
+}  // extern "C"

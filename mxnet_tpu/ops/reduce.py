@@ -107,9 +107,10 @@ def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", 
     if ret_typ == "both":
         return vals, idx
     if ret_typ == "mask":
-        mask = jnp.zeros(xm.shape, dtype=x.dtype)
+        # scatter in the moved frame (xm / top_k idx are both there),
+        # then move the ranked axis back
         mask = jnp.put_along_axis(
-            jnp.moveaxis(mask, axis, -1),
+            jnp.zeros(xm.shape, dtype=x.dtype),
             jnp.moveaxis(idx.astype(jnp.int32), axis, -1), 1.0, axis=-1,
             inplace=False)
         return jnp.moveaxis(mask, -1, axis)

@@ -1,0 +1,159 @@
+"""Core C API (native/c_api.cpp) exercised through ctypes.
+
+Reference analogue: the `tests/cpp/` C-API cases and every FFI binding
+in the reference tree (c_api.h NDArray block, MXImperativeInvoke,
+Symbol JSON block).  The library embeds CPython, so loading it into
+this process reuses the running interpreter.
+"""
+import ctypes
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.native import get_c_api_lib
+
+
+@pytest.fixture(scope="module")
+def lib():
+    l = get_c_api_lib()
+    if l is None:
+        pytest.skip("native toolchain unavailable")
+    return l
+
+
+def _check(rc, lib):
+    assert rc == 0, lib.MXGetLastError().decode()
+
+
+def test_version_and_op_names(lib):
+    v = ctypes.c_int()
+    _check(lib.MXGetVersion(ctypes.byref(v)), lib)
+    assert v.value >= 10000
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)), lib)
+    names = {arr[i].decode() for i in range(n.value)}
+    assert n.value >= 250
+    assert {"FullyConnected", "Convolution", "softmax"} <= names
+
+
+def _nd_create(lib, shape, dtype=0):
+    cshape = (ctypes.c_uint * len(shape))(*shape)
+    h = ctypes.c_void_p()
+    _check(lib.MXNDArrayCreateEx(cshape, len(shape), 1, 0, 0, dtype,
+                                 ctypes.byref(h)), lib)
+    return h
+
+
+def _nd_from_np(lib, a):
+    h = _nd_create(lib, a.shape, dtype=0)
+    buf = np.ascontiguousarray(a, dtype=np.float32)
+    _check(lib.MXNDArraySyncCopyFromCPU(
+        h, buf.ctypes.data_as(ctypes.c_void_p), buf.size), lib)
+    return h
+
+
+def _nd_to_np(lib, h):
+    dim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(lib.MXNDArrayGetShape(h, ctypes.byref(dim),
+                                 ctypes.byref(pdata)), lib)
+    shape = tuple(pdata[i] for i in range(dim.value))
+    out = np.empty(shape, np.float32)
+    _check(lib.MXNDArraySyncCopyToCPU(
+        h, out.ctypes.data_as(ctypes.c_void_p), out.size), lib)
+    return out
+
+
+def test_ndarray_roundtrip_and_dtype(lib):
+    a = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    h = _nd_from_np(lib, a)
+    dt = ctypes.c_int()
+    _check(lib.MXNDArrayGetDType(h, ctypes.byref(dt)), lib)
+    assert dt.value == 0  # float32
+    _check(lib.MXNDArrayWaitToRead(h), lib)
+    got = _nd_to_np(lib, h)
+    assert np.allclose(got, a)
+    _check(lib.MXNDArrayFree(h), lib)
+
+
+def test_imperative_invoke_fully_connected(lib):
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3).astype(np.float32)
+    w = rng.rand(4, 3).astype(np.float32)
+    b = rng.rand(4).astype(np.float32)
+    hs = (ctypes.c_void_p * 3)(_nd_from_np(lib, x).value,
+                               _nd_from_np(lib, w).value,
+                               _nd_from_np(lib, b).value)
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"4")
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXImperativeInvokeByName(
+        b"FullyConnected", 3, hs, ctypes.byref(n_out), ctypes.byref(outs),
+        1, keys, vals), lib)
+    assert n_out.value == 1
+    got = _nd_to_np(lib, ctypes.c_void_p(outs[0]))
+    assert np.allclose(got, x @ w.T + b, atol=1e-5)
+    # typed-param rejection crosses the ABI as a clean error
+    bad = (ctypes.c_char_p * 1)(b"no_bais")
+    badv = (ctypes.c_char_p * 1)(b"1")
+    rc = lib.MXImperativeInvokeByName(
+        b"FullyConnected", 3, hs, ctypes.byref(n_out), ctypes.byref(outs),
+        1, bad, badv)
+    assert rc != 0
+    assert b"no_bias" in lib.MXGetLastError()
+
+
+def test_symbol_json_roundtrip(lib):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                name="fc")
+    js = sym.tojson().encode()
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(js, ctypes.byref(h)), lib)
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXSymbolListArguments(h, ctypes.byref(n),
+                                     ctypes.byref(arr)), lib)
+    args = [arr[i].decode() for i in range(n.value)]
+    assert args == ["data", "fc_weight", "fc_bias"]
+    _check(lib.MXSymbolListOutputs(h, ctypes.byref(n),
+                                   ctypes.byref(arr)), lib)
+    assert [arr[i].decode() for i in range(n.value)] == ["fc_output"]
+    out_json = ctypes.c_char_p()
+    _check(lib.MXSymbolSaveToJSON(h, ctypes.byref(out_json)), lib)
+    # round-trip: the re-serialized graph reloads identically in Python
+    sym2 = mx.sym.load_json(out_json.value.decode())
+    assert sym2.list_arguments() == args
+    _check(lib.MXSymbolFree(h), lib)
+
+
+def test_ndarray_save_load(lib, tmp_path):
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    h = _nd_from_np(lib, a)
+    fname = str(tmp_path / "weights.nd").encode()
+    keys = (ctypes.c_char_p * 1)(b"w0")
+    hs = (ctypes.c_void_p * 1)(h.value)
+    _check(lib.MXNDArraySave(fname, 1, hs, keys), lib)
+    out_n = ctypes.c_uint()
+    out_arr = ctypes.POINTER(ctypes.c_void_p)()
+    name_n = ctypes.c_uint()
+    name_arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXNDArrayLoad(fname, ctypes.byref(out_n),
+                             ctypes.byref(out_arr), ctypes.byref(name_n),
+                             ctypes.byref(name_arr)), lib)
+    assert out_n.value == 1 and name_n.value == 1
+    assert name_arr[0] == b"w0"
+    got = _nd_to_np(lib, ctypes.c_void_p(out_arr[0]))
+    assert np.allclose(got, a)
+
+
+def test_error_path_names_the_problem(lib):
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    rc = lib.MXImperativeInvokeByName(
+        b"NoSuchOperator", 0, None, ctypes.byref(n_out),
+        ctypes.byref(outs), 0, None, None)
+    assert rc != 0
+    assert b"NoSuchOperator" in lib.MXGetLastError()
