@@ -103,8 +103,7 @@ def imperative_invoke(op_name, inputs, keys, vals):
     """MXImperativeInvoke: run a registered op on NDArray handles with
     string-valued attrs (coerced exactly like symbol JSON attrs)."""
     from .imperative import invoke
-    attrs = dict(zip([k for k in keys], [v for v in vals]))
-    out = invoke(op_name, list(inputs), attrs)
+    out = invoke(op_name, list(inputs), dict(zip(keys, vals)))
     return out if isinstance(out, list) else [out]
 
 
