@@ -48,7 +48,12 @@ def _fail(reason, code):
 
 
 def _measure(num_batches, disp_batches, timeout_s, extra_env=None):
-    """One bounded training run; returns (median img/s, error or None)."""
+    """One bounded training run.
+
+    Returns (median img/s, None) on success, else (None, (message, rc))
+    — rc 3 for crash/timeout, rc 5 for "ran but no Speedometer output"
+    (distinct codes the harness diagnostics key on).
+    """
     script = os.path.join(HERE, "example", "image-classification",
                           "train_imagenet.py")
     cmd = [sys.executable, "-u", script,
@@ -72,10 +77,10 @@ def _measure(num_batches, disp_batches, timeout_s, extra_env=None):
         how = ("exceeded %ds wall clock (killed)" % timeout_s
                if rc is None else "exited rc=%s" % rc)
         return None, ("train_imagenet.py %s with %d/%d Speedometer "
-                      "readings" % (how, len(speeds), expected))
+                      "readings" % (how, len(speeds), expected), 3)
     if not speeds:
         sys.stderr.write(text[-4000:])
-        return None, "no Speedometer output parsed"
+        return None, ("no Speedometer output parsed", 5)
     steady = sorted(speeds[1:] if len(speeds) > 1 else speeds)
     return steady[len(steady) // 2], None
 
@@ -87,7 +92,7 @@ def main():
 
     img_s, err = _measure(210, 20, HARD_TIMEOUT_S)
     if err is not None:
-        _fail(err, 3)
+        _fail(err[0], err[1])
     # the ONE stdout JSON line goes out IMMEDIATELY: nothing that runs
     # after this (layout experiments, a wedged interpreter exit) can
     # void a successful primary measurement
@@ -98,8 +103,8 @@ def main():
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }))
     sys.stdout.flush()
-    # secondary: the NHWC layout A/B (docs/faq/perf.md experiment) rides
-    # the same alive-relay window, recorded to a side file so stdout
+    # secondary: the layout/MFU experiment legs (docs/faq/perf.md) ride
+    # the same alive-relay window, recorded to side files so stdout
     # stays one line
     if os.environ.get("MXNET_BENCH_SKIP_NHWC") != "1":
         nhwc, nhwc_err = _measure(
@@ -109,9 +114,22 @@ def main():
             ab["nhwc_img_per_sec"] = round(nhwc, 2)
             ab["nhwc_vs_nchw"] = round(nhwc / img_s, 3)
         else:
-            ab["nhwc_error"] = nhwc_err
+            ab["nhwc_error"] = nhwc_err[0]
         with open(os.path.join(HERE, "BENCH_NHWC.json"), "w") as f:
             json.dump(ab, f)
+    if os.environ.get("MXNET_BENCH_SKIP_RIDERS") != "1":
+        riders = {"baseline_img_per_sec": round(img_s, 2)}
+        for name, env in (
+                ("stem_s2d", {"MXNET_STEM_SPACE_TO_DEPTH": "1"}),
+                ("unfused_metric", {"MXNET_FUSED_METRIC": "0"})):
+            v, v_err = _measure(110, 20, 600, extra_env=env)
+            if v is not None:
+                riders[name + "_img_per_sec"] = round(v, 2)
+                riders[name + "_vs_baseline"] = round(v / img_s, 3)
+            else:
+                riders[name + "_error"] = v_err[0]
+        with open(os.path.join(HERE, "BENCH_RIDERS.json"), "w") as f:
+            json.dump(riders, f)
 
 
 if __name__ == "__main__":

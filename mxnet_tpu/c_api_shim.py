@@ -22,6 +22,14 @@ __all__ = [
     "nd_to_bytes", "nd_wait", "wait_all", "nd_save", "nd_load",
     "list_op_names", "imperative_invoke", "sym_from_json", "sym_to_json",
     "sym_list_arguments", "sym_list_outputs", "sym_list_aux",
+    "nd_slice", "nd_at", "nd_reshape", "nd_context", "random_seed",
+    "sym_copy", "sym_name", "sym_internals", "sym_get_output",
+    "creator_info", "create_atomic_symbol", "sym_compose", "sym_var",
+    "exec_simple_bind", "exec_arg_arrays", "exec_grad_arrays",
+    "exec_aux_arrays", "exec_forward", "exec_backward", "exec_outputs",
+    "kv_create", "kv_init", "kv_push", "kv_pull", "kv_rank_size",
+    "list_data_iters", "data_iter_info", "data_iter_create",
+    "iter_before_first", "iter_next", "iter_data", "iter_label",
 ]
 
 _DTYPE_BY_ENUM = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
@@ -30,8 +38,9 @@ _ENUM_BY_DTYPE = {v: k for k, v in _DTYPE_BY_ENUM.items()}
 
 
 def version():
-    """MXGetVersion: reference-compatible version number (1.x line)."""
-    return 10600
+    """MXGetVersion: reference version contract 1.2.0 -> 10200
+    (reference python/mxnet/libinfo.py:76)."""
+    return 10200
 
 
 def nd_create(shape, dtype_enum):
@@ -85,12 +94,13 @@ def nd_save(fname, arrs, keys):
 
 
 def nd_load(fname):
-    """Returns (list of arrays, list of keys — empty for list files)."""
+    """Returns (list of arrays, list of keys — empty for list files).
+    Save order is preserved (the reference C API hands arrays back in
+    file order; dict insertion order carries it here)."""
     from . import nd
     data = nd.load(fname)
     if isinstance(data, dict):
-        ks = sorted(data)
-        return [data[k] for k in ks], list(ks)
+        return list(data.values()), list(data)
     return list(data), []
 
 
@@ -126,3 +136,251 @@ def sym_list_outputs(sym):
 
 def sym_list_aux(sym):
     return list(sym.list_auxiliary_states())
+
+
+# -- NDArray views / misc (MXNDArraySlice/At/Reshape, MXRandomSeed) ---------
+
+def nd_slice(arr, start, stop):
+    """MXNDArraySlice: first-axis range view (write-through like the
+    reference's shared-chunk slice)."""
+    return arr[int(start):int(stop)]
+
+
+def nd_at(arr, idx):
+    return arr[int(idx)]
+
+
+def nd_reshape(arr, dims):
+    return arr.reshape(tuple(int(d) for d in dims))
+
+
+def nd_context(arr):
+    """MXNDArrayGetContext: (dev_type, dev_id).  Placement is XLA's —
+    report the single logical device (dev_type 1 = the reference's cpu
+    slot, reused as 'default device' here)."""
+    return [1, 0]
+
+
+def random_seed(seed):
+    from . import random as mxrandom
+    mxrandom.seed(int(seed))
+    return None
+
+
+def sym_copy(sym):
+    return sym.__copy__()
+
+
+def sym_name(sym):
+    return sym.name or ""
+
+
+def sym_internals(sym):
+    return sym.get_internals()
+
+
+def sym_get_output(sym, index):
+    return sym[int(index)]
+
+
+# -- creator enumeration (MXSymbolListAtomicSymbolCreators block) -----------
+# Reference: c_api_symbolic.cc enumerates registered op creators with
+# per-creator name/docs (what python/mxnet/base.py-style ctypes codegen
+# binds against).  A creator handle here is the canonical op NAME; the
+# native side wraps it in a Handle like any other object.
+
+def creator_info(op_name):
+    """MXSymbolGetAtomicSymbolInfo: (name, description, arg_names,
+    arg_type_infos, arg_descriptions, key_var_num_args, return_type)."""
+    from .ops.registry import get_op
+    op = get_op(op_name)
+    names, types, descs = [], [], []
+    for p in op.params.values():
+        names.append(p.name)
+        head = p.describe().split("\n")[0]
+        types.append(head.split(" : ", 1)[1] if " : " in head else "any")
+        descs.append(p.doc or "")
+    kv = "num_args" if op.sig.variadic else ""
+    return (op.name, op.doc or "", names, types, descs, kv, "NDArray-or-Symbol")
+
+
+def create_atomic_symbol(op_name, keys, vals):
+    """MXSymbolCreateAtomicSymbol: an op node with attrs and auto-created
+    variable placeholders for every input (compose replaces them)."""
+    from .symbol import _make_symbol_call
+    from .ops.registry import coerce_attrs
+    return _make_symbol_call(op_name, [], coerce_attrs(dict(zip(keys, vals))))
+
+
+def sym_compose(sym, name, keys, arg_syms):
+    """MXSymbolCompose: wire input symbols into the node's free
+    variables (positional, or by input name via keys) and apply the
+    caller's node name — renaming the auto-created param placeholders so
+    ``fc1`` owns ``fc1_weight``/``fc1_bias``, the codegen contract."""
+    node = sym._heads[0][0]
+    old = node.name
+    if name:
+        node.name = name
+        for inp, _ in node.inputs:
+            if inp.is_variable and inp.name.startswith(old + "_"):
+                inp.name = name + inp.name[len(old):]
+    if keys:
+        # compose keys are INPUT names ("data", "weight"); the node's
+        # free placeholders are named "<node>_<input>" — translate
+        free = {inp.name for inp, _ in node.inputs if inp.is_variable}
+        kw = {}
+        for k, s in zip(keys, arg_syms):
+            slot = "%s_%s" % (node.name, k)
+            kw[slot if slot in free else k] = s
+        sym._compose(**kw)
+    else:
+        sym._compose(*arg_syms)
+    return None
+
+
+def sym_var(name):
+    from .symbol import var
+    return var(name)
+
+
+# -- executor (MXExecutorSimpleBind/Forward/Backward/Outputs block) ---------
+# Reference: src/c_api/c_api_executor.cc:47,54,132,220.  The handle wraps
+# the real Executor; in_args/arg_grads/aux are the executor's own
+# NDArrays, so MXNDArraySyncCopyFromCPU into an in_arg feeds the next
+# Forward exactly like the reference's shared-memory binding.
+
+def exec_simple_bind(sym, grad_req, shape_keys, shape_flat, shape_ndims):
+    shapes, off = {}, 0
+    for k, nd_ in zip(shape_keys, shape_ndims):
+        shapes[k] = tuple(int(v) for v in shape_flat[off:off + nd_])
+        off += nd_
+    return sym.simple_bind(grad_req=grad_req, **shapes)
+
+
+def exec_arg_arrays(exe):
+    return [exe.arg_dict[n] for n in exe.arg_names]
+
+
+def exec_grad_arrays(exe):
+    """Aligned with arg order; None for grad_req='null' args (the
+    reference returns NULL handles there)."""
+    return [exe.grad_dict.get(n) for n in exe.arg_names]
+
+
+def exec_aux_arrays(exe):
+    return [exe.aux_dict[n] for n in exe.aux_names]
+
+
+def exec_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+    return None
+
+
+def exec_backward(exe, head_grads):
+    exe.backward(list(head_grads) if head_grads else None)
+    return None
+
+
+def exec_outputs(exe):
+    return list(exe.outputs)
+
+
+# -- KVStore (MXKVStoreCreate/Init/Push/Pull block) -------------------------
+# Reference: src/c_api/c_api.cc MXKVStore* over include/mxnet/kvstore.h.
+# String-keyed variants (the Ex family) — integer keys stringify.
+
+def kv_create(ktype):
+    from . import kvstore
+    return kvstore.create(ktype)
+
+
+def kv_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+    return None
+
+
+def kv_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+    return None
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return None
+
+
+def kv_rank_size(kv):
+    return [int(kv.rank), int(kv.num_workers)]
+
+
+# -- Data iterators (MXListDataIters/MXDataIterCreateIter block) ------------
+# Reference: src/c_api/c_api.cc MXDataIter* enumerating IO creators.
+# An iter creator handle is the iterator's registered NAME.
+
+_ITER_REGISTRY = {
+    "MNISTIter": ("mxnet_tpu.io", "MNISTIter"),
+    "ImageRecordIter": ("mxnet_tpu.io", "ImageRecordIter"),
+    "CSVIter": ("mxnet_tpu.io", "CSVIter"),
+    "LibSVMIter": ("mxnet_tpu.io", "LibSVMIter"),
+    "NDArrayIter": ("mxnet_tpu.io", "NDArrayIter"),
+}
+
+
+def list_data_iters():
+    return sorted(_ITER_REGISTRY)
+
+
+def data_iter_info(name):
+    import importlib
+    mod, cls = _ITER_REGISTRY[name]
+    c = getattr(importlib.import_module(mod), cls)
+    return (name, (c.__doc__ or "").strip())
+
+
+def data_iter_create(name, keys, vals):
+    """MXDataIterCreateIter: build from string kwargs (coerced like
+    symbol attrs: '(2,2)' -> tuple, '12' -> int...)."""
+    import importlib
+
+    from .ops.registry import coerce_attrs
+    mod, cls = _ITER_REGISTRY[name]
+    kwargs = coerce_attrs(dict(zip(keys, vals)))
+    return getattr(importlib.import_module(mod), cls)(**kwargs)
+
+
+def iter_before_first(it):
+    it.reset()
+    it._c_api_batch = None
+    return None
+
+
+def iter_next(it):
+    """MXDataIterNext: advance and HOLD the batch (the reference C
+    iterator stores the current batch; GetData/GetLabel read it).
+    Driving through ``next()`` works for every DataIter subclass —
+    ``getdata``/``getlabel`` are optional in this framework's iterator
+    contract (several iterators only implement ``next()``)."""
+    try:
+        it._c_api_batch = it.next()
+        return 1
+    except StopIteration:
+        it._c_api_batch = None
+        return 0
+
+
+def _held_batch(it):
+    batch = getattr(it, "_c_api_batch", None)
+    if batch is None:
+        raise ValueError(
+            "MXDataIterGetData/GetLabel before a successful MXDataIterNext")
+    return batch
+
+
+def iter_data(it):
+    d = _held_batch(it).data
+    return d[0] if isinstance(d, list) else d
+
+
+def iter_label(it):
+    lab = _held_batch(it).label
+    return lab[0] if isinstance(lab, list) else lab

@@ -97,6 +97,13 @@ register_env("MXNET_KVSTORE_HEARTBEAT_DIR", str, None,
 register_env("MXNET_CONV_LAYOUT", str, None,
              "set to NHWC to run 2-D conv/pool internally channel-last "
              "(layout experiment; XLA folds the boundary transposes)")
+register_env("MXNET_FUSED_METRIC", str, None,
+             "set to 0 to disable the one-dispatch jitted Accuracy "
+             "accumulate (falls back to per-op device calls)")
+register_env("MXNET_STEM_SPACE_TO_DEPTH", str, None,
+             "set to 1 to rewrite 7x7/s2/p3 few-channel stem convs as "
+             "space-to-depth + 4x4/s1 conv (MXU-fill experiment, "
+             "docs/faq/perf.md)")
 register_env("MXNET_KVSTORE_ASYNC_DIR", str, None,
              "shared spool directory for the dist_async parameter "
              "server (coordinator applies pushes on arrival)")

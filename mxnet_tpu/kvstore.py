@@ -678,64 +678,146 @@ class KVStoreDistAsync(KVStore):
                 time.sleep(0.01)  # mid-replace; retry
         raise MXNetError("dist_async: cannot read weight %r" % (k,))
 
-    def _spool_backpressure(self, headroom=1):
-        """Block while the spool is at capacity, so bounded staleness is
-        actually bounded: workers outrunning the server thread (or a
-        slow shared filesystem) cannot grow the spool without limit.
-        The bound is cap + (num_workers - 1): the capacity check and the
-        spool write are not one atomic step, so each concurrent worker
-        can land one extra file past a just-full spool.  Raises after
-        MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT — a spool pinned at
-        capacity that long means the server thread is dead, not merely
-        behind.
+    # a live holder only performs <= cap cheap renames; a lock older
+    # than this means its holder died mid-publish
+    _LOCK_STALE_S = 30
 
-        Returns how many files may be spooled before the next scan is
-        needed (``headroom`` asks for more than one — push() uses this
-        to pay ONE directory scan per call, not per key)."""
+    def _spool_lock(self, deadline):
+        """O_CREAT|O_EXCL lockfile serializing scan+publish across
+        workers on the shared spool directory.  Returns a context
+        manager; raises MXNetError past ``deadline``.
+
+        Crash-safety protocol: the holder writes a unique identity into
+        the lockfile.  A breaker claims a stale lock (age >
+        ``_LOCK_STALE_S``) by atomically RENAMING it to a private name
+        — only one breaker can win the rename, and a concurrently
+        re-created fresh lock is untouched.  Release unlinks only if
+        the lockfile still carries the holder's own identity, so a
+        broken-then-recreated lock is never deleted out from under its
+        new owner."""
+        import contextlib
+        import time
+
+        lock_path = os.path.join(self._push_dir, ".spool.lock")
+        ident = "%s:%d:%f" % (os.uname().nodename, os.getpid(),
+                              time.time())
+
+        @contextlib.contextmanager
+        def _held():
+            while True:
+                try:
+                    fd = os.open(lock_path,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    os.write(fd, ident.encode())
+                    os.close(fd)
+                    break
+                except FileExistsError:
+                    try:
+                        age = time.time() - os.path.getmtime(lock_path)
+                    except OSError:
+                        continue  # released between probes: retry now
+                    if age > self._LOCK_STALE_S:
+                        grave = lock_path + ".broken.%d" % os.getpid()
+                        try:
+                            os.replace(lock_path, grave)  # atomic claim
+                            os.unlink(grave)
+                        except OSError:
+                            pass  # another breaker won the rename
+                        continue
+                    if time.time() > deadline:
+                        raise MXNetError(
+                            "dist_async: spool lock held past the "
+                            "backpressure timeout")
+                    time.sleep(0.002)
+            try:
+                yield
+            finally:
+                try:
+                    with open(lock_path) as f:
+                        still_ours = f.read() == ident
+                except OSError:
+                    still_ours = False  # broken while held
+                if still_ours:
+                    try:
+                        os.unlink(lock_path)
+                    except OSError:  # pragma: no cover - raced release
+                        pass
+
+        return _held()
+
+    def _spool_admit(self, pairs):
+        """Publish spooled temp files under the capacity cap — EXACTLY.
+
+        The capacity scan and the publishing renames happen under one
+        spool lockfile, so concurrent workers cannot overshoot: pending
+        never exceeds MXNET_KVSTORE_ASYNC_MAX_PENDING (the r4 bound was
+        cap + workers - 1 from the unlocked check-then-write; reference
+        analogue: the request queue bound in
+        src/kvstore/kvstore_dist_server.h:261).  Blocks while full;
+        raises after MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT — a spool
+        pinned at capacity that long means the server thread is dead,
+        not merely behind."""
         import time
 
         from . import config as _config
         cap = _config.get("MXNET_KVSTORE_ASYNC_MAX_PENDING")
         if not cap or cap <= 0:
-            return headroom
+            for tmp, final in pairs:
+                os.replace(tmp, final)
+            return
         deadline = time.time() + \
             _config.get("MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT")
-        while True:
-            pending = len(self._spool_files())
-            if pending < cap:
-                return max(1, min(headroom, cap - pending))
-            if time.time() > deadline:
-                raise MXNetError(
-                    "dist_async: push spool held %d pending gradients "
-                    "past the backpressure timeout — is the coordinator "
-                    "server thread alive?" % pending)
-            time.sleep(0.005)
+        i = 0
+        while i < len(pairs):
+            with self._spool_lock(deadline):
+                room = cap - len(self._spool_files())
+                while room > 0 and i < len(pairs):
+                    os.replace(*pairs[i])
+                    i += 1
+                    room -= 1
+            if i < len(pairs):
+                if time.time() > deadline:
+                    raise MXNetError(
+                        "dist_async: push spool held %d pending "
+                        "gradients past the backpressure timeout — is "
+                        "the coordinator server thread alive?"
+                        % len(self._spool_files()))
+                time.sleep(0.005)
 
     def push(self, key, value, priority=0):
         """Spool the merged gradient and RETURN — no barrier, no wait;
         the server applies it on arrival.  A full spool blocks first
-        (``_spool_backpressure``)."""
+        (``_spool_admit``)."""
         import numpy as _np
         keys, vals = _ctype_key_value(key, value)
-        budget = 0
+        pairs = []
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))
-            if budget <= 0:
-                budget = self._spool_backpressure(
-                    headroom=len(keys))
-            budget -= 1
             merged = self._reduce(k, vlist)
-            self._push_seq += 1
+            with self._lock:  # push may be called from several threads
+                self._push_seq += 1
+                seq = self._push_seq
             name = "%013d-%03d-%06d-%s" % (
-                _now_ms(), self._rank, self._push_seq, _san(k))
+                _now_ms(), self._rank, seq, _san(k))
             # temp name must NOT match the server's *.npz scan (it would
             # race the rename); savez appends .npz, so park it under a
             # .tmp.npz suffix the scan filters out
             tmp = os.path.join(self._push_dir, "." + name + ".tmp")
             _np.savez(tmp, key=_np.str_(k), grad=merged.asnumpy())
-            os.replace(tmp + ".npz", os.path.join(self._push_dir,
-                                                  name + ".npz"))
+            pairs.append((tmp + ".npz",
+                          os.path.join(self._push_dir, name + ".npz")))
+        try:
+            self._spool_admit(pairs)
+        except MXNetError:
+            # don't orphan unpublished temp files in the shared spool
+            # when admission times out (the caller may retry forever)
+            for tmp, _final in pairs:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            raise
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Read the server's CURRENT weights — possibly missing pushes

@@ -50,16 +50,35 @@ class _LazyNativeLib:
                 from .. import config as _config
                 if _config.get("MXNET_NATIVE_DISABLE"):
                     return self._lib
-                if (not os.path.exists(self._so)
-                        or os.path.getmtime(self._so)
-                        < os.path.getmtime(self._src)):
+                # rebuild gate: source content hash, not mtime — a fresh
+                # checkout gives .so and .cpp identical mtimes, and these
+                # artifacts are platform- and CPython-ABI-specific (not
+                # Py_LIMITED_API), so a stale foreign binary must never
+                # be dlopen'd instead of rebuilt
+                import hashlib
+                with open(self._src, "rb") as f:
+                    src_hash = hashlib.sha256(f.read()).hexdigest()
+                hash_file = self._so + ".hash"
+                built_hash = None
+                if os.path.exists(hash_file):
+                    with open(hash_file) as f:
+                        built_hash = f.read().strip()
+                if not os.path.exists(self._so) or built_hash != src_hash:
+                    # pid-unique temp paths: concurrent importers (e.g.
+                    # multiproc dryrun ranks on a fresh checkout) must
+                    # not interleave writes into one .tmp
+                    tmp_so = "%s.tmp.%d" % (self._so, os.getpid())
                     cmd = ["g++", "-O2", "-fPIC", "-shared", self._src,
-                           "-o", self._so + ".tmp"] + self._extra
+                           "-o", tmp_so] + self._extra
                     if self._python_inc:
                         import sysconfig
                         cmd.append("-I" + sysconfig.get_paths()["include"])
                     subprocess.run(cmd, check=True, capture_output=True)
-                    os.replace(self._so + ".tmp", self._so)
+                    os.replace(tmp_so, self._so)
+                    tmp_hash = "%s.tmp.%d" % (hash_file, os.getpid())
+                    with open(tmp_hash, "w") as f:
+                        f.write(src_hash)
+                    os.replace(tmp_hash, hash_file)
                 lib = ctypes.CDLL(self._so) if self._mode is None \
                     else ctypes.CDLL(self._so, mode=self._mode)
                 if self._declare is not None:
@@ -243,6 +262,62 @@ def _declare_c_api(lib):
               lib.MXSymbolListAuxiliaryStates):
         f.argtypes = [
             vp, up, ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    cpp = ctypes.POINTER(ctypes.c_char_p)
+    # ndarray views / misc block
+    lib.MXNDArraySlice.argtypes = [vp, u, u, ctypes.POINTER(vp)]
+    lib.MXNDArrayAt.argtypes = [vp, u, ctypes.POINTER(vp)]
+    lib.MXNDArrayReshape.argtypes = [vp, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_int),
+                                     ctypes.POINTER(vp)]
+    lib.MXNDArrayGetContext.argtypes = [vp, ctypes.POINTER(ctypes.c_int),
+                                        ctypes.POINTER(ctypes.c_int)]
+    lib.MXRandomSeed.argtypes = [ctypes.c_int]
+    lib.MXSymbolCopy.argtypes = [vp, ctypes.POINTER(vp)]
+    lib.MXSymbolGetName.argtypes = [vp, cpp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXSymbolGetInternals.argtypes = [vp, ctypes.POINTER(vp)]
+    lib.MXSymbolGetOutput.argtypes = [vp, u, ctypes.POINTER(vp)]
+    # creator enumeration block
+    lib.MXSymbolListAtomicSymbolCreators.argtypes = [
+        up, ctypes.POINTER(ctypes.POINTER(vp))]
+    lib.MXSymbolGetAtomicSymbolName.argtypes = [vp, cpp]
+    lib.MXSymbolGetAtomicSymbolInfo.argtypes = [
+        vp, cpp, cpp, up, ctypes.POINTER(cpp), ctypes.POINTER(cpp),
+        ctypes.POINTER(cpp), cpp, cpp]
+    lib.MXSymbolCreateAtomicSymbol.argtypes = [
+        vp, u, cpp, cpp, ctypes.POINTER(vp)]
+    lib.MXSymbolCreateVariable.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(vp)]
+    lib.MXSymbolCompose.argtypes = [vp, ctypes.c_char_p, u, cpp,
+                                    ctypes.POINTER(vp)]
+    # executor block
+    lib.MXExecutorSimpleBind.argtypes = [
+        vp, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, u, cpp, up, up,
+        ctypes.POINTER(vp)]
+    lib.MXExecutorFree.argtypes = [vp]
+    for f in (lib.MXExecutorArgArrays, lib.MXExecutorGradArrays,
+              lib.MXExecutorAuxArrays, lib.MXExecutorOutputs):
+        f.argtypes = [vp, up, ctypes.POINTER(ctypes.POINTER(vp))]
+    lib.MXExecutorForward.argtypes = [vp, ctypes.c_int]
+    lib.MXExecutorBackward.argtypes = [vp, u, ctypes.POINTER(vp)]
+    # kvstore block
+    lib.MXKVStoreCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+    lib.MXKVStoreFree.argtypes = [vp]
+    for f in (lib.MXKVStoreInitEx,):
+        f.argtypes = [vp, u, cpp, ctypes.POINTER(vp)]
+    for f in (lib.MXKVStorePushEx, lib.MXKVStorePullEx):
+        f.argtypes = [vp, u, cpp, ctypes.POINTER(vp), ctypes.c_int]
+    lib.MXKVStoreGetRank.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXKVStoreGetGroupSize.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    # data-iterator block
+    lib.MXListDataIters.argtypes = [up, ctypes.POINTER(ctypes.POINTER(vp))]
+    lib.MXDataIterGetIterInfo.argtypes = [vp, cpp, cpp]
+    lib.MXDataIterCreateIter.argtypes = [vp, u, cpp, cpp,
+                                         ctypes.POINTER(vp)]
+    lib.MXDataIterFree.argtypes = [vp]
+    lib.MXDataIterBeforeFirst.argtypes = [vp]
+    lib.MXDataIterNext.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    lib.MXDataIterGetData.argtypes = [vp, ctypes.POINTER(vp)]
+    lib.MXDataIterGetLabel.argtypes = [vp, ctypes.POINTER(vp)]
 
 
 _CAPI = _LazyNativeLib(_CAPI_SRC, _CAPI_SO, python_inc=True,

@@ -9,12 +9,21 @@
 // usable from any C/C++ host linked against libpython, or loaded into a
 // running interpreter via ctypes/cffi.
 //
-// Scope: the core subset FFI consumers actually exercise — NDArray
-// create/copy/shape/dtype/save/load/wait, imperative op invocation by
-// registered name (which reaches the ENTIRE op registry), and Symbol
-// JSON round-trips.  The remaining reference functions are executor /
-// KVStore / IO plumbing whose deployment story here is the Python API
-// or c_predict_api (SURVEY §2.13 scope note).
+// Scope: the blocks FFI consumers actually exercise —
+//   - NDArray create/copy/shape/dtype/save/load/wait
+//   - imperative op invocation by registered name (the ENTIRE registry)
+//   - Symbol JSON round-trips + creator enumeration/compose
+//     (MXSymbolListAtomicSymbolCreators family: what ctypes codegen
+//     binds against, reference python/mxnet/base.py)
+//   - executor SimpleBind/Forward/Backward/Outputs
+//     (reference src/c_api/c_api_executor.cc:47,54,132,220)
+//   - KVStore create/init/push/pull (string-keyed Ex family)
+//   - DataIter enumeration/create/next/data/label
+// A from-scratch C host can build a symbol, bind it, and run a full
+// training loop without importing mxnet_tpu's Python API directly
+// (tests/test_c_api.py::test_ctypes_only_mlp_train_loop).  Remaining
+// unimplemented reference functions are niche variants of these blocks
+// (monitor installers, profiler config, legacy aliases).
 //
 // Build (native/__init__.py get_c_api_lib):
 //   g++ -O2 -fPIC -shared c_api.cpp -o libmxnet_capi.so -I$(python-inc)
@@ -138,6 +147,33 @@ int fill_str_list(Handle* h, PyObject* list, uint32_t* out_size,
 thread_local std::vector<std::string> g_name_strs;
 thread_local std::vector<const char*> g_name_ptrs;
 
+// one shared dtype-enum -> itemsize table (reference
+// include/mxnet/tensor_blob.h enum order, mirrored by
+// c_api_shim._DTYPE_BY_ENUM: f32 f64 f16 u8 i32 i8 i64)
+const size_t kItemSize[] = {4, 8, 2, 1, 4, 1, 8};
+const int kNumDTypes = 7;
+
+// wrap a list of shim objects as a thread-local handle array (entries
+// may be None -> nullptr, e.g. grad arrays for grad_req='null')
+int fill_handle_list(PyObject* list, uint32_t* out_size,
+                     void*** out_array,
+                     std::vector<void*>* store) {
+  Py_ssize_t n = PyList_Size(list);
+  store->clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(list, i);
+    if (o == Py_None) {
+      store->push_back(nullptr);
+    } else {
+      Py_INCREF(o);
+      store->push_back(wrap(o));
+    }
+  }
+  *out_size = static_cast<uint32_t>(n);
+  *out_array = store->data();
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -225,9 +261,12 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
   {
     int dt = 0;
     if (MXNDArrayGetDType(handle, &dt) != 0) return -1;
-    static const size_t kItem[] = {4, 8, 2, 1, 4, 1, 8};
+    if (dt < 0 || dt >= kNumDTypes) {
+      set_error("SyncCopyFromCPU: unknown dtype enum");
+      return -1;
+    }
     raw = PyBytes_FromStringAndSize(static_cast<const char*>(data),
-                                    size * kItem[dt]);
+                                    size * kItemSize[dt]);
   }
   PyObject* r = shim_call("nd_from_bytes",
                           Py_BuildValue("(ON)", h->obj, raw));
@@ -253,10 +292,16 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
     Py_DECREF(raw);
     return -1;
   }
-  static const size_t kItem[] = {4, 8, 2, 1, 4, 1, 8};
-  size_t want = size * kItem[dt];
-  if (want > static_cast<size_t>(len)) {
-    set_error("SyncCopyToCPU: requested more elements than the array has");
+  if (dt < 0 || dt >= kNumDTypes) {
+    set_error("SyncCopyToCPU: unknown dtype enum");
+    Py_DECREF(raw);
+    return -1;
+  }
+  size_t want = size * kItemSize[dt];
+  // exact element count required (reference c_api.cc CHECK_EQs it);
+  // a silent partial copy hands the caller truncated data
+  if (want != static_cast<size_t>(len)) {
+    set_error("SyncCopyToCPU: size must equal the array's element count");
     Py_DECREF(raw);
     return -1;
   }
@@ -465,6 +510,522 @@ int MXSymbolListAuxiliaryStates(SymbolHandle handle, uint32_t* out_size,
   int rc = fill_str_list(h, l, out_size, out_array);
   Py_DECREF(l);
   return rc;
+}
+
+// -- NDArray views / misc ---------------------------------------------------
+
+static int obj_to_handle(PyObject* o, void** out) {
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, uint32_t start, uint32_t stop,
+                   NDArrayHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("nd_slice", Py_BuildValue("(OII)", h->obj, start, stop)),
+      out);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("nd_at", Py_BuildValue("(OI)", h->obj, idx)), out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  }
+  return obj_to_handle(
+      shim_call("nd_reshape", Py_BuildValue("(ON)", h->obj, shp)), out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("nd_context", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  *out_dev_type = static_cast<int>(PyLong_AsLong(PyList_GetItem(r, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyList_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  GIL gil;
+  PyObject* r = shim_call("random_seed", Py_BuildValue("(i)", seed));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown() { return 0; }
+
+int MXSetNumOMPThreads(int n) { (void)n; return 0; }
+
+int MXSymbolCopy(SymbolHandle handle, SymbolHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("sym_copy", Py_BuildValue("(O)", h->obj)), out);
+}
+
+int MXSymbolGetName(SymbolHandle handle, const char** out, int* success) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* s = shim_call("sym_name", Py_BuildValue("(O)", h->obj));
+  if (s == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(s);
+  h->text = c == nullptr ? "" : c;
+  Py_DECREF(s);
+  *success = h->text.empty() ? 0 : 1;
+  *out = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("sym_internals", Py_BuildValue("(O)", h->obj)), out);
+}
+
+int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
+                      SymbolHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("sym_get_output", Py_BuildValue("(OI)", h->obj, index)),
+      out);
+}
+
+// -- creator enumeration ----------------------------------------------------
+// Reference: MXSymbolListAtomicSymbolCreators + GetAtomicSymbolInfo
+// (src/c_api/c_api_symbolic.cc) — the surface ctypes codegen binds
+// against.  A creator handle wraps the canonical op-name string.
+
+typedef void* AtomicSymbolCreator;
+typedef void* ExecutorHandle;
+typedef void* KVStoreHandle;
+typedef void* DataIterCreator;
+typedef void* DataIterHandle;
+
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  GIL gil;
+  PyObject* names = shim_call("list_op_names", PyTuple_New(0));
+  if (names == nullptr) return -1;
+  static thread_local std::vector<AtomicSymbolCreator> creators;
+  creators.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    PyObject* o = PyList_GetItem(names, i);
+    Py_INCREF(o);
+    creators.push_back(wrap(o));
+  }
+  Py_DECREF(names);
+  *out_size = static_cast<uint32_t>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(creator);
+  const char* c = PyUnicode_AsUTF8(h->obj);
+  if (c == nullptr) {
+    capture_py_error();
+    return -1;
+  }
+  h->text = c;
+  *name = h->text.c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(
+    AtomicSymbolCreator creator, const char** name, const char** description,
+    uint32_t* num_args, const char*** arg_names, const char*** arg_type_infos,
+    const char*** arg_descriptions, const char** key_var_num_args,
+    const char** return_type) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(creator);
+  PyObject* info = shim_call("creator_info", Py_BuildValue("(O)", h->obj));
+  if (info == nullptr) return -1;
+  // (name, doc, arg_names, type_infos, arg_descs, key_var, return_type)
+  h->strs.clear();
+  auto str_at = [&](int i) {
+    return PyUnicode_AsUTF8(PyTuple_GetItem(info, i));
+  };
+  h->strs.emplace_back(str_at(0));
+  h->strs.emplace_back(str_at(1));
+  h->strs.emplace_back(str_at(5));
+  h->strs.emplace_back(str_at(6));
+  PyObject *an = PyTuple_GetItem(info, 2), *at = PyTuple_GetItem(info, 3),
+           *ad = PyTuple_GetItem(info, 4);
+  Py_ssize_t n = PyList_Size(an);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(an, i)));
+    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(at, i)));
+    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ad, i)));
+  }
+  Py_DECREF(info);
+  // pointers into h->strs stay valid until the next info call on this
+  // creator handle (same lifetime contract as the reference's ret store)
+  h->ptrs.clear();
+  for (const std::string& s : h->strs) h->ptrs.push_back(s.c_str());
+  *name = h->ptrs[0];
+  *description = h->ptrs[1];
+  *key_var_num_args = h->ptrs[2];
+  if (return_type != nullptr) *return_type = h->ptrs[3];
+  *num_args = static_cast<uint32_t>(n);
+  static thread_local std::vector<const char*> names_v, types_v, descs_v;
+  names_v.clear(); types_v.clear(); descs_v.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    names_v.push_back(h->ptrs[4 + 3 * i]);
+    types_v.push_back(h->ptrs[4 + 3 * i + 1]);
+    descs_v.push_back(h->ptrs[4 + 3 * i + 2]);
+  }
+  *arg_names = names_v.data();
+  *arg_type_infos = types_v.data();
+  *arg_descriptions = descs_v.data();
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               uint32_t num_param, const char** keys,
+                               const char** vals, SymbolHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(creator);
+  PyObject* ks = PyList_New(num_param);
+  PyObject* vs = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* s = shim_call("create_atomic_symbol",
+                          Py_BuildValue("(ONN)", h->obj, ks, vs));
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  GIL gil;
+  PyObject* s = shim_call("sym_var", Py_BuildValue("(s)", name));
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
+                    const char** keys, SymbolHandle* args) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* ks;
+  if (keys == nullptr) {
+    ks = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    ks = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    }
+  }
+  PyObject* syms = PyList_New(num_args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyObject* o = static_cast<Handle*>(args[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(syms, i, o);
+  }
+  PyObject* r = shim_call(
+      "sym_compose",
+      Py_BuildValue("(OsNN)", h->obj, name == nullptr ? "" : name, ks, syms));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- executor ---------------------------------------------------------------
+// Reference: src/c_api/c_api_executor.cc:47 (Free), :54 (Forward),
+// :132 (Backward), :220 (SimpleBind).  Signature simplification vs the
+// reference's 20-arg SimpleBindEx: shape-only binding (dtypes inferred,
+// contexts meaningless under XLA placement), one grad_req string.
+
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char* grad_req, uint32_t num_provided_shapes,
+                         const char** shape_keys, const uint32_t* shape_data,
+                         const uint32_t* shape_ndims, ExecutorHandle* out) {
+  (void)dev_type; (void)dev_id;  // XLA owns placement
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* ks = PyList_New(num_provided_shapes);
+  PyObject* nds = PyList_New(num_provided_shapes);
+  size_t total = 0;
+  for (uint32_t i = 0; i < num_provided_shapes; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(shape_keys[i]));
+    PyList_SET_ITEM(nds, i, PyLong_FromUnsignedLong(shape_ndims[i]));
+    total += shape_ndims[i];
+  }
+  PyObject* flat = PyList_New(total);
+  for (size_t i = 0; i < total; ++i) {
+    PyList_SET_ITEM(flat, i, PyLong_FromUnsignedLong(shape_data[i]));
+  }
+  PyObject* exe = shim_call(
+      "exec_simple_bind",
+      Py_BuildValue("(OsNNN)", h->obj, grad_req, ks, flat, nds));
+  if (exe == nullptr) return -1;
+  *out = wrap(exe);
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) { return MXNDArrayFree(handle); }
+
+static int exec_array_block(ExecutorHandle handle, const char* shim_fn,
+                            uint32_t* out_size, NDArrayHandle** out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* l = shim_call(shim_fn, Py_BuildValue("(O)", h->obj));
+  if (l == nullptr) return -1;
+  static thread_local std::vector<void*> store;
+  int rc = fill_handle_list(l, out_size,
+                            reinterpret_cast<void***>(out), &store);
+  Py_DECREF(l);
+  return rc;
+}
+
+int MXExecutorArgArrays(ExecutorHandle handle, uint32_t* out_size,
+                        NDArrayHandle** out) {
+  return exec_array_block(handle, "exec_arg_arrays", out_size, out);
+}
+
+int MXExecutorGradArrays(ExecutorHandle handle, uint32_t* out_size,
+                         NDArrayHandle** out) {
+  return exec_array_block(handle, "exec_grad_arrays", out_size, out);
+}
+
+int MXExecutorAuxArrays(ExecutorHandle handle, uint32_t* out_size,
+                        NDArrayHandle** out) {
+  return exec_array_block(handle, "exec_aux_arrays", out_size, out);
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("exec_forward",
+                          Py_BuildValue("(Oi)", h->obj, is_train));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, uint32_t len,
+                       NDArrayHandle* head_grads) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* grads = PyList_New(len);
+  for (uint32_t i = 0; i < len; ++i) {
+    PyObject* o = static_cast<Handle*>(head_grads[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(grads, i, o);
+  }
+  PyObject* r = shim_call("exec_backward",
+                          Py_BuildValue("(ON)", h->obj, grads));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, uint32_t* out_size,
+                      NDArrayHandle** out) {
+  return exec_array_block(handle, "exec_outputs", out_size, out);
+}
+
+// -- KVStore ----------------------------------------------------------------
+// Reference: MXKVStoreCreate/.../PushEx/PullEx (src/c_api/c_api.cc).
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  GIL gil;
+  PyObject* kv = shim_call("kv_create", Py_BuildValue("(s)", type));
+  if (kv == nullptr) return -1;
+  *out = wrap(kv);
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) { return MXNDArrayFree(handle); }
+
+static PyObject* keyed_nd_lists(uint32_t num, const char** keys,
+                                NDArrayHandle* vals, PyObject** out_vals) {
+  PyObject* ks = PyList_New(num);
+  PyObject* vs = PyList_New(num);
+  for (uint32_t i = 0; i < num; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyObject* o = static_cast<Handle*>(vals[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(vs, i, o);
+  }
+  *out_vals = vs;
+  return ks;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* vs = nullptr;
+  PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
+  PyObject* r = shim_call("kv_init", Py_BuildValue("(ONN)", h->obj, ks, vs));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* vs = nullptr;
+  PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
+  PyObject* r = shim_call(
+      "kv_push", Py_BuildValue("(ONNi)", h->obj, ks, vs, priority));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* vs = nullptr;
+  PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
+  PyObject* r = shim_call(
+      "kv_pull", Py_BuildValue("(ONNi)", h->obj, ks, vs, priority));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int* rank) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("kv_rank_size", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  *rank = static_cast<int>(PyLong_AsLong(PyList_GetItem(r, 0)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* size) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("kv_rank_size", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  *size = static_cast<int>(PyLong_AsLong(PyList_GetItem(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- Data iterators ---------------------------------------------------------
+// Reference: MXListDataIters/MXDataIterCreateIter/... (src/c_api/c_api.cc)
+
+int MXListDataIters(uint32_t* out_size, DataIterCreator** out_array) {
+  GIL gil;
+  PyObject* names = shim_call("list_data_iters", PyTuple_New(0));
+  if (names == nullptr) return -1;
+  static thread_local std::vector<DataIterCreator> creators;
+  creators.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(names); ++i) {
+    PyObject* o = PyList_GetItem(names, i);
+    Py_INCREF(o);
+    creators.push_back(wrap(o));
+  }
+  Py_DECREF(names);
+  *out_size = static_cast<uint32_t>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
+                          const char** description) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(creator);
+  PyObject* info = shim_call("data_iter_info", Py_BuildValue("(O)", h->obj));
+  if (info == nullptr) return -1;
+  h->strs.clear();
+  h->strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(info, 0)));
+  h->strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(info, 1)));
+  Py_DECREF(info);
+  h->ptrs.clear();
+  for (const std::string& s : h->strs) h->ptrs.push_back(s.c_str());
+  *name = h->ptrs[0];
+  *description = h->ptrs[1];
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, uint32_t num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(creator);
+  PyObject* ks = PyList_New(num_param);
+  PyObject* vs = PyList_New(num_param);
+  for (uint32_t i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* it = shim_call("data_iter_create",
+                           Py_BuildValue("(ONN)", h->obj, ks, vs));
+  if (it == nullptr) return -1;
+  *out = wrap(it);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("iter_before_first", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("iter_next", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int iter_fetch(DataIterHandle handle, const char* fn,
+                      NDArrayHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* a = shim_call(fn, Py_BuildValue("(O)", h->obj));
+  if (a == nullptr) return -1;
+  *out = wrap(a);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_fetch(handle, "iter_data", out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return iter_fetch(handle, "iter_label", out);
 }
 
 }  // extern "C"

@@ -20,3 +20,8 @@ from . import optimizer_ops  # noqa: F401
 from . import contrib       # noqa: F401
 from . import quantization  # noqa: F401
 from . import misc          # noqa: F401
+
+# reference-transcribed range/enum overlay goes on LAST, once every
+# module has populated the registry (see constraints.py docstring)
+from . import constraints   # noqa: E402
+constraints.install()

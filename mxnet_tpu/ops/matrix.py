@@ -171,7 +171,9 @@ def _slice_like(x, like, axes=(), **attrs):
 
 @register("Concat", aliases=("concat",), params=[
     P("dim", int, default=1),
-    P("num_args", int, default=0, low=0)])
+    P("num_args", int, default=0, low=1,
+      doc="number of inputs (reference nn/concat-inl.h:53 lower bound 1; "
+          "the unset default 0 means 'infer from the call arity')")])
 def _concat(*args, dim=1, num_args=None, **attrs):
     return jnp.concatenate(args, axis=dim)
 

@@ -41,6 +41,49 @@ def _internal_nhwc():
         return False
 
 
+def _stem_s2d_enabled():
+    """MFU experiment toggle (docs/faq/perf.md): rewrite the ResNet-style
+    7x7/s2/p3 few-channel stem conv as space-to-depth + 4x4/s1 conv."""
+    from .. import config as _config
+    try:
+        return _config.get("MXNET_STEM_SPACE_TO_DEPTH") == "1"
+    except KeyError:  # pragma: no cover - registry not loaded yet
+        return False
+
+
+def _conv_stem_s2d(data, weight, bias, no_bias):
+    """7x7/stride-2/pad-3 stem conv via space-to-depth (MLPerf trick).
+
+    The 7x7 kernel over C<=4 input channels under-fills the 128x128 MXU
+    contraction (round-2 trace's named loss).  Equivalent program: pad
+    the kernel to 8x8 (zero top-left row/col, which shifts effective
+    padding 3 -> 4), 2x2-space-to-depth both operands, and run a 4x4
+    stride-1 conv over 4*C channels — identical math, MXU-friendlier
+    tiling.  All rearrangement is traced, so autodiff and bf16 flow
+    through unchanged.
+    """
+    N, C, H, W = data.shape
+    F = weight.shape[0]
+    # kernel: zeros at top/left make k=8 pad=4 reproduce k=7 pad=3
+    w8 = jnp.pad(weight, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    w_s2d = w8.reshape(F, C, 4, 2, 4, 2).transpose(0, 1, 3, 5, 2, 4) \
+              .reshape(F, C * 4, 4, 4)
+    xp = jnp.pad(data, ((0, 0), (0, 0), (4, 4), (4, 4)))
+    Hp, Wp = H + 8, W + 8
+    xs = xp.reshape(N, C, Hp // 2, 2, Wp // 2, 2) \
+           .transpose(0, 1, 3, 5, 2, 4).reshape(N, C * 4, Hp // 2, Wp // 2)
+    dn = lax.conv_dimension_numbers(xs.shape, w_s2d.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(xs, w_s2d, (1, 1), [(0, 0), (0, 0)],
+                                   dimension_numbers=dn)
+    # symmetric (4,4) padding overshoots the original (4,3) by one output
+    # row/col of pure padding; the original output is exactly H/2 x W/2
+    out = out[:, :, :H // 2, :W // 2]
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
 # -- FullyConnected ---------------------------------------------------------
 @register("FullyConnected", params=[
     P("num_hidden", int, required=True, low=1,
@@ -176,6 +219,12 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = normalize_tuple(stride, nd) if stride else (1,) * nd
     dilate = normalize_tuple(dilate, nd) if dilate else (1,) * nd
     pad = normalize_tuple(pad, nd) if pad else (0,) * nd
+    if (nd == 2 and layout in (None, "NCHW") and _stem_s2d_enabled()
+            and kernel == (7, 7) and stride == (2, 2) and pad == (3, 3)
+            and dilate == (1, 1) and num_group == 1
+            and data.shape[1] <= 4
+            and data.shape[2] % 2 == 0 and data.shape[3] % 2 == 0):
+        return _conv_stem_s2d(data, weight, bias, no_bias)
     if nd == 2 and layout in (None, "NCHW") and _internal_nhwc():
         # layout experiment (MXNET_CONV_LAYOUT=NHWC): run the conv in
         # NHWC with boundary transposes.  XLA folds the transposes

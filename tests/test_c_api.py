@@ -157,3 +157,325 @@ def test_error_path_names_the_problem(lib):
         ctypes.byref(outs), 0, None, None)
     assert rc != 0
     assert b"NoSuchOperator" in lib.MXGetLastError()
+
+
+# -- round 5: creator enumeration / executor / kvstore / data-iter blocks ---
+
+def test_version_gate_matches_reference_contract(lib):
+    # reference python/mxnet/libinfo.py:76 — 1.2.0 -> 10200
+    v = ctypes.c_int()
+    _check(lib.MXGetVersion(ctypes.byref(v)), lib)
+    assert v.value == 10200
+
+
+def test_nd_load_preserves_save_order(lib, tmp_path):
+    # reference C API returns arrays in FILE order, not key-sorted
+    import mxnet_tpu.ndarray as nd
+    fname = str(tmp_path / "ordered.nd")
+    nd.save(fname, {"zz_first": nd.ones((2,)), "aa_second": nd.zeros((3,))})
+    out_n = ctypes.c_uint()
+    out_arr = ctypes.POINTER(ctypes.c_void_p)()
+    name_n = ctypes.c_uint()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXNDArrayLoad(fname.encode(), ctypes.byref(out_n),
+                             ctypes.byref(out_arr), ctypes.byref(name_n),
+                             ctypes.byref(names)), lib)
+    got = [names[i].decode() for i in range(name_n.value)]
+    assert got == ["zz_first", "aa_second"]
+
+
+def test_sync_copy_to_cpu_requires_exact_size(lib):
+    h = _nd_from_np(lib, np.zeros((2, 3), np.float32))
+    buf = np.empty(4, np.float32)  # wrong element count (6 expected)
+    rc = lib.MXNDArraySyncCopyToCPU(
+        h, buf.ctypes.data_as(ctypes.c_void_p), buf.size)
+    assert rc != 0
+    assert b"element count" in lib.MXGetLastError()
+    _check(lib.MXNDArrayFree(h), lib)
+
+
+def _find_creator(lib, name):
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(arr)), lib)
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        h = ctypes.c_void_p(arr[i])
+        _check(lib.MXSymbolGetAtomicSymbolName(h, ctypes.byref(cname)), lib)
+        if cname.value.decode() == name:
+            return h
+    raise AssertionError("creator %s not enumerated" % name)
+
+
+def test_creator_enumeration_and_info(lib):
+    fc = _find_creator(lib, "FullyConnected")
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    nargs = ctypes.c_uint()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    atypes = ctypes.POINTER(ctypes.c_char_p)()
+    adescs = ctypes.POINTER(ctypes.c_char_p)()
+    kv = ctypes.c_char_p()
+    ret = ctypes.c_char_p()
+    _check(lib.MXSymbolGetAtomicSymbolInfo(
+        fc, ctypes.byref(name), ctypes.byref(desc), ctypes.byref(nargs),
+        ctypes.byref(anames), ctypes.byref(atypes), ctypes.byref(adescs),
+        ctypes.byref(kv), ctypes.byref(ret)), lib)
+    assert name.value == b"FullyConnected"
+    params = {anames[i].decode(): atypes[i].decode()
+              for i in range(nargs.value)}
+    assert "num_hidden" in params and "int" in params["num_hidden"]
+    assert "no_bias" in params
+
+
+def _atomic(lib, creator, keys, vals):
+    n = len(keys)
+    ks = (ctypes.c_char_p * n)(*[k.encode() for k in keys])
+    vs = (ctypes.c_char_p * n)(*[v.encode() for v in vals])
+    out = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateAtomicSymbol(creator, n, ks, vs,
+                                          ctypes.byref(out)), lib)
+    return out
+
+
+def _compose(lib, sym, name, keys, args):
+    n = len(args)
+    ks = None if keys is None else \
+        (ctypes.c_char_p * n)(*[k.encode() for k in keys])
+    hs = (ctypes.c_void_p * n)(*[a.value for a in args])
+    _check(lib.MXSymbolCompose(sym, name.encode(), n, ks, hs), lib)
+
+
+def test_ctypes_only_mlp_train_loop(lib):
+    """The directive's done-criterion: build a symbol through the
+    creator ABI, SimpleBind it, and train an MLP to high accuracy using
+    ONLY C-API calls (reference consumer analogue: any from-scratch FFI
+    binding, e.g. python/mxnet/base.py codegen or the Scala/Perl
+    frontends)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+
+    # ---- build the graph through the creator ABI ----
+    data = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)), lib)
+    label = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateVariable(b"softmax_label",
+                                      ctypes.byref(label)), lib)
+    fc1 = _atomic(lib, _find_creator(lib, "FullyConnected"),
+                  ["num_hidden"], ["64"])
+    _compose(lib, fc1, "fc1", ["data"], [data])
+    act = _atomic(lib, _find_creator(lib, "Activation"),
+                  ["act_type"], ["relu"])
+    _compose(lib, act, "relu1", ["data"], [fc1])
+    fc2 = _atomic(lib, _find_creator(lib, "FullyConnected"),
+                  ["num_hidden"], ["3"])
+    _compose(lib, fc2, "fc2", ["data"], [act])
+    sm = _atomic(lib, _find_creator(lib, "SoftmaxOutput"), [], [])
+    _compose(lib, sm, "softmax", ["data", "label"], [fc2, label])
+
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXSymbolListArguments(sm, ctypes.byref(n),
+                                     ctypes.byref(arr)), lib)
+    arg_names = [arr[i].decode() for i in range(n.value)]
+    assert arg_names == ["data", "fc1_weight", "fc1_bias", "fc2_weight",
+                         "fc2_bias", "softmax_label"]
+
+    # ---- SimpleBind ----
+    skeys = (ctypes.c_char_p * 2)(b"data", b"softmax_label")
+    sdata = (ctypes.c_uint * 3)(256, 10, 256)
+    sndims = (ctypes.c_uint * 2)(2, 1)
+    exe = ctypes.c_void_p()
+    _check(lib.MXExecutorSimpleBind(sm, 1, 0, b"write", 2, skeys, sdata,
+                                    sndims, ctypes.byref(exe)), lib)
+    na = ctypes.c_uint()
+    args_p = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXExecutorArgArrays(exe, ctypes.byref(na),
+                                   ctypes.byref(args_p)), lib)
+    assert na.value == len(arg_names)
+    arg_h = {arg_names[i]: ctypes.c_void_p(args_p[i])
+             for i in range(na.value)}
+    ng = ctypes.c_uint()
+    grads_p = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXExecutorGradArrays(exe, ctypes.byref(ng),
+                                    ctypes.byref(grads_p)), lib)
+    grad_h = {arg_names[i]: ctypes.c_void_p(grads_p[i])
+              for i in range(ng.value)}
+
+    # Xavier-ish init through the ABI
+    r2 = np.random.RandomState(42)
+    def _set(name, a):
+        buf = np.ascontiguousarray(a, np.float32)
+        _check(lib.MXNDArraySyncCopyFromCPU(
+            arg_h[name], buf.ctypes.data_as(ctypes.c_void_p), buf.size),
+            lib)
+    _set("fc1_weight", r2.randn(64, 10) * (2.0 / 10) ** 0.5)
+    _set("fc1_bias", np.zeros(64))
+    _set("fc2_weight", r2.randn(3, 64) * (2.0 / 64) ** 0.5)
+    _set("fc2_bias", np.zeros(3))
+    _set("data", X)
+    _set("softmax_label", Y)
+
+    # ---- train loop: Forward / Backward / sgd_update, all C ----
+    lr_keys = (ctypes.c_char_p * 1)(b"lr")
+    lr_vals = (ctypes.c_char_p * 1)(b"0.002")
+    weights = ["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"]
+    for step in range(60):
+        _check(lib.MXExecutorForward(exe, 1), lib)
+        _check(lib.MXExecutorBackward(exe, 0, None), lib)
+        for wname in weights:
+            hs = (ctypes.c_void_p * 2)(arg_h[wname].value,
+                                       grad_h[wname].value)
+            n_out = ctypes.c_int()
+            outs = ctypes.POINTER(ctypes.c_void_p)()
+            _check(lib.MXImperativeInvokeByName(
+                b"sgd_update", 2, hs, ctypes.byref(n_out),
+                ctypes.byref(outs), 1, lr_keys, lr_vals), lib)
+            new_w = _nd_to_np(lib, ctypes.c_void_p(outs[0]))
+            _set(wname, new_w)
+
+    _check(lib.MXExecutorForward(exe, 0), lib)
+    no = ctypes.c_uint()
+    outs_p = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXExecutorOutputs(exe, ctypes.byref(no),
+                                 ctypes.byref(outs_p)), lib)
+    assert no.value == 1
+    probs = _nd_to_np(lib, ctypes.c_void_p(outs_p[0]))
+    acc = float((probs.argmax(1) == Y).mean())
+    assert acc > 0.9, "ctypes-only MLP failed to train: acc=%.3f" % acc
+    _check(lib.MXExecutorFree(exe), lib)
+
+
+def test_kvstore_block(lib):
+    kv = ctypes.c_void_p()
+    _check(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)), lib)
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    _check(lib.MXKVStoreGetRank(kv, ctypes.byref(rank)), lib)
+    _check(lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)), lib)
+    assert (rank.value, size.value) == (0, 1)
+    w0 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    h_init = _nd_from_np(lib, w0)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    hs = (ctypes.c_void_p * 1)(h_init.value)
+    _check(lib.MXKVStoreInitEx(kv, 1, keys, hs), lib)
+    g = np.ones((2, 3), np.float32)
+    h_g = _nd_from_np(lib, g)
+    hs_g = (ctypes.c_void_p * 1)(h_g.value)
+    _check(lib.MXKVStorePushEx(kv, 1, keys, hs_g, 0), lib)
+    h_out = _nd_from_np(lib, np.zeros((2, 3), np.float32))
+    hs_o = (ctypes.c_void_p * 1)(h_out.value)
+    _check(lib.MXKVStorePullEx(kv, 1, keys, hs_o, 0), lib)
+    got = _nd_to_np(lib, h_out)
+    assert np.allclose(got, w0 + g)  # local kvstore aggregates pushes
+    _check(lib.MXKVStoreFree(kv), lib)
+
+
+def test_data_iter_block(lib):
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXListDataIters(ctypes.byref(n), ctypes.byref(arr)), lib)
+    names = []
+    target = None
+    for i in range(n.value):
+        h = ctypes.c_void_p(arr[i])
+        cname = ctypes.c_char_p()
+        cdesc = ctypes.c_char_p()
+        _check(lib.MXDataIterGetIterInfo(h, ctypes.byref(cname),
+                                         ctypes.byref(cdesc)), lib)
+        names.append(cname.value.decode())
+        if names[-1] == "CSVIter":
+            target = ctypes.c_void_p(arr[i])
+    assert {"MNISTIter", "ImageRecordIter", "CSVIter"} <= set(names)
+    # drive CSVIter end-to-end through the ABI
+    import tempfile, os
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    fd, path = tempfile.mkstemp(suffix=".csv")
+    with os.fdopen(fd, "w") as f:
+        for r in rows:
+            f.write(",".join("%g" % v for v in r) + "\n")
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(path.encode(), b"(3,)", b"2")
+    it = ctypes.c_void_p()
+    _check(lib.MXDataIterCreateIter(target, 3, keys, vals,
+                                    ctypes.byref(it)), lib)
+    _check(lib.MXDataIterBeforeFirst(it), lib)
+    seen = []
+    has = ctypes.c_int()
+    while True:
+        _check(lib.MXDataIterNext(it, ctypes.byref(has)), lib)
+        if not has.value:
+            break
+        d = ctypes.c_void_p()
+        _check(lib.MXDataIterGetData(it, ctypes.byref(d)), lib)
+        seen.append(_nd_to_np(lib, d))
+    batch = np.concatenate(seen, axis=0)
+    assert batch.shape[0] >= 4
+    assert np.allclose(batch[:4], rows)
+    _check(lib.MXDataIterFree(it), lib)
+    os.unlink(path)
+
+
+def test_ndarray_views_and_misc_block(lib):
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    h = _nd_from_np(lib, a)
+    # slice
+    s = ctypes.c_void_p()
+    _check(lib.MXNDArraySlice(h, 1, 3, ctypes.byref(s)), lib)
+    assert np.allclose(_nd_to_np(lib, s), a[1:3])
+    # at
+    row = ctypes.c_void_p()
+    _check(lib.MXNDArrayAt(h, 2, ctypes.byref(row)), lib)
+    assert np.allclose(_nd_to_np(lib, row), a[2])
+    # reshape
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(6, 4)
+    _check(lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)), lib)
+    assert _nd_to_np(lib, r).shape == (6, 4)
+    # context
+    dt = ctypes.c_int()
+    di = ctypes.c_int()
+    _check(lib.MXNDArrayGetContext(h, ctypes.byref(dt),
+                                   ctypes.byref(di)), lib)
+    assert (dt.value, di.value) == (1, 0)
+    _check(lib.MXRandomSeed(42), lib)
+    for handle in (s, row, r, h):
+        _check(lib.MXNDArrayFree(handle), lib)
+
+
+def test_symbol_views_block(lib):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                name="fc")
+    js = sym.tojson().encode()
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(js, ctypes.byref(h)), lib)
+    # name
+    nm = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib.MXSymbolGetName(h, ctypes.byref(nm), ctypes.byref(ok)), lib)
+    assert ok.value == 1 and nm.value == b"fc"
+    # copy is independent
+    cp = ctypes.c_void_p()
+    _check(lib.MXSymbolCopy(h, ctypes.byref(cp)), lib)
+    out_json = ctypes.c_char_p()
+    _check(lib.MXSymbolSaveToJSON(cp, ctypes.byref(out_json)), lib)
+    assert b"fc" in out_json.value
+    # internals lists every node output; get_output picks one head
+    internals = ctypes.c_void_p()
+    _check(lib.MXSymbolGetInternals(h, ctypes.byref(internals)), lib)
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXSymbolListOutputs(internals, ctypes.byref(n),
+                                   ctypes.byref(arr)), lib)
+    outs = [arr[i].decode() for i in range(n.value)]
+    assert "fc_output" in outs and len(outs) >= 2
+    head = ctypes.c_void_p()
+    _check(lib.MXSymbolGetOutput(internals, 0, ctypes.byref(head)), lib)
+    _check(lib.MXSymbolListOutputs(head, ctypes.byref(n),
+                                   ctypes.byref(arr)), lib)
+    assert n.value == 1
+    for handle in (cp, internals, head, h):
+        _check(lib.MXSymbolFree(handle), lib)

@@ -271,10 +271,13 @@ def test_dist_sync_zero_host_staging(tmp_path):
 
 def test_dist_async_spool_bounded_under_stalled_server(tmp_path):
     """With the coordinator's server thread stalled, pushes hit the
-    spool capacity and block, then raise after the backpressure timeout
-    — the spool is bounded by MXNET_KVSTORE_ASYNC_MAX_PENDING plus at
-    most one in-flight file per concurrent worker (VERDICT r3 #9)."""
+    spool capacity and block, then raise after the backpressure timeout.
+    The bound is EXACT (r4 VERDICT #7): the capacity scan and the
+    publishing rename happen under one spool lockfile, so even
+    concurrent pushers cannot land cap + k files (the r4 bound was
+    cap + workers - 1 from the unlocked check-then-write)."""
     import glob
+    import threading
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import nd
@@ -282,7 +285,7 @@ def test_dist_async_spool_bounded_under_stalled_server(tmp_path):
 
     os.environ["MXNET_KVSTORE_ASYNC_DIR"] = str(tmp_path)
     os.environ["MXNET_KVSTORE_ASYNC_MAX_PENDING"] = "3"
-    os.environ["MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT"] = "0.5"
+    os.environ["MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT"] = "1.5"
     try:
         kv = mx.kv.create("dist_async")
         kv.init("w", nd.zeros((2, 2)))
@@ -290,11 +293,30 @@ def test_dist_async_spool_bounded_under_stalled_server(tmp_path):
         kv._stop.set()
         kv._server.join(timeout=5)
         g = nd.array(np.ones((2, 2), np.float32))
-        with pytest.raises(MXNetError, match="backpressure|server thread"):
-            for _ in range(10):
-                kv.push("w", g)
+        # 4 concurrent pushers all racing the capacity check — every
+        # one must eventually raise, and the spool must hold EXACTLY
+        # the cap, not cap + (pushers - 1)
+        errors = []
+
+        def _spam():
+            try:
+                for _ in range(5):
+                    kv.push("w", g)
+            except MXNetError as e:
+                errors.append(str(e))
+
+        threads = [threading.Thread(target=_spam) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(errors) == 4, \
+            "every blocked pusher must raise: %d/4" % len(errors)
+        assert all("backpressure" in e or "server thread" in e
+                   for e in errors)
         spooled = glob.glob(str(tmp_path / "push" / "*.npz"))
-        assert len(spooled) <= 3, "spool exceeded capacity: %d" % len(spooled)
+        assert len(spooled) == 3, \
+            "spool must hold exactly the cap: %d" % len(spooled)
     finally:
         for var in ("MXNET_KVSTORE_ASYNC_DIR",
                     "MXNET_KVSTORE_ASYNC_MAX_PENDING",

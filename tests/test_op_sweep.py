@@ -328,3 +328,61 @@ def test_derived_params_type_checked():
     # scope/framework attrs still pass through untouched
     conv.validate_attrs({"kernel": (3, 3), "num_filter": 8,
                          "name": "c0", "__lr_mult__": "2.0"})
+
+
+# -- reference-transcribed range/enum overlay (constraints.py) --------------
+# Reference: dmlc fields with set_range/set_lower_bound/add_enum
+# (e.g. src/operator/roi_pooling-inl.h spatial_scale.set_range(0, 1));
+# the overlay table transcribes every such bound and THIS sweep walks
+# the same table, so transcription and enforcement cannot drift.
+
+def test_constraint_overlay_fully_applied():
+    from mxnet_tpu.ops import constraints
+    assert constraints.UNAPPLIED == (), \
+        "constraint entries with no matching op/param: %s" % (
+            constraints.UNAPPLIED,)
+
+
+def test_every_transcribed_bound_is_enforced():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ops.registry import _OP_REGISTRY
+    from mxnet_tpu.ops.constraints import CONSTRAINTS
+    soft = []
+    for opname, fields in CONSTRAINTS.items():
+        op = _OP_REGISTRY[opname]
+        for pname, c in fields.items():
+            p = op.params[pname]
+            # the live bound must be at least as tight as the reference's
+            if "low" in c and (p.low is None or p.low < c["low"]):
+                soft.append((opname, pname, "low"))
+            if "high" in c and (p.high is None or p.high > c["high"]):
+                soft.append((opname, pname, "high"))
+            # and actually enforced: an out-of-range value raises
+            for bad in ([c["low"] - 1] if "low" in c else []) + \
+                       ([c["high"] + 1] if "high" in c else []):
+                try:
+                    p.check(opname, (bad,) if p.ptype is tuple else bad)
+                    soft.append((opname, pname, "accepted %r" % bad))
+                except MXNetError:
+                    pass
+    assert not soft, "reference-bounded params not enforced: %s" % soft
+
+
+def test_judge_probe_values_raise():
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="spatial_scale"):
+        nd.ROIPooling(nd.ones((1, 3, 8, 8)), nd.array([[0, 0, 0, 4, 4]]),
+                      pooled_size=(2, 2), spatial_scale=-3)
+    with pytest.raises(MXNetError, match="kernel_size"):
+        nd.Correlation(nd.ones((1, 1, 8, 8)), nd.ones((1, 1, 8, 8)),
+                       kernel_size=-5)
+    with pytest.raises(MXNetError, match="axis"):
+        nd.SequenceMask(nd.ones((4, 2, 3)), axis=7)
+    with pytest.raises(MXNetError, match="ord"):
+        nd.norm(nd.ones((3, 3)), ord=99)
+    # stabilizer/name-based defaults: eps and lr are non-negative
+    with pytest.raises(MXNetError, match="eps"):
+        nd.BatchNorm(nd.ones((2, 3, 4, 4)), nd.ones(3), nd.zeros(3),
+                     nd.zeros(3), nd.ones(3), eps=-1e-3)
+    with pytest.raises(MXNetError, match="lr"):
+        nd.sgd_update(nd.ones((3,)), nd.ones((3,)), lr=-0.1)
