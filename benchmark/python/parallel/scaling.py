@@ -70,7 +70,7 @@ def main():
     args = ap.parse_args()
     import jax
     n = len(jax.devices())
-    base = None
+    base = base_w = None
     rows = []
     print("%6s %12s %10s" % ("dp", "samples/s", "efficiency"))
     for w in (int(x) for x in args.widths.split(",")):
@@ -80,13 +80,18 @@ def main():
         batch = args.global_batch or args.batch_per_device * w
         sps = bench_width(w, batch, args.steps, args.image_size)
         if base is None:
-            base = sps
-        # strong scaling: ideal = base * w regardless of batch split
-        eff = sps / (base * w)
-        rows.append({"devices": w, "global_batch": batch,
-                     "samples_per_sec": round(sps, 1),
-                     "efficiency_vs_linear": round(eff, 3),
-                     "throughput_vs_1dev": round(sps / base, 3)})
+            base, base_w = sps, w
+        # strong scaling vs the FIRST width run: ideal = base * (w/base_w)
+        eff = sps * base_w / (base * w)
+        row = {"devices": w, "global_batch": batch,
+               "samples_per_sec": round(sps, 1),
+               "efficiency_vs_linear": round(eff, 3)}
+        # only call the flat-throughput ratio "vs 1 device" when the
+        # sweep actually ran a 1-device base
+        key = ("throughput_vs_1dev" if base_w == 1
+               else "throughput_vs_%ddev_base" % base_w)
+        row[key] = round(sps / base, 3)
+        rows.append(row)
         print("%6d %12.1f %9.0f%%" % (w, sps, 100 * eff))
     if args.json_out:
         import json

@@ -23,6 +23,10 @@ __all__ = [
     "list_op_names", "imperative_invoke", "sym_from_json", "sym_to_json",
     "sym_list_arguments", "sym_list_outputs", "sym_list_aux",
     "nd_slice", "nd_at", "nd_reshape", "nd_context", "random_seed",
+    "autograd_set_recording", "autograd_set_training",
+    "autograd_is_recording", "autograd_is_training",
+    "autograd_mark_variables", "autograd_backward", "nd_get_grad",
+    "sym_infer_shape",
     "sym_copy", "sym_name", "sym_internals", "sym_get_output",
     "creator_info", "create_atomic_symbol", "sym_compose", "sym_var",
     "exec_simple_bind", "exec_arg_arrays", "exec_grad_arrays",
@@ -181,6 +185,69 @@ def sym_internals(sym):
 
 def sym_get_output(sym, index):
     return sym[int(index)]
+
+
+# -- autograd (MXAutograd* block) -------------------------------------------
+# Reference: include/mxnet/c_api.h:894-970 over Imperative::Get()'s
+# recording state; here the tape lives in mxnet_tpu.autograd.
+
+def autograd_set_recording(flag):
+    from . import autograd
+    return 1 if autograd.set_recording(bool(flag)) else 0
+
+
+def autograd_set_training(flag):
+    from . import autograd
+    return 1 if autograd.set_training(bool(flag)) else 0
+
+
+def autograd_is_recording():
+    from . import autograd
+    return 1 if autograd.is_recording() else 0
+
+
+def autograd_is_training():
+    from . import autograd
+    return 1 if autograd.is_training() else 0
+
+
+def autograd_mark_variables(variables, gradients, reqs):
+    from . import autograd
+    autograd.mark_variables(list(variables), list(gradients), list(reqs))
+    return None
+
+
+def autograd_backward(outputs, head_grads, retain_graph, train_mode):
+    from . import autograd
+    autograd.backward(list(outputs),
+                      list(head_grads) if head_grads else None,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(train_mode))
+    return None
+
+
+def nd_get_grad(arr):
+    g = arr.grad
+    if g is None:
+        raise ValueError("NDArray has no attached gradient buffer "
+                         "(call MXAutogradMarkVariables first)")
+    return g
+
+
+def sym_infer_shape(sym, keys, flat, ndims, partial):
+    """MXSymbolInferShape[Partial]: returns (arg_shapes, out_shapes,
+    aux_shapes, complete) with each shape a list (or None)."""
+    known, off = {}, 0
+    for k, nd_ in zip(keys, ndims):
+        known[k] = tuple(int(v) for v in flat[off:off + nd_])
+        off += nd_
+    fn = sym.infer_shape_partial if partial else sym.infer_shape
+    args, outs, aux = fn(**known)
+    complete = all(s is not None for s in args) and \
+        all(s is not None for s in outs)
+    to_lists = lambda ss: [None if s is None else [int(v) for v in s]
+                           for s in ss]
+    return to_lists(args), to_lists(outs), to_lists(aux), 1 if complete else 0
 
 
 # -- creator enumeration (MXSymbolListAtomicSymbolCreators block) -----------

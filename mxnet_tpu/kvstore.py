@@ -678,70 +678,44 @@ class KVStoreDistAsync(KVStore):
                 time.sleep(0.01)  # mid-replace; retry
         raise MXNetError("dist_async: cannot read weight %r" % (k,))
 
-    # a live holder only performs <= cap cheap renames; a lock older
-    # than this means its holder died mid-publish
-    _LOCK_STALE_S = 30
-
     def _spool_lock(self, deadline):
-        """O_CREAT|O_EXCL lockfile serializing scan+publish across
-        workers on the shared spool directory.  Returns a context
-        manager; raises MXNetError past ``deadline``.
+        """flock-based lock serializing scan+publish across workers on
+        the shared spool directory.  Returns a context manager; raises
+        MXNetError past ``deadline``.
 
-        Crash-safety protocol: the holder writes a unique identity into
-        the lockfile.  A breaker claims a stale lock (age >
-        ``_LOCK_STALE_S``) by atomically RENAMING it to a private name
-        — only one breaker can win the rename, and a concurrently
-        re-created fresh lock is untouched.  Release unlinks only if
-        the lockfile still carries the holder's own identity, so a
-        broken-then-recreated lock is never deleted out from under its
-        new owner."""
+        ``fcntl.flock`` on a persistent lockfile is the whole protocol:
+        the kernel releases the lock when the holder exits or dies, so
+        there is no stale-lock breaking and therefore no
+        check-then-break TOCTOU window — at most one holder exists at
+        any instant, which is what makes the spool cap EXACT.  (The
+        earlier O_EXCL+mtime-staleness design could steal a freshly
+        re-created lock under clock skew.)"""
         import contextlib
+        import fcntl
         import time
 
         lock_path = os.path.join(self._push_dir, ".spool.lock")
-        ident = "%s:%d:%f" % (os.uname().nodename, os.getpid(),
-                              time.time())
 
         @contextlib.contextmanager
         def _held():
-            while True:
-                try:
-                    fd = os.open(lock_path,
-                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    os.write(fd, ident.encode())
-                    os.close(fd)
-                    break
-                except FileExistsError:
-                    try:
-                        age = time.time() - os.path.getmtime(lock_path)
-                    except OSError:
-                        continue  # released between probes: retry now
-                    if age > self._LOCK_STALE_S:
-                        grave = lock_path + ".broken.%d" % os.getpid()
-                        try:
-                            os.replace(lock_path, grave)  # atomic claim
-                            os.unlink(grave)
-                        except OSError:
-                            pass  # another breaker won the rename
-                        continue
-                    if time.time() > deadline:
-                        raise MXNetError(
-                            "dist_async: spool lock held past the "
-                            "backpressure timeout")
-                    time.sleep(0.002)
+            fd = os.open(lock_path, os.O_CREAT | os.O_WRONLY)
             try:
-                yield
-            finally:
-                try:
-                    with open(lock_path) as f:
-                        still_ours = f.read() == ident
-                except OSError:
-                    still_ours = False  # broken while held
-                if still_ours:
+                while True:
                     try:
-                        os.unlink(lock_path)
-                    except OSError:  # pragma: no cover - raced release
-                        pass
+                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                        break
+                    except OSError:
+                        if time.time() > deadline:
+                            raise MXNetError(
+                                "dist_async: spool lock held past the "
+                                "backpressure timeout")
+                        time.sleep(0.002)
+                try:
+                    yield
+                finally:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
 
         return _held()
 

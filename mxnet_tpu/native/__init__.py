@@ -50,14 +50,19 @@ class _LazyNativeLib:
                 from .. import config as _config
                 if _config.get("MXNET_NATIVE_DISABLE"):
                     return self._lib
-                # rebuild gate: source content hash, not mtime — a fresh
-                # checkout gives .so and .cpp identical mtimes, and these
-                # artifacts are platform- and CPython-ABI-specific (not
-                # Py_LIMITED_API), so a stale foreign binary must never
-                # be dlopen'd instead of rebuilt
+                # rebuild gate: source content hash SALTED with the
+                # interpreter ABI/platform tag — a fresh checkout gives
+                # .so and .cpp identical mtimes, and these artifacts are
+                # platform- and CPython-ABI-specific (not
+                # Py_LIMITED_API), so a binary built by a different
+                # Python or machine must never be dlopen'd
                 import hashlib
+                import sysconfig
+                abi = "%s|%s" % (sysconfig.get_config_var("SOABI"),
+                                 sysconfig.get_platform())
                 with open(self._src, "rb") as f:
-                    src_hash = hashlib.sha256(f.read()).hexdigest()
+                    src_hash = hashlib.sha256(
+                        f.read() + abi.encode()).hexdigest()
                 hash_file = self._so + ".hash"
                 built_hash = None
                 if os.path.exists(hash_file):
@@ -276,6 +281,25 @@ def _declare_c_api(lib):
     lib.MXSymbolGetName.argtypes = [vp, cpp, ctypes.POINTER(ctypes.c_int)]
     lib.MXSymbolGetInternals.argtypes = [vp, ctypes.POINTER(vp)]
     lib.MXSymbolGetOutput.argtypes = [vp, u, ctypes.POINTER(vp)]
+    # autograd block
+    lib.MXAutogradSetIsRecording.argtypes = [ctypes.c_int,
+                                             ctypes.POINTER(ctypes.c_int)]
+    lib.MXAutogradSetIsTraining.argtypes = [ctypes.c_int,
+                                            ctypes.POINTER(ctypes.c_int)]
+    lib.MXAutogradIsRecording.argtypes = [ctypes.POINTER(ctypes.c_bool)]
+    lib.MXAutogradIsTraining.argtypes = [ctypes.POINTER(ctypes.c_bool)]
+    lib.MXAutogradMarkVariables.argtypes = [u, ctypes.POINTER(vp), up,
+                                            ctypes.POINTER(vp)]
+    lib.MXAutogradBackward.argtypes = [u, ctypes.POINTER(vp),
+                                       ctypes.POINTER(vp), ctypes.c_int]
+    lib.MXNDArrayGetGrad.argtypes = [vp, ctypes.POINTER(vp)]
+    # shape inference block
+    upp = ctypes.POINTER(up)
+    uppp = ctypes.POINTER(ctypes.POINTER(up))
+    for f in (lib.MXSymbolInferShape, lib.MXSymbolInferShapePartial):
+        f.argtypes = [vp, u, cpp, up, up,
+                      up, upp, uppp, up, upp, uppp, up, upp, uppp,
+                      ctypes.POINTER(ctypes.c_int)]
     # creator enumeration block
     lib.MXSymbolListAtomicSymbolCreators.argtypes = [
         up, ctypes.POINTER(ctypes.POINTER(vp))]

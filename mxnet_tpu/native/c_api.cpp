@@ -10,11 +10,12 @@
 // running interpreter via ctypes/cffi.
 //
 // Scope: the blocks FFI consumers actually exercise —
-//   - NDArray create/copy/shape/dtype/save/load/wait
+//   - NDArray create/copy/shape/dtype/save/load/wait/slice/at/reshape
 //   - imperative op invocation by registered name (the ENTIRE registry)
-//   - Symbol JSON round-trips + creator enumeration/compose
-//     (MXSymbolListAtomicSymbolCreators family: what ctypes codegen
-//     binds against, reference python/mxnet/base.py)
+//   - autograd record/mark/backward/grad (c_api.h:894-970)
+//   - Symbol JSON round-trips, shape inference, creator
+//     enumeration/compose (MXSymbolListAtomicSymbolCreators family:
+//     what ctypes codegen binds against, reference python/mxnet/base.py)
 //   - executor SimpleBind/Forward/Backward/Outputs
 //     (reference src/c_api/c_api_executor.cc:47,54,132,220)
 //   - KVStore create/init/push/pull (string-keyed Ex family)
@@ -115,6 +116,11 @@ struct Handle {
   std::string text;                     // MXSymbolSaveToJSON scratch
   std::vector<std::string> strs;        // string-list scratch
   std::vector<const char*> ptrs;
+  // per-handle creator-info scratch (GetAtomicSymbolInfo): pointers
+  // stay valid until the NEXT info call on the SAME handle, matching
+  // the reference's per-op ret store — collecting info across many
+  // creators must not invalidate earlier handles' arrays
+  std::vector<const char*> info_names, info_types, info_descs;
 };
 
 Handle* wrap(PyObject* obj) {
@@ -608,6 +614,233 @@ int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
       out);
 }
 
+// -- autograd ---------------------------------------------------------------
+// Reference: include/mxnet/c_api.h:894-970 (Imperative recording state,
+// MarkVariables, Backward).
+
+static int flag_call(const char* fn, int arg, int* prev) {
+  GIL gil;
+  PyObject* r = shim_call(fn, Py_BuildValue("(i)", arg));
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int flag_query(const char* fn, bool* curr) {
+  GIL gil;
+  PyObject* r = shim_call(fn, PyTuple_New(0));
+  if (r == nullptr) return -1;
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  return flag_call("autograd_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  return flag_call("autograd_set_training", is_training, prev);
+}
+
+int MXAutogradIsRecording(bool* curr) {
+  return flag_query("autograd_is_recording", curr);
+}
+
+int MXAutogradIsTraining(bool* curr) {
+  return flag_query("autograd_is_training", curr);
+}
+
+int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
+                            uint32_t* reqs_array,
+                            NDArrayHandle* grad_handles) {
+  GIL gil;
+  // reference grad_req enum: 0=null 1=write 2=add (ndarray.py _GRAD_REQ)
+  static const char* kReq[] = {"null", "write", "add"};
+  PyObject* vars = PyList_New(num_var);
+  PyObject* grads = PyList_New(num_var);
+  PyObject* reqs = PyList_New(num_var);
+  for (uint32_t i = 0; i < num_var; ++i) {
+    PyObject* v = static_cast<Handle*>(var_handles[i])->obj;
+    PyObject* g = static_cast<Handle*>(grad_handles[i])->obj;
+    Py_INCREF(v);
+    Py_INCREF(g);
+    PyList_SET_ITEM(vars, i, v);
+    PyList_SET_ITEM(grads, i, g);
+    uint32_t r = reqs_array == nullptr ? 1u : reqs_array[i];
+    PyList_SET_ITEM(reqs, i,
+                    PyUnicode_FromString(r <= 2 ? kReq[r] : "write"));
+  }
+  PyObject* out = shim_call("autograd_mark_variables",
+                            Py_BuildValue("(NNN)", vars, grads, reqs));
+  if (out == nullptr) return -1;
+  Py_DECREF(out);
+  return 0;
+}
+
+int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph) {
+  GIL gil;
+  PyObject* outs = PyList_New(num_output);
+  for (uint32_t i = 0; i < num_output; ++i) {
+    PyObject* o = static_cast<Handle*>(output_handles[i])->obj;
+    Py_INCREF(o);
+    PyList_SET_ITEM(outs, i, o);
+  }
+  PyObject* ogs;
+  if (ograd_handles == nullptr) {
+    ogs = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    ogs = PyList_New(num_output);
+    for (uint32_t i = 0; i < num_output; ++i) {
+      PyObject* o = static_cast<Handle*>(ograd_handles[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(ogs, i, o);
+    }
+  }
+  PyObject* r = shim_call(
+      "autograd_backward",
+      Py_BuildValue("(NNii)", outs, ogs, retain_graph, /*train_mode=*/1));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  return obj_to_handle(
+      shim_call("nd_get_grad", Py_BuildValue("(O)", h->obj)), out);
+}
+
+// -- shape inference --------------------------------------------------------
+// Reference: MXSymbolInferShape / MXSymbolInferShapePartial
+// (src/c_api/c_api_symbolic.cc).  Scratch layout: all shapes flattened
+// into per-handle vectors whose pointers stay valid until the next
+// infer call on the same symbol handle.
+
+struct ShapeScratch {
+  std::vector<uint32_t> ndims;
+  std::vector<uint32_t> flat;
+  std::vector<const uint32_t*> ptrs;
+};
+thread_local ShapeScratch g_shape_scratch[3];
+
+static void pack_shapes(PyObject* list, ShapeScratch* s, uint32_t* size,
+                        const uint32_t** ndim_out,
+                        const uint32_t*** data_out) {
+  Py_ssize_t n = PyList_Size(list);
+  s->ndims.clear();
+  s->flat.clear();
+  std::vector<size_t> offsets;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* shp = PyList_GetItem(list, i);
+    offsets.push_back(s->flat.size());
+    if (shp == Py_None) {
+      s->ndims.push_back(0);
+      continue;
+    }
+    Py_ssize_t d = PyList_Size(shp);
+    s->ndims.push_back(static_cast<uint32_t>(d));
+    for (Py_ssize_t j = 0; j < d; ++j) {
+      s->flat.push_back(static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyList_GetItem(shp, j))));
+    }
+  }
+  s->ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    s->ptrs.push_back(s->flat.data() + offsets[i]);
+  }
+  *size = static_cast<uint32_t>(n);
+  *ndim_out = s->ndims.data();
+  *data_out = s->ptrs.data();
+}
+
+static int infer_shape_impl(SymbolHandle sym, uint32_t num_args,
+                            const char** keys, const uint32_t* arg_ind_ptr,
+                            const uint32_t* arg_shape_data, int partial,
+                            uint32_t* in_shape_size,
+                            const uint32_t** in_shape_ndim,
+                            const uint32_t*** in_shape_data,
+                            uint32_t* out_shape_size,
+                            const uint32_t** out_shape_ndim,
+                            const uint32_t*** out_shape_data,
+                            uint32_t* aux_shape_size,
+                            const uint32_t** aux_shape_ndim,
+                            const uint32_t*** aux_shape_data,
+                            int* complete) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* ks = PyList_New(num_args);
+  PyObject* nds = PyList_New(num_args);
+  size_t total = num_args == 0 ? 0 : arg_ind_ptr[num_args];
+  PyObject* flat = PyList_New(total);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(nds, i, PyLong_FromUnsignedLong(
+        arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
+  }
+  for (size_t i = 0; i < total; ++i) {
+    PyList_SET_ITEM(flat, i,
+                    PyLong_FromUnsignedLong(arg_shape_data[i]));
+  }
+  PyObject* r = shim_call(
+      "sym_infer_shape",
+      Py_BuildValue("(ONNNi)", h->obj, ks, flat, nds, partial));
+  if (r == nullptr) return -1;
+  pack_shapes(PyTuple_GetItem(r, 0), &g_shape_scratch[0], in_shape_size,
+              in_shape_ndim, in_shape_data);
+  pack_shapes(PyTuple_GetItem(r, 1), &g_shape_scratch[1], out_shape_size,
+              out_shape_ndim, out_shape_data);
+  pack_shapes(PyTuple_GetItem(r, 2), &g_shape_scratch[2], aux_shape_size,
+              aux_shape_ndim, aux_shape_data);
+  *complete = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle sym, uint32_t num_args,
+                       const char** keys, const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          0, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, uint32_t num_args,
+                              const char** keys,
+                              const uint32_t* arg_ind_ptr,
+                              const uint32_t* arg_shape_data,
+                              uint32_t* in_shape_size,
+                              const uint32_t** in_shape_ndim,
+                              const uint32_t*** in_shape_data,
+                              uint32_t* out_shape_size,
+                              const uint32_t** out_shape_ndim,
+                              const uint32_t*** out_shape_data,
+                              uint32_t* aux_shape_size,
+                              const uint32_t** aux_shape_ndim,
+                              const uint32_t*** aux_shape_data,
+                              int* complete) {
+  return infer_shape_impl(sym, num_args, keys, arg_ind_ptr, arg_shape_data,
+                          1, in_shape_size, in_shape_ndim, in_shape_data,
+                          out_shape_size, out_shape_ndim, out_shape_data,
+                          aux_shape_size, aux_shape_ndim, aux_shape_data,
+                          complete);
+}
+
 // -- creator enumeration ----------------------------------------------------
 // Reference: MXSymbolListAtomicSymbolCreators + GetAtomicSymbolInfo
 // (src/c_api/c_api_symbolic.cc) — the surface ctypes codegen binds
@@ -687,16 +920,17 @@ int MXSymbolGetAtomicSymbolInfo(
   *key_var_num_args = h->ptrs[2];
   if (return_type != nullptr) *return_type = h->ptrs[3];
   *num_args = static_cast<uint32_t>(n);
-  static thread_local std::vector<const char*> names_v, types_v, descs_v;
-  names_v.clear(); types_v.clear(); descs_v.clear();
+  h->info_names.clear();
+  h->info_types.clear();
+  h->info_descs.clear();
   for (Py_ssize_t i = 0; i < n; ++i) {
-    names_v.push_back(h->ptrs[4 + 3 * i]);
-    types_v.push_back(h->ptrs[4 + 3 * i + 1]);
-    descs_v.push_back(h->ptrs[4 + 3 * i + 2]);
+    h->info_names.push_back(h->ptrs[4 + 3 * i]);
+    h->info_types.push_back(h->ptrs[4 + 3 * i + 1]);
+    h->info_descs.push_back(h->ptrs[4 + 3 * i + 2]);
   }
-  *arg_names = names_v.data();
-  *arg_type_infos = types_v.data();
-  *arg_descriptions = descs_v.data();
+  *arg_names = h->info_names.data();
+  *arg_type_infos = h->info_types.data();
+  *arg_descriptions = h->info_descs.data();
   return 0;
 }
 

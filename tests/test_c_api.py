@@ -479,3 +479,68 @@ def test_symbol_views_block(lib):
     assert n.value == 1
     for handle in (cp, internals, head, h):
         _check(lib.MXSymbolFree(handle), lib)
+
+
+def test_autograd_block(lib):
+    # record x*x through the C autograd ABI, backward, read x.grad
+    prev = ctypes.c_int()
+    _check(lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)), lib)
+    assert prev.value == 0
+    curr = ctypes.c_bool()
+    _check(lib.MXAutogradIsRecording(ctypes.byref(curr)), lib)
+    assert curr.value
+    x = _nd_from_np(lib, np.array([1.0, 2.0, 3.0], np.float32))
+    g = _nd_from_np(lib, np.zeros(3, np.float32))
+    vars_ = (ctypes.c_void_p * 1)(x.value)
+    grads = (ctypes.c_void_p * 1)(g.value)
+    reqs = (ctypes.c_uint * 1)(1)  # write
+    _check(lib.MXAutogradMarkVariables(1, vars_, reqs, grads), lib)
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    hs = (ctypes.c_void_p * 2)(x.value, x.value)
+    _check(lib.MXImperativeInvokeByName(
+        b"elemwise_mul", 2, hs, ctypes.byref(n_out), ctypes.byref(outs),
+        0, None, None), lib)
+    y = ctypes.c_void_p(outs[0])
+    _check(lib.MXAutogradBackward(1, (ctypes.c_void_p * 1)(y.value),
+                                  None, 0), lib)
+    _check(lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)), lib)
+    gh = ctypes.c_void_p()
+    _check(lib.MXNDArrayGetGrad(x, ctypes.byref(gh)), lib)
+    got = _nd_to_np(lib, gh)
+    assert np.allclose(got, 2 * np.array([1.0, 2.0, 3.0]))  # d(x^2)/dx
+
+
+def test_infer_shape_block(lib):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                name="fc")
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                      ctypes.byref(h)), lib)
+    keys = (ctypes.c_char_p * 1)(b"data")
+    ind_ptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(5, 3)
+    in_n = ctypes.c_uint()
+    out_n = ctypes.c_uint()
+    aux_n = ctypes.c_uint()
+    in_nd = ctypes.POINTER(ctypes.c_uint)()
+    out_nd = ctypes.POINTER(ctypes.c_uint)()
+    aux_nd = ctypes.POINTER(ctypes.c_uint)()
+    in_d = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    out_d = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    aux_d = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+    complete = ctypes.c_int()
+    _check(lib.MXSymbolInferShape(
+        h, 1, keys, ind_ptr, shape_data,
+        ctypes.byref(in_n), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_n), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_n), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(complete)), lib)
+    assert complete.value == 1
+    def shapes(n, nd_, d):
+        return [tuple(d[i][j] for j in range(nd_[i])) for i in range(n.value)]
+    args = shapes(in_n, in_nd, in_d)
+    # data, fc_weight, fc_bias
+    assert args == [(5, 3), (8, 3), (8,)]
+    assert shapes(out_n, out_nd, out_d) == [(5, 8)]
+    _check(lib.MXSymbolFree(h), lib)
