@@ -187,6 +187,76 @@ def sym_get_output(sym, index):
     return sym[int(index)]
 
 
+# -- NDArray raw bytes / Symbol files & attrs / executor reshape ------------
+
+def nd_save_raw(arr):
+    """MXNDArraySaveRawBytes: one V2 serialization record as bytes."""
+    import io
+
+    from .ndarray.ndarray import _write_ndarray
+    buf = io.BytesIO()
+    _write_ndarray(buf, arr)
+    return buf.getvalue()
+
+
+def nd_load_raw(raw):
+    """MXNDArrayLoadFromRawBytes."""
+    import io
+
+    from .ndarray.ndarray import _read_ndarray
+    return _read_ndarray(io.BytesIO(bytes(raw)))
+
+
+def sym_save_file(sym, fname):
+    sym.save(fname)
+    return None
+
+
+def sym_load_file(fname):
+    from . import symbol as sym_mod
+    return sym_mod.load(fname)
+
+
+def sym_attr_get(sym, key):
+    v = sym.attr(key)
+    return v  # None -> success=0 on the C side
+
+
+def sym_attr_set(sym, key, value):
+    sym._set_attr(**{key: value})
+    return None
+
+
+def sym_attr_list(sym):
+    """MXSymbolListAttr: recursive, reference 'name$key' encoding —
+    a flat [k0, v0, k1, v1, ...] list."""
+    out = []
+    for node, attrs in sym.attr_dict().items():
+        for k, v in attrs.items():
+            out.extend(["%s$%s" % (node, k), str(v)])
+    return out
+
+
+def sym_attr_list_shallow(sym):
+    # stringify ALL head-node attrs (the reference stores attrs as
+    # str->str, so its shallow listing never drops entries; Python-side
+    # list_attr()'s str-only filter must not leak into the ABI)
+    out = []
+    for k, v in sym._heads[0][0].attrs.items():
+        out.extend([k, str(v)])
+    return out
+
+
+def exec_reshape(exe, shape_keys, shape_flat, shape_ndims,
+                 partial_shaping, allow_up_sizing):
+    shapes, off = {}, 0
+    for k, nd_ in zip(shape_keys, shape_ndims):
+        shapes[k] = tuple(int(v) for v in shape_flat[off:off + nd_])
+        off += nd_
+    return exe.reshape(partial_shaping=bool(partial_shaping),
+                       allow_up_sizing=bool(allow_up_sizing), **shapes)
+
+
 # -- autograd (MXAutograd* block) -------------------------------------------
 # Reference: include/mxnet/c_api.h:894-970 over Imperative::Get()'s
 # recording state; here the tape lives in mxnet_tpu.autograd.

@@ -526,9 +526,36 @@ class Executor:
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Rebind with new data shapes, sharing parameter arrays
         (reference: MXExecutorReshape — bucketing/variable batch).  On TPU
-        this is a new jit cache entry; XLA recompiles per shape."""
+        this is a new jit cache entry; XLA recompiles per shape.
+
+        Flag contract (reference src/c_api/c_api_executor.cc Reshape):
+        growing a PROVIDED argument needs ``allow_up_sizing=True``; a
+        shape change inferred onto an UNSPECIFIED argument (typically a
+        parameter, whose trained values would be replaced) needs
+        ``partial_shaping=True`` — silently zeroing weights is exactly
+        the failure this guards."""
+        import numpy as _np
+
         new_shapes = {k: tuple(v) for k, v in kwargs.items()}
         shapes, _, aux_shapes = _infer_graph(self._symbol, dict(new_shapes), {})
+        for n in self.arg_names:
+            cur = self.arg_dict[n].shape
+            new = shapes.get(n)
+            if n in new_shapes:
+                if new is not None and \
+                        _np.prod(new, dtype=_np.int64) > \
+                        _np.prod(cur, dtype=_np.int64) and \
+                        not allow_up_sizing:
+                    raise MXNetError(
+                        "reshape: arg %r grows %s -> %s; set "
+                        "allow_up_sizing=True to permit reallocation"
+                        % (n, cur, new))
+            elif new is not None and new != cur and not partial_shaping:
+                raise MXNetError(
+                    "reshape: unspecified arg %r would change shape "
+                    "%s -> %s (its contents would be re-initialized); "
+                    "set partial_shaping=True to permit this"
+                    % (n, cur, new))
         arg_dict, grad_dict = {}, {}
         for n in self.arg_names:
             if n in new_shapes or shapes.get(n) != self.arg_dict[n].shape:

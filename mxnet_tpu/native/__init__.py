@@ -281,6 +281,23 @@ def _declare_c_api(lib):
     lib.MXSymbolGetName.argtypes = [vp, cpp, ctypes.POINTER(ctypes.c_int)]
     lib.MXSymbolGetInternals.argtypes = [vp, ctypes.POINTER(vp)]
     lib.MXSymbolGetOutput.argtypes = [vp, u, ctypes.POINTER(vp)]
+    # raw bytes / symbol files & attrs / reshape block
+    lib.MXNDArraySaveRawBytes.argtypes = [
+        vp, ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_char_p)]
+    lib.MXNDArrayLoadFromRawBytes.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.POINTER(vp)]
+    lib.MXSymbolCreateFromFile.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(vp)]
+    lib.MXSymbolSaveToFile.argtypes = [vp, ctypes.c_char_p]
+    lib.MXSymbolGetAttr.argtypes = [vp, ctypes.c_char_p, cpp,
+                                    ctypes.POINTER(ctypes.c_int)]
+    lib.MXSymbolSetAttr.argtypes = [vp, ctypes.c_char_p, ctypes.c_char_p]
+    for f in (lib.MXSymbolListAttr, lib.MXSymbolListAttrShallow):
+        f.argtypes = [vp, up, ctypes.POINTER(cpp)]
+    lib.MXExecutorReshape.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, u, cpp,
+        up, up, vp, ctypes.POINTER(vp)]
     # autograd block
     lib.MXAutogradSetIsRecording.argtypes = [ctypes.c_int,
                                              ctypes.POINTER(ctypes.c_int)]

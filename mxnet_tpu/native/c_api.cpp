@@ -614,6 +614,151 @@ int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
       out);
 }
 
+// -- NDArray raw bytes ------------------------------------------------------
+// Reference: c_api.h:480,490 (one V2 serialization record in memory).
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* raw = shim_call("nd_save_raw", Py_BuildValue("(O)", h->obj));
+  if (raw == nullptr) return -1;
+  char* buf = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(raw, &buf, &len) != 0) {
+    capture_py_error();
+    Py_DECREF(raw);
+    return -1;
+  }
+  h->text.assign(buf, static_cast<size_t>(len));  // binary-safe scratch
+  Py_DECREF(raw);
+  *out_size = h->text.size();
+  *out_buf = h->text.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  GIL gil;
+  PyObject* raw = PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), static_cast<Py_ssize_t>(size));
+  return obj_to_handle(shim_call("nd_load_raw",
+                                 Py_BuildValue("(N)", raw)), out);
+}
+
+// -- Symbol files & attributes ----------------------------------------------
+// Reference: c_api.h:1114,1128,1174,1194,1204,1214.
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  GIL gil;
+  return obj_to_handle(
+      shim_call("sym_load_file", Py_BuildValue("(s)", fname)), out);
+}
+
+int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* r = shim_call("sym_save_file",
+                          Py_BuildValue("(Os)", h->obj, fname));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
+                    int* success) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* v = shim_call("sym_attr_get",
+                          Py_BuildValue("(Os)", h->obj, key));
+  if (v == nullptr) return -1;
+  if (v == Py_None) {
+    *success = 0;
+    *out = nullptr;
+  } else {
+    PyObject* s = PyObject_Str(v);
+    const char* c = s == nullptr ? nullptr : PyUnicode_AsUTF8(s);
+    if (c == nullptr) {
+      capture_py_error();
+      Py_XDECREF(s);
+      Py_DECREF(v);
+      return -1;
+    }
+    h->text = c;
+    Py_DECREF(s);
+    *success = 1;
+    *out = h->text.c_str();
+  }
+  Py_DECREF(v);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* r = shim_call("sym_attr_set",
+                          Py_BuildValue("(Oss)", h->obj, key, value));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int attr_list_impl(SymbolHandle sym, const char* shim_fn,
+                          uint32_t* out_size, const char*** out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(sym);
+  PyObject* l = shim_call(shim_fn, Py_BuildValue("(O)", h->obj));
+  if (l == nullptr) return -1;
+  uint32_t pairs_x2 = 0;
+  int rc = fill_str_list(h, l, &pairs_x2, out);
+  Py_DECREF(l);
+  // reference returns the PAIR count; the array holds 2*out_size
+  *out_size = pairs_x2 / 2;
+  return rc;
+}
+
+int MXSymbolListAttr(SymbolHandle sym, uint32_t* out_size,
+                     const char*** out) {
+  return attr_list_impl(sym, "sym_attr_list", out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle sym, uint32_t* out_size,
+                            const char*** out) {
+  return attr_list_impl(sym, "sym_attr_list_shallow", out_size, out);
+}
+
+// -- executor reshape -------------------------------------------------------
+// Reference: MXExecutorReshape (bucketing / variable batch); returns a
+// NEW executor sharing parameter arrays.
+
+int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
+                      int dev_type, int dev_id, uint32_t num_provided,
+                      const char** shape_keys, const uint32_t* shape_data,
+                      const uint32_t* shape_ndims,
+                      /*ExecutorHandle*/ void* shared,
+                      /*ExecutorHandle*/ void** out) {
+  (void)dev_type; (void)dev_id;
+  GIL gil;
+  Handle* h = static_cast<Handle*>(shared);
+  PyObject* ks = PyList_New(num_provided);
+  PyObject* nds = PyList_New(num_provided);
+  size_t total = 0;
+  for (uint32_t i = 0; i < num_provided; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(shape_keys[i]));
+    PyList_SET_ITEM(nds, i, PyLong_FromUnsignedLong(shape_ndims[i]));
+    total += shape_ndims[i];
+  }
+  PyObject* flat = PyList_New(total);
+  for (size_t i = 0; i < total; ++i) {
+    PyList_SET_ITEM(flat, i, PyLong_FromUnsignedLong(shape_data[i]));
+  }
+  return obj_to_handle(
+      shim_call("exec_reshape",
+                Py_BuildValue("(ONNNii)", h->obj, ks, flat, nds,
+                              partial_shaping, allow_up_sizing)),
+      out);
+}
+
 // -- autograd ---------------------------------------------------------------
 // Reference: include/mxnet/c_api.h:894-970 (Imperative recording state,
 // MarkVariables, Backward).

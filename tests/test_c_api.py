@@ -544,3 +544,95 @@ def test_infer_shape_block(lib):
     assert args == [(5, 3), (8, 3), (8,)]
     assert shapes(out_n, out_nd, out_d) == [(5, 8)]
     _check(lib.MXSymbolFree(h), lib)
+
+
+def test_raw_bytes_roundtrip(lib):
+    a = np.random.RandomState(5).rand(3, 5).astype(np.float32)
+    h = _nd_from_np(lib, a)
+    size = ctypes.c_size_t()
+    buf = ctypes.c_char_p()
+    _check(lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                     ctypes.byref(buf)), lib)
+    raw = ctypes.string_at(buf, size.value)
+    h2 = ctypes.c_void_p()
+    _check(lib.MXNDArrayLoadFromRawBytes(raw, len(raw),
+                                         ctypes.byref(h2)), lib)
+    assert np.allclose(_nd_to_np(lib, h2), a)
+    for hh in (h, h2):
+        _check(lib.MXNDArrayFree(hh), lib)
+
+
+def test_symbol_file_and_attrs(lib, tmp_path):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                      ctypes.byref(h)), lib)
+    # set + get an attr through the ABI
+    _check(lib.MXSymbolSetAttr(h, b"lr_mult", b"2.5"), lib)
+    out = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    _check(lib.MXSymbolGetAttr(h, b"lr_mult", ctypes.byref(out),
+                               ctypes.byref(ok)), lib)
+    assert ok.value == 1 and out.value == b"2.5"
+    _check(lib.MXSymbolGetAttr(h, b"nope", ctypes.byref(out),
+                               ctypes.byref(ok)), lib)
+    assert ok.value == 0
+    # deep listing carries the name$key encoding
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _check(lib.MXSymbolListAttr(h, ctypes.byref(n), ctypes.byref(arr)), lib)
+    pairs = {arr[2 * i].decode(): arr[2 * i + 1].decode()
+             for i in range(n.value)}
+    assert pairs.get("fc$lr_mult") == "2.5"
+    # file round-trip
+    fname = str(tmp_path / "sym.json").encode()
+    _check(lib.MXSymbolSaveToFile(h, fname), lib)
+    h2 = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromFile(fname, ctypes.byref(h2)), lib)
+    _check(lib.MXSymbolListArguments(h2, ctypes.byref(n),
+                                     ctypes.byref(arr)), lib)
+    assert [arr[i].decode() for i in range(n.value)] == \
+        ["data", "fc_weight", "fc_bias"]
+    for hh in (h, h2):
+        _check(lib.MXSymbolFree(hh), lib)
+
+
+def test_executor_reshape(lib):
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                      ctypes.byref(h)), lib)
+    skeys = (ctypes.c_char_p * 1)(b"data")
+    sdata = (ctypes.c_uint * 2)(8, 3)
+    sndims = (ctypes.c_uint * 1)(2)
+    exe = ctypes.c_void_p()
+    _check(lib.MXExecutorSimpleBind(h, 1, 0, b"write", 1, skeys, sdata,
+                                    sndims, ctypes.byref(exe)), lib)
+    sdata2 = (ctypes.c_uint * 2)(16, 3)
+    exe2 = ctypes.c_void_p()
+    # growing without allow_up_sizing errors (reference contract)
+    rc = lib.MXExecutorReshape(0, 0, 1, 0, 1, skeys, sdata2, sndims,
+                               exe, ctypes.byref(exe2))
+    assert rc != 0 and b"allow_up_sizing" in lib.MXGetLastError()
+    _check(lib.MXExecutorReshape(0, 1, 1, 0, 1, skeys, sdata2, sndims,
+                                 exe, ctypes.byref(exe2)), lib)
+    na = ctypes.c_uint()
+    args_p = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXExecutorArgArrays(exe2, ctypes.byref(na),
+                                   ctypes.byref(args_p)), lib)
+    dim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    _check(lib.MXNDArrayGetShape(ctypes.c_void_p(args_p[0]),
+                                 ctypes.byref(dim), ctypes.byref(pdata)),
+           lib)
+    assert tuple(pdata[i] for i in range(dim.value)) == (16, 3)
+    _check(lib.MXExecutorForward(exe2, 0), lib)
+    no = ctypes.c_uint()
+    outs_p = ctypes.POINTER(ctypes.c_void_p)()
+    _check(lib.MXExecutorOutputs(exe2, ctypes.byref(no),
+                                 ctypes.byref(outs_p)), lib)
+    assert _nd_to_np(lib, ctypes.c_void_p(outs_p[0])).shape == (16, 4)
+    for e in (exe, exe2):
+        _check(lib.MXExecutorFree(e), lib)

@@ -1,9 +1,11 @@
 """Symbol + Executor tests (reference: tests/python/unittest/test_symbol.py,
 test_executor.py, test_infer_shape.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.test_utils import assert_almost_equal, same
 
 
@@ -115,11 +117,26 @@ def test_symbol_arithmetic_scalar():
 
 def test_executor_reshape():
     net = _mlp()
-    exe = net.simple_bind(ctx=mx.current_context(), data=(4, 6))
-    exe2 = exe.reshape(data=(8, 6))
-    assert exe2.arg_dict["data"].shape == (8, 6)
-    # params shared
+    exe = net.simple_bind(ctx=mx.current_context(), data=(8, 6))
+    # shrinking (all batch-dependent args provided) shares params and
+    # needs no flags
+    exe2 = exe.reshape(data=(4, 6), softmax_label=(4,))
+    assert exe2.arg_dict["data"].shape == (4, 6)
     assert exe2.arg_dict["fc1_weight"] is exe.arg_dict["fc1_weight"]
+    # growing a provided arg requires allow_up_sizing (reference
+    # MXExecutorReshape contract)
+    with pytest.raises(MXNetError, match="allow_up_sizing"):
+        exe.reshape(data=(16, 6), softmax_label=(16,))
+    exe3 = exe.reshape(data=(16, 6), softmax_label=(16,),
+                       allow_up_sizing=True)
+    assert exe3.arg_dict["data"].shape == (16, 6)
+    # changing an UNSPECIFIED arg's inferred shape (here the label via
+    # the batch dim — same guard protects trained weights) requires
+    # partial_shaping: contents get re-initialized, never silently
+    with pytest.raises(MXNetError, match="partial_shaping"):
+        exe.reshape(data=(4, 6))
+    exe4 = exe.reshape(data=(8, 4), partial_shaping=True)
+    assert exe4.arg_dict["fc1_weight"].shape[1] == 4
 
 
 def test_aux_states_batchnorm():
