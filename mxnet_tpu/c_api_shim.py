@@ -257,6 +257,41 @@ def exec_reshape(exe, shape_keys, shape_flat, shape_ndims,
                        allow_up_sizing=bool(allow_up_sizing), **shapes)
 
 
+# -- profiler (MXSetProfilerConfig/State, MXDumpProfile) --------------------
+
+_PROFILER_PATH_KEYS = frozenset({"filename", "jax_trace_dir"})
+
+
+def profiler_set_config(keys, vals):
+    from . import profiler
+    from .ops.registry import coerce_attrs
+    raw = dict(zip(keys, vals))
+    # path-valued params stay verbatim strings — coercion would turn
+    # "1" / "true" into non-str values that later break open()
+    cfg = coerce_attrs({k: v for k, v in raw.items()
+                        if k not in _PROFILER_PATH_KEYS})
+    cfg.update({k: v for k, v in raw.items() if k in _PROFILER_PATH_KEYS})
+    profiler.set_config(**cfg)
+    return None
+
+
+def profiler_set_state(state):
+    from . import profiler
+    profiler.set_state("run" if int(state) else "stop")
+    return None
+
+
+def profiler_dump(finished):
+    from . import profiler
+    profiler.dump(finished=bool(finished))
+    return None
+
+
+def kv_barrier(kv):
+    kv.barrier()
+    return None
+
+
 # -- autograd (MXAutograd* block) -------------------------------------------
 # Reference: include/mxnet/c_api.h:894-970 over Imperative::Get()'s
 # recording state; here the tape lives in mxnet_tpu.autograd.

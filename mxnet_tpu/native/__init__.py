@@ -281,6 +281,11 @@ def _declare_c_api(lib):
     lib.MXSymbolGetName.argtypes = [vp, cpp, ctypes.POINTER(ctypes.c_int)]
     lib.MXSymbolGetInternals.argtypes = [vp, ctypes.POINTER(vp)]
     lib.MXSymbolGetOutput.argtypes = [vp, u, ctypes.POINTER(vp)]
+    # profiler / kv barrier block
+    lib.MXSetProfilerConfig.argtypes = [ctypes.c_int, cpp, cpp]
+    lib.MXSetProfilerState.argtypes = [ctypes.c_int]
+    lib.MXDumpProfile.argtypes = [ctypes.c_int]
+    lib.MXKVStoreBarrier.argtypes = [vp]
     # raw bytes / symbol files & attrs / reshape block
     lib.MXNDArraySaveRawBytes.argtypes = [
         vp, ctypes.POINTER(ctypes.c_size_t),

@@ -614,6 +614,52 @@ int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
       out);
 }
 
+// -- profiler ---------------------------------------------------------------
+// Reference: MXSetProfilerConfig/MXSetProfilerState/MXDumpProfile
+// (c_api.h profiler block) over the chrome-trace profiler.
+
+int MXSetProfilerConfig(int num_params, const char* const* keys,
+                        const char* const* vals) {
+  GIL gil;
+  PyObject* ks = PyList_New(num_params);
+  PyObject* vs = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vs, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject* r = shim_call("profiler_set_config",
+                          Py_BuildValue("(NN)", ks, vs));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  GIL gil;
+  PyObject* r = shim_call("profiler_set_state",
+                          Py_BuildValue("(i)", state));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProfile(int finished) {
+  GIL gil;
+  PyObject* r = shim_call("profiler_dump", Py_BuildValue("(i)", finished));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreBarrier(void* handle) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* r = shim_call("kv_barrier", Py_BuildValue("(O)", h->obj));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 // -- NDArray raw bytes ------------------------------------------------------
 // Reference: c_api.h:480,490 (one V2 serialization record in memory).
 

@@ -636,3 +636,30 @@ def test_executor_reshape(lib):
     assert _nd_to_np(lib, ctypes.c_void_p(outs_p[0])).shape == (16, 4)
     for e in (exe, exe2):
         _check(lib.MXExecutorFree(e), lib)
+
+
+def test_profiler_and_kv_barrier_block(lib, tmp_path):
+    out = str(tmp_path / "prof.json")
+    keys = (ctypes.c_char_p * 2)(b"filename", b"aggregate_stats")
+    vals = (ctypes.c_char_p * 2)(out.encode(), b"true")
+    _check(lib.MXSetProfilerConfig(2, keys, vals), lib)
+    _check(lib.MXSetProfilerState(1), lib)
+    # do some work while profiling, through the ABI
+    h = _nd_from_np(lib, np.ones((4, 4), np.float32))
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    hs = (ctypes.c_void_p * 2)(h.value, h.value)
+    _check(lib.MXImperativeInvokeByName(
+        b"elemwise_add", 2, hs, ctypes.byref(n_out), ctypes.byref(outs),
+        0, None, None), lib)
+    _check(lib.MXSetProfilerState(0), lib)
+    _check(lib.MXDumpProfile(1), lib)
+    import json
+    with open(out) as f:
+        trace = json.load(f)
+    assert "traceEvents" in trace
+    # kv barrier is a no-op locally but must succeed through the ABI
+    kv = ctypes.c_void_p()
+    _check(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)), lib)
+    _check(lib.MXKVStoreBarrier(kv), lib)
+    _check(lib.MXKVStoreFree(kv), lib)
