@@ -97,6 +97,10 @@ register_env("MXNET_KVSTORE_HEARTBEAT_DIR", str, None,
 register_env("MXNET_CONV_LAYOUT", str, None,
              "set to NHWC to run 2-D conv/pool internally channel-last "
              "(layout experiment; XLA folds the boundary transposes)")
+register_env("MXNET_BENCH_SECONDARY_BUDGET_S", float, 600.0,
+             "bench.py wall budget for the secondary NHWC/rider legs; "
+             "legs that no longer fit are marked skipped in the side "
+             "JSON files instead of risking an external kill")
 register_env("MXNET_FUSED_METRIC", str, None,
              "set to 0 to disable the one-dispatch jitted Accuracy "
              "accumulate (falls back to per-op device calls)")
