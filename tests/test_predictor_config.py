@@ -102,3 +102,22 @@ def test_role_predicates():
     assert mx.kvstore.is_worker_node()
     assert not mx.kvstore.is_server_node()
     assert mx.kvstore.is_scheduler_node()   # process 0 is the coordinator
+
+
+def test_every_registered_env_var_is_documented():
+    """docs/faq/env_var.md is the contract surface for knobs (reference
+    docs/faq/env_var.md documents its env registry); every var in the
+    live config registry must appear there — a new register_env without
+    a docs row fails here, so the doc cannot drift."""
+    from mxnet_tpu import config
+    doc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "faq", "env_var.md")
+    with open(doc) as f:
+        text = f.read()
+    import re
+    # word-boundary match: a var must appear as its own token, not as a
+    # substring of a longer documented name
+    missing = [name for name in config._REGISTRY
+               if not re.search(r"\b%s\b" % re.escape(name), text)]
+    assert not missing, \
+        "registered env vars missing from docs/faq/env_var.md: %s" % missing
