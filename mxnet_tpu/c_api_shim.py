@@ -341,9 +341,24 @@ def nd_get_grad(arr):
 
 def sym_infer_shape(sym, keys, flat, ndims, partial):
     """MXSymbolInferShape[Partial]: returns (arg_shapes, out_shapes,
-    aux_shapes, complete) with each shape a list (or None)."""
+    aux_shapes, complete) with each shape a list (or None).
+
+    ``keys`` is None in the reference's positional mode (C callers pass
+    keys==NULL): the flattened shapes map onto ``list_arguments()``
+    order, with ndim-0 entries meaning "unknown, infer it"."""
+    positional = keys is None
+    if positional:
+        order = sym.list_arguments()
+        if len(ndims) > len(order):
+            raise ValueError(
+                "positional infer_shape got %d shapes for %d arguments"
+                % (len(ndims), len(order)))
+        keys = order[:len(ndims)]
     known, off = {}, 0
     for k, nd_ in zip(keys, ndims):
+        if positional and nd_ == 0:
+            off += nd_
+            continue
         known[k] = tuple(int(v) for v in flat[off:off + nd_])
         off += nd_
     fn = sym.infer_shape_partial if partial else sym.infer_shape

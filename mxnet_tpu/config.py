@@ -118,3 +118,20 @@ register_env("MXNET_KVSTORE_ASYNC_MAX_PENDING", int, 64,
 register_env("MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT", float, 120.0,
              "seconds a dist_async push may block on a full spool "
              "before raising (a dead server thread, not staleness)")
+register_env("MXNET_SERVING_MAX_BATCH", int, 8,
+             "largest serving shape bucket; the micro-batcher coalesces "
+             "concurrent requests up to this many rows per dispatch")
+register_env("MXNET_SERVING_QUEUE_DEPTH", int, 256,
+             "bounded serving request queue; submissions beyond this "
+             "depth are rejected with QueueFull (explicit backpressure)")
+register_env("MXNET_SERVING_BATCH_WAIT_MS", float, 2.0,
+             "how long the micro-batcher holds a head-of-line request "
+             "for co-batchable arrivals before dispatching a partial "
+             "bucket")
+register_env("MXNET_SERVING_DEFAULT_TIMEOUT_MS", float, 5000.0,
+             "per-request serving deadline when infer() passes none; "
+             "expired requests fail with DeadlineExceeded and are "
+             "skipped by the batcher")
+register_env("MXNET_SERVING_EXECUTOR_CACHE", int, 16,
+             "LRU capacity of the serving executor cache, in bound "
+             "(model, version, bucket) programs; misses are recompiles")

@@ -25,7 +25,7 @@ import contextlib
 import threading
 
 __all__ = ["bulk", "set_bulk_size", "record_exception", "check_raise",
-           "clear_exception", "naive", "naive_scope_active"]
+           "clear_exception", "naive", "naive_scope_active", "worker_scope"]
 
 _NAIVE_DEPTH = [0]
 
@@ -83,6 +83,33 @@ def consume_exception(exc):
     with _EXC_LOCK:
         if _DEFERRED_EXC and _DEFERRED_EXC[0] is exc:
             _DEFERRED_EXC.clear()
+
+
+@contextlib.contextmanager
+def worker_scope(deliver=None):
+    """Exception routing for persistent worker threads (the reference's
+    ThreadedEngine contract: a failed job poisons ITS waiters, never the
+    worker loop — OnCompleteStatic captures into opr->exception_ptr and
+    the thread keeps draining its queue).
+
+    Code in the scope that raises does not propagate: the exception is
+    first offered to ``deliver(exc)`` — e.g. the serving batcher failing
+    the poisoned batch's own request futures — and only when delivery
+    reports no live receiver (``deliver`` absent, falsy return, or
+    itself raising) does it fall back to :func:`record_exception`, so an
+    orphaned error still surfaces at the next global sync point instead
+    of disappearing with the thread."""
+    try:
+        yield
+    except Exception as exc:   # noqa: BLE001 — worker loop must survive
+        delivered = False
+        if deliver is not None:
+            try:
+                delivered = bool(deliver(exc))
+            except Exception:
+                delivered = False
+        if not delivered:
+            record_exception(exc)
 
 
 def set_bulk_size(size):

@@ -849,12 +849,21 @@ int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
   GIL gil;
   // reference grad_req enum: 0=null 1=write 2=add (ndarray.py _GRAD_REQ)
   static const char* kReq[] = {"null", "write", "add"};
+  for (uint32_t i = 0; i < num_var; ++i) {
+    // a NULL variable is unconditionally a caller bug; a NULL grad is
+    // legal for grad_req 'null' (no buffer to attach) and maps to None
+    if (var_handles == nullptr || var_handles[i] == nullptr) {
+      set_error("MXAutogradMarkVariables: null variable handle");
+      return -1;
+    }
+  }
   PyObject* vars = PyList_New(num_var);
   PyObject* grads = PyList_New(num_var);
   PyObject* reqs = PyList_New(num_var);
   for (uint32_t i = 0; i < num_var; ++i) {
     PyObject* v = static_cast<Handle*>(var_handles[i])->obj;
-    PyObject* g = static_cast<Handle*>(grad_handles[i])->obj;
+    PyObject* g = (grad_handles == nullptr || grad_handles[i] == nullptr)
+        ? Py_None : static_cast<Handle*>(grad_handles[i])->obj;
     Py_INCREF(v);
     Py_INCREF(g);
     PyList_SET_ITEM(vars, i, v);
@@ -964,12 +973,23 @@ static int infer_shape_impl(SymbolHandle sym, uint32_t num_args,
                             int* complete) {
   GIL gil;
   Handle* h = static_cast<Handle*>(sym);
-  PyObject* ks = PyList_New(num_args);
+  // reference contract (c_api.h): keys may be NULL — positional mode,
+  // shapes matched onto list_arguments() order.  The shim resolves the
+  // argument names; here None marks the mode instead of dereferencing.
+  PyObject* ks;
+  if (keys == nullptr) {
+    ks = Py_None;
+    Py_INCREF(Py_None);
+  } else {
+    ks = PyList_New(num_args);
+  }
   PyObject* nds = PyList_New(num_args);
   size_t total = num_args == 0 ? 0 : arg_ind_ptr[num_args];
   PyObject* flat = PyList_New(total);
   for (uint32_t i = 0; i < num_args; ++i) {
-    PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    if (keys != nullptr) {
+      PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
+    }
     PyList_SET_ITEM(nds, i, PyLong_FromUnsignedLong(
         arg_ind_ptr[i + 1] - arg_ind_ptr[i]));
   }
@@ -1085,23 +1105,36 @@ int MXSymbolGetAtomicSymbolInfo(
   PyObject* info = shim_call("creator_info", Py_BuildValue("(O)", h->obj));
   if (info == nullptr) return -1;
   // (name, doc, arg_names, type_infos, arg_descs, key_var, return_type)
+  // PyUnicode_AsUTF8 returns nullptr on conversion failure (e.g. a doc
+  // string with lone surrogates) — error-return, never a crash
   h->strs.clear();
-  auto str_at = [&](int i) {
-    return PyUnicode_AsUTF8(PyTuple_GetItem(info, i));
+  bool utf8_fail = false;
+  auto push_utf8 = [&](PyObject* o) {
+    const char* c = PyUnicode_AsUTF8(o);
+    if (c == nullptr) {
+      utf8_fail = true;
+      h->strs.emplace_back();
+    } else {
+      h->strs.emplace_back(c);
+    }
   };
-  h->strs.emplace_back(str_at(0));
-  h->strs.emplace_back(str_at(1));
-  h->strs.emplace_back(str_at(5));
-  h->strs.emplace_back(str_at(6));
+  push_utf8(PyTuple_GetItem(info, 0));
+  push_utf8(PyTuple_GetItem(info, 1));
+  push_utf8(PyTuple_GetItem(info, 5));
+  push_utf8(PyTuple_GetItem(info, 6));
   PyObject *an = PyTuple_GetItem(info, 2), *at = PyTuple_GetItem(info, 3),
            *ad = PyTuple_GetItem(info, 4);
   Py_ssize_t n = PyList_Size(an);
   for (Py_ssize_t i = 0; i < n; ++i) {
-    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(an, i)));
-    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(at, i)));
-    h->strs.emplace_back(PyUnicode_AsUTF8(PyList_GetItem(ad, i)));
+    push_utf8(PyList_GetItem(an, i));
+    push_utf8(PyList_GetItem(at, i));
+    push_utf8(PyList_GetItem(ad, i));
   }
   Py_DECREF(info);
+  if (utf8_fail) {
+    capture_py_error();
+    return -1;
+  }
   // pointers into h->strs stay valid until the next info call on this
   // creator handle (same lifetime contract as the reference's ret store)
   h->ptrs.clear();
@@ -1385,9 +1418,16 @@ int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
   Handle* h = static_cast<Handle*>(creator);
   PyObject* info = shim_call("data_iter_info", Py_BuildValue("(O)", h->obj));
   if (info == nullptr) return -1;
+  const char* nm = PyUnicode_AsUTF8(PyTuple_GetItem(info, 0));
+  const char* ds = PyUnicode_AsUTF8(PyTuple_GetItem(info, 1));
+  if (nm == nullptr || ds == nullptr) {
+    capture_py_error();
+    Py_DECREF(info);
+    return -1;
+  }
   h->strs.clear();
-  h->strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(info, 0)));
-  h->strs.emplace_back(PyUnicode_AsUTF8(PyTuple_GetItem(info, 1)));
+  h->strs.emplace_back(nm);
+  h->strs.emplace_back(ds);
   Py_DECREF(info);
   h->ptrs.clear();
   for (const std::string& s : h->strs) h->ptrs.push_back(s.c_str());

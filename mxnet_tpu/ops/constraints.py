@@ -21,6 +21,17 @@ Application rules (``apply()``):
 reference adds dtype enums (e.g. random/sample_op.h): the registry
 leaves dtype untyped so users can pass strings, numpy dtypes, or type
 objects interchangeably; invalid dtypes fail in jnp.dtype resolution.
+
+Known DELIBERATE deviations from the reference (this table is a
+transcription PLUS these floors — see NAME_DEFAULTS below): the
+reference's DMLC optimizer structs declare ``lr`` with no ``set_range``
+(only beta1/beta2 are ranged in optimizer_op-inl.h), and ``eps``/
+``epsilon`` stabilizers are likewise unbounded in several structs, so
+``sgd_update(..., lr=-0.1)`` is reference-valid.  This overlay floors
+them at 0 anyway: a negative learning rate or stabilizer is always a
+sign-error ascending the loss or destabilizing the denominator, and on
+TPU it fails only as silent divergence many compiled steps later —
+bounds here fail at the call site instead.
 """
 from __future__ import annotations
 
@@ -100,6 +111,8 @@ CONSTRAINTS = {
 # with a per-op exception (e.g. `step`, which slice allows negative)
 # must NOT be listed here.
 NAME_DEFAULTS = {
+    # eps/epsilon/lr floors are DELIBERATE deviations — stricter than
+    # the reference transcription; rationale in the module docstring
     "eps": dict(low=0.0),
     "epsilon": dict(low=0.0),
     "lr": dict(low=0.0),

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .mesh import shard_map as _shard_map_compat
+
 __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
 
 
@@ -229,7 +231,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     # single device (jit outputs are), which shard_map rejects
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
-    fn = jax.shard_map(
+    fn = _shard_map_compat(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -273,7 +275,7 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     spec = P(None, axis_name, None, None)
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(a, sharding) for a in (q, k, v))
-    fn = jax.shard_map(
+    fn = _shard_map_compat(
         functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
                           scale=scale, kv_len=kv_len),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

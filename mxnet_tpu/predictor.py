@@ -31,37 +31,37 @@ class Predictor:
 
     def __init__(self, symbol_file, param_file, input_shapes,
                  dev_type="tpu", dev_id=0, output_names=None):
-        from .symbol import load as load_symbol, load_json
-        # c_predict_api contract: the symbol may arrive as the JSON text
-        # itself and the params as the raw container bytes
-        # (c_predict_api.cc MXPredCreate receives buffers, not paths)
-        if isinstance(symbol_file, str) and symbol_file.lstrip()[:1] == "{":
-            sym = load_json(symbol_file)
-        else:
-            sym = load_symbol(symbol_file)
-        if output_names:
-            outs = sym.get_internals()
-            names = outs.list_outputs()
-            picked = []
-            for want in output_names:
-                if want not in names:
-                    raise MXNetError("output %r not in graph (%s...)"
-                                     % (want, ", ".join(names[:8])))
-                picked.append(outs[names.index(want)])
-            from .symbol import Group
-            sym = picked[0] if len(picked) == 1 else Group(picked)
+        sym = _load_symbol(symbol_file, output_names)
         arg_params, aux_params = _load_params(param_file)
-        self._sym = sym
-        self._exe = sym.simple_bind(grad_req="null", **input_shapes)
-        for k, v in arg_params.items():
+        self._bind_aliased(sym, arg_params, aux_params, input_shapes)
+
+    def _bind_aliased(self, symbol, arg_params, aux_params, input_shapes):
+        """Inference-bind ``symbol`` and alias the param buffers in
+        (``_data`` assignment — a reference, never a copy), the ONE
+        bind path both the file constructor and ``from_parts`` use."""
+        self._sym = symbol
+        self._exe = symbol.simple_bind(grad_req="null", **input_shapes)
+        for k, v in (arg_params or {}).items():
             if k in self._exe.arg_dict:
                 self._exe.arg_dict[k]._data = v._data
-        for k, v in aux_params.items():
+        for k, v in (aux_params or {}).items():
             if k in self._exe.aux_dict:
                 self._exe.aux_dict[k]._data = v._data
         self._input_names = list(input_shapes)
         self._inputs = {}
         self._outputs = None
+
+    @classmethod
+    def from_parts(cls, symbol, arg_params, aux_params, input_shapes):
+        """Bind a predictor from an already-loaded symbol + param dicts.
+
+        The serving executor cache binds one predictor per shape bucket
+        from a single in-memory checkpoint (mxnet_tpu.serving); every
+        bucket shares the SAME underlying param arrays, so N buckets
+        cost N compiled programs but one set of weights."""
+        p = cls.__new__(cls)
+        p._bind_aliased(symbol, arg_params, aux_params, input_shapes)
+        return p
 
     def set_input(self, name, data):
         """MXPredSetInput (reference: c_predict_api.h:177)."""
@@ -137,6 +137,32 @@ class Predictor:
         self._exe = None
         self._outputs = None
         self._inputs = {}
+
+
+def _load_symbol(symbol_file, output_names=None):
+    """Resolve a serving/predict symbol source into a Symbol.
+
+    c_predict_api contract: the symbol may arrive as the JSON text
+    itself and the params as the raw container bytes
+    (c_predict_api.cc MXPredCreate receives buffers, not paths).
+    ``output_names`` picks internal heads (MXPredCreatePartialOut)."""
+    from .symbol import load as load_symbol, load_json
+    if isinstance(symbol_file, str) and symbol_file.lstrip()[:1] == "{":
+        sym = load_json(symbol_file)
+    else:
+        sym = load_symbol(symbol_file)
+    if output_names:
+        outs = sym.get_internals()
+        names = outs.list_outputs()
+        picked = []
+        for want in output_names:
+            if want not in names:
+                raise MXNetError("output %r not in graph (%s...)"
+                                 % (want, ", ".join(names[:8])))
+            picked.append(outs[names.index(want)])
+        from .symbol import Group
+        sym = picked[0] if len(picked) == 1 else Group(picked)
+    return sym
 
 
 def _load_params(param_file):

@@ -1,0 +1,20 @@
+"""mxnet_tpu.serving — dynamic-batching inference server.
+
+The deployment surface scaled up from ``predictor.py``'s one-shot
+wrapper: a versioned model registry, a shape-bucketed LRU executor
+cache (every compiled program reused, zero steady-state recompiles),
+and a dynamic micro-batcher with per-request deadlines, bounded-queue
+backpressure, worker fault isolation, and a /stats metrics snapshot.
+See ``docs/faq/serving.md`` for architecture and knobs.
+"""
+from .bucketing import pick_bucket, shape_buckets  # noqa: F401
+from .cache import ExecutorCache  # noqa: F401
+from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
+                     QueueFull, ServerClosed, ServingError)
+from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .server import InferenceFuture, ModelServer  # noqa: F401
+
+__all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
+           "InferenceFuture", "ServingError", "ModelNotFound", "QueueFull",
+           "DeadlineExceeded", "ServerClosed", "BadRequest",
+           "shape_buckets", "pick_bucket"]

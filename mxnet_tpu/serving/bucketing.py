@@ -1,0 +1,43 @@
+"""Shape buckets — the compiled-program working set.
+
+On TPU/XLA every novel input shape is a fresh compilation
+(docs/faq/bucketing.md covers the training-side analogue, the
+reference's BucketingModule).  The serving layer therefore quantizes
+the batch dimension to a small fixed ladder — powers of two up to
+``max_batch`` — so the steady-state server runs entirely out of
+already-compiled executors: a coalesced batch of ``n`` requests is
+padded up to the smallest bucket >= n and sliced back after forward.
+
+The ladder is the same one TF-Serving's ``BatchingSession`` documents
+(``allowed_batch_sizes``): geometric spacing bounds padding waste at
+<2x while keeping the compile count at O(log max_batch).
+"""
+from __future__ import annotations
+
+__all__ = ["shape_buckets", "pick_bucket"]
+
+
+def shape_buckets(max_batch):
+    """The batch-size ladder ``1, 2, 4, ..., max_batch``.
+
+    ``max_batch`` is always the last rung even when it is not a power
+    of two (e.g. 12 -> ``[1, 2, 4, 8, 12]``) so the server can coalesce
+    up to its advertised capacity."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1, got %d" % max_batch)
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+def pick_bucket(rows, buckets):
+    """Smallest bucket >= rows; None when rows exceeds the ladder."""
+    for b in buckets:
+        if b >= rows:
+            return b
+    return None

@@ -1,0 +1,94 @@
+"""LRU executor cache keyed on (model, version, bucket).
+
+The compiled-program working set: each entry is an inference-bound
+``Predictor`` (``Predictor.from_parts``) at one shape bucket, sharing
+the registry's param arrays across buckets.  A miss is a bind — and on
+XLA a bind's first forward is a compile — so the cache's miss counter
+IS the recompile counter the /stats surface reports; after warmup a
+healthy server's miss count stays flat (ISSUE acceptance: zero
+recompiles across mixed-size traffic).
+
+Eviction (capacity ``MXNET_SERVING_EXECUTOR_CACHE``) only DROPS the
+cache's reference: the batcher may still be mid-forward on an evicted
+or invalidated executor, so buffers are reclaimed by refcount once any
+in-flight batch completes — never freed out from under it.  The shared
+params live in the registry entries and are untouched either way.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..predictor import Predictor
+
+__all__ = ["ExecutorCache"]
+
+
+class ExecutorCache:
+    def __init__(self, capacity=16):
+        if capacity < 1:
+            raise ValueError("executor cache capacity must be >= 1")
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+        # (name, version, id(entry), bucket) -> (ModelVersion, Predictor)
+        self._entries = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, entry, bucket):
+        """The bound predictor for ``entry`` (a ModelVersion) at
+        ``bucket`` rows, binding (compiling) on miss.
+
+        ``id(entry)`` is part of the key: after an unload +
+        re-register under the SAME (name, version), a still-queued
+        old-entry request must not repopulate a key that new-entry
+        requests would then hit — old weights would serve new traffic
+        silently.  The cached value holds the entry itself, so the id
+        in a live key can never be recycled onto a different
+        ModelVersion by the allocator."""
+        key = (entry.name, entry.version, id(entry), int(bucket))
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return cached[1]
+        # bind OUTSIDE the lock: a compile can take seconds and must not
+        # stall concurrent lookups of already-cached buckets
+        pred = Predictor.from_parts(entry.symbol, entry.arg_params,
+                                    entry.aux_params,
+                                    entry.full_shapes(bucket))
+        with self._lock:
+            race = self._entries.get(key)
+            if race is not None:        # another thread bound it first
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return race[1]
+            self.misses += 1
+            self._entries[key] = (entry, pred)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return pred
+
+    def invalidate(self, name, version=None):
+        """Drop cached executors for a model (hot swap / unload path)."""
+        with self._lock:
+            doomed = [k for k in self._entries
+                      if k[0] == name and (version is None
+                                           or k[1] == int(version))]
+            for k in doomed:
+                self._entries.pop(k)
+            return len(doomed)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self):
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "recompiles": self.misses, "evictions": self.evictions,
+                    "size": len(self._entries),
+                    "capacity": self._capacity}

@@ -1,0 +1,43 @@
+"""Typed serving errors.
+
+Reference: TF-Serving's status taxonomy (tensorflow_serving/core —
+RESOURCE_EXHAUSTED for a full batching queue, DEADLINE_EXCEEDED for
+expired requests, NOT_FOUND for unknown servables) mapped onto this
+framework's ``MXNetError`` root so existing ``except mx.MXNetError``
+handlers keep working.  Every rejection path in ``ModelServer`` raises
+one of these — callers can distinguish backpressure (retry later) from
+deadline misses (drop) from operator error (fix the request).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "ModelNotFound", "QueueFull",
+           "DeadlineExceeded", "ServerClosed", "BadRequest"]
+
+
+class ServingError(MXNetError):
+    """Root of the serving error taxonomy."""
+
+
+class ModelNotFound(ServingError):
+    """No such model name / version in the registry (NOT_FOUND)."""
+
+
+class QueueFull(ServingError):
+    """Bounded request queue is at capacity — explicit backpressure
+    (RESOURCE_EXHAUSTED); the request was NOT enqueued, retry later."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before a result was produced
+    (DEADLINE_EXCEEDED); it will not be executed if still queued."""
+
+
+class ServerClosed(ServingError):
+    """The server was stopped before this request completed."""
+
+
+class BadRequest(ServingError):
+    """Malformed request (unknown input name, inconsistent batch rows,
+    or a batch larger than the largest shape bucket)."""

@@ -1,0 +1,147 @@
+"""Model registry — named, versioned, hot-swappable checkpoints.
+
+Reference: TF-Serving's servable manager (one name -> many versions,
+an aliasable "default" version, load/unload without restarting the
+server) over this framework's checkpoint format (``model.save_checkpoint``
+prefix convention loaded through the ``Predictor`` path).
+
+A registered version holds the loaded symbol + param NDArrays and the
+per-input SAMPLE shapes (the declared shapes minus the batch axis);
+the batch axis is owned by the serving layer's shape buckets.  The
+registry itself never binds executors — that is the executor cache's
+job — so a load is cheap and a hot swap is: ``load()`` the new
+version, ``set_default()``, optionally ``unload()`` the old one.
+"""
+from __future__ import annotations
+
+import threading
+
+from .errors import BadRequest, ModelNotFound
+
+__all__ = ["ModelVersion", "ModelRegistry"]
+
+
+class ModelVersion:
+    """One immutable loaded checkpoint: symbol, params, input signature."""
+
+    __slots__ = ("name", "version", "symbol", "arg_params", "aux_params",
+                 "sample_shapes", "input_names", "num_outputs")
+
+    def __init__(self, name, version, symbol, arg_params, aux_params,
+                 input_shapes):
+        self.name = name
+        self.version = int(version)
+        self.symbol = symbol
+        self.arg_params = dict(arg_params or {})
+        self.aux_params = dict(aux_params or {})
+        if not input_shapes:
+            raise BadRequest(
+                "model %r needs at least one declared input" % (name,))
+        self.sample_shapes = {}
+        for k, shp in input_shapes.items():
+            shp = tuple(int(d) for d in shp)
+            if len(shp) < 1:
+                raise BadRequest(
+                    "input %r of model %r needs a batch axis; got shape %r"
+                    % (k, name, shp))
+            self.sample_shapes[k] = shp[1:]
+        self.input_names = list(self.sample_shapes)
+        self.num_outputs = len(symbol.list_outputs())
+
+    def full_shapes(self, batch):
+        """Declared input shapes with the batch axis set to ``batch``."""
+        return {k: (int(batch),) + s for k, s in self.sample_shapes.items()}
+
+
+class ModelRegistry:
+    """Thread-safe name -> {version -> ModelVersion} store."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models = {}      # name -> {version: ModelVersion}
+        self._default = {}     # name -> version
+
+    # -- registration -------------------------------------------------------
+    def load(self, name, symbol_file, param_file, input_shapes,
+             version=None):
+        """Load a checkpoint (path or in-memory JSON/bytes, exactly the
+        ``Predictor`` contract) under ``name``; returns the version
+        number (auto-incremented when not given)."""
+        from ..predictor import _load_params, _load_symbol
+        sym = _load_symbol(symbol_file)
+        arg_params, aux_params = _load_params(param_file)
+        return self.add(name, sym, arg_params, aux_params, input_shapes,
+                        version=version)
+
+    def add(self, name, symbol, arg_params, aux_params, input_shapes,
+            version=None):
+        """Register an already-loaded symbol + params (the programmatic
+        path ``Module.export_serving`` uses).  The FIRST registered
+        version of a name becomes its default; later versions only
+        serve once ``set_default`` promotes them (hot swap is an
+        explicit, atomic step, not a side effect of loading)."""
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions) + 1 if versions else 1
+            version = int(version)
+            if version in versions:
+                raise BadRequest("model %r version %d already registered"
+                                 % (name, version))
+            versions[version] = ModelVersion(
+                name, version, symbol, arg_params, aux_params, input_shapes)
+            self._default.setdefault(name, version)
+            return version
+
+    def set_default(self, name, version):
+        """Promote ``version`` to what unversioned requests resolve to."""
+        with self._lock:
+            if name not in self._models or \
+                    int(version) not in self._models[name]:
+                raise ModelNotFound("model %r version %r is not registered"
+                                    % (name, version))
+            self._default[name] = int(version)
+
+    def unload(self, name, version=None):
+        """Drop one version (or the whole model when version is None)."""
+        with self._lock:
+            if name not in self._models:
+                raise ModelNotFound("model %r is not registered" % (name,))
+            if version is None:
+                del self._models[name]
+                del self._default[name]
+                return
+            version = int(version)
+            versions = self._models[name]
+            if version not in versions:
+                raise ModelNotFound("model %r version %d is not registered"
+                                    % (name, version))
+            del versions[version]
+            if not versions:
+                del self._models[name]
+                del self._default[name]
+            elif self._default[name] == version:
+                self._default[name] = max(versions)
+
+    # -- lookup -------------------------------------------------------------
+    def get(self, name, version=None):
+        """Resolve (name, version) -> ModelVersion; None version means
+        the current default.  Raises ModelNotFound."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ModelNotFound("model %r is not registered" % (name,))
+            if version is None:
+                version = self._default[name]
+            entry = versions.get(int(version))
+            if entry is None:
+                raise ModelNotFound("model %r version %r is not registered"
+                                    % (name, version))
+            return entry
+
+    def describe(self):
+        """Snapshot for the /stats surface: name -> versions + default."""
+        with self._lock:
+            return {name: {"versions": sorted(vs),
+                           "default": self._default[name]}
+                    for name, vs in self._models.items()}

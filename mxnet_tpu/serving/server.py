@@ -1,0 +1,525 @@
+"""ModelServer — dynamic micro-batching over a bucketed executor cache.
+
+Reference: TF-Serving's ``BatchingSession`` (arxiv 1605.08695 §5: "we
+achieve throughput on accelerators by folding concurrent requests into
+batches") composed with the reference MXNet deployment surface
+(``c_predict_api``): callers see a per-request ``infer()``; internally
+one batcher thread drains a bounded queue, coalesces co-batchable
+requests, pads the coalesced rows up to a shape bucket
+(``bucketing.shape_buckets``) and dispatches ONE compiled program from
+the LRU executor cache.  After ``warmup()`` every request runs an
+already-compiled executor — the steady state has ZERO recompiles.
+
+Production behaviors, each with a typed error and a /stats counter:
+
+- **deadlines** — every request carries one (default
+  ``MXNET_SERVING_DEFAULT_TIMEOUT_MS``); expired requests fail with
+  ``DeadlineExceeded`` and are skipped by the batcher, so a stale
+  request never spends accelerator time;
+- **backpressure** — the queue is bounded
+  (``MXNET_SERVING_QUEUE_DEPTH``); submissions beyond it are rejected
+  immediately with ``QueueFull`` instead of growing memory;
+- **fault isolation** — batch execution runs inside
+  ``engine.worker_scope``: a poisoned batch (bind failure, executor
+  error) fails ITS OWN requests' futures and the batcher thread keeps
+  serving; an error nobody is left to receive falls back to
+  ``engine.record_exception`` and surfaces at the next global sync
+  point, exactly the threaded-engine exception_ptr contract;
+- **observability** — ``stats()`` snapshots queue depth, a
+  batch-occupancy histogram, p50/p99 latency, executor-cache
+  hits/misses and the recompile count; each executed batch also emits
+  a ``serving:batch`` span through the profiler's chrome-trace path.
+
+Threading model: ONE batcher thread owns all executor dispatch (the
+natural fit for a single accelerator's program queue); client threads
+only enqueue and wait on futures.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import config
+from .. import engine
+from .. import profiler
+from ..io import pad_batch
+from .bucketing import pick_bucket, shape_buckets
+from .cache import ExecutorCache
+from .errors import (BadRequest, DeadlineExceeded, QueueFull, ServerClosed)
+from .registry import ModelRegistry
+
+__all__ = ["InferenceFuture", "ModelServer"]
+
+
+def _now_ms():
+    return time.monotonic() * 1000.0
+
+
+class InferenceFuture:
+    """Result handle for one queued request.
+
+    ``result()`` blocks until the batcher delivers or the request's
+    deadline passes — deadline expiry CANCELS the request (the batcher
+    will skip it) and raises ``DeadlineExceeded``, so a timed-out
+    client never consumes accelerator time retroactively."""
+
+    __slots__ = ("_ev", "_lock", "_result", "_exc", "_cancelled",
+                 "_deadline")
+
+    def __init__(self, deadline_ms):
+        self._ev = threading.Event()
+        self._lock = threading.Lock()
+        self._result = None
+        self._exc = None
+        self._cancelled = False
+        self._deadline = deadline_ms
+
+    def done(self):
+        return self._ev.is_set()
+
+    def cancelled(self):
+        return self._cancelled
+
+    def _set_result(self, value):
+        """Deliver; False when the client already gave up (cancelled)."""
+        with self._lock:
+            if self._cancelled or self._ev.is_set():
+                return False
+            self._result = value
+            self._ev.set()
+            return True
+
+    def _set_exception(self, exc):
+        with self._lock:
+            if self._cancelled or self._ev.is_set():
+                return False
+            self._exc = exc
+            self._ev.set()
+            return True
+
+    def _expired(self, now_ms):
+        return now_ms > self._deadline and not self._ev.is_set()
+
+    def wait(self, timeout_s=None):
+        return self._ev.wait(timeout_s)
+
+    def result(self):
+        remaining = (self._deadline - _now_ms()) / 1000.0
+        self._ev.wait(max(0.0, remaining))
+        with self._lock:
+            if not self._ev.is_set():
+                self._cancelled = True
+                raise DeadlineExceeded(
+                    "deadline passed before a result was delivered")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Request:
+    __slots__ = ("entry", "inputs", "rows", "future", "gkey", "t_submit",
+                 "solo")
+
+    def __init__(self, entry, inputs, rows, future, t_submit, solo=False):
+        self.entry = entry
+        self.inputs = inputs
+        self.rows = rows
+        self.future = future
+        # id(entry) pins the EXACT registry object: an unload +
+        # re-register of the same version number while requests are
+        # queued must not co-batch old-entry and new-entry requests.
+        # (self.entry keeps the object alive, so the id cannot be
+        # recycled while the request exists.)
+        self.gkey = (entry.name, entry.version, id(entry))
+        self.t_submit = t_submit
+        # solo requests are never coalesced: warmup uses this so an
+        # exactly-bucket-sized dummy cannot merge with live traffic
+        # into a DIFFERENT bucket, leaving the intended one uncompiled
+        self.solo = solo
+
+
+class ModelServer:
+    """The serving front door: a model registry + one batcher thread.
+
+    >>> srv = ModelServer()
+    >>> srv.load_model("resnet", "m-symbol.json", "m-0001.params",
+    ...                {"data": (1, 3, 224, 224)})
+    >>> srv.start(); srv.warmup("resnet")
+    >>> probs = srv.infer("resnet", {"data": x})[0]
+    """
+
+    def __init__(self, registry=None, max_batch=None, queue_depth=None,
+                 batch_wait_ms=None, default_timeout_ms=None,
+                 cache_size=None, buckets=None):
+        self.registry = registry if registry is not None else ModelRegistry()
+        if buckets is not None:
+            self._buckets = sorted({int(b) for b in buckets})
+            if not self._buckets or self._buckets[0] < 1:
+                raise ValueError("buckets must be a non-empty list of "
+                                 "sizes >= 1, got %r" % (buckets,))
+            if max_batch is not None and int(max_batch) != self._buckets[-1]:
+                raise ValueError(
+                    "conflicting config: max_batch=%d but the explicit "
+                    "bucket ladder tops out at %d"
+                    % (int(max_batch), self._buckets[-1]))
+        else:
+            mb = max_batch if max_batch is not None \
+                else config.get("MXNET_SERVING_MAX_BATCH")
+            self._buckets = shape_buckets(mb)
+        self._max_batch = self._buckets[-1]
+        self._queue_depth = int(queue_depth if queue_depth is not None
+                                else config.get("MXNET_SERVING_QUEUE_DEPTH"))
+        self._batch_wait_ms = float(
+            batch_wait_ms if batch_wait_ms is not None
+            else config.get("MXNET_SERVING_BATCH_WAIT_MS"))
+        self._default_timeout_ms = float(
+            default_timeout_ms if default_timeout_ms is not None
+            else config.get("MXNET_SERVING_DEFAULT_TIMEOUT_MS"))
+        self.cache = ExecutorCache(
+            cache_size if cache_size is not None
+            else config.get("MXNET_SERVING_EXECUTOR_CACHE"))
+        self._cv = threading.Condition()
+        self._queue = []
+        self._stopping = False
+        self._drain = True
+        self._thread = None
+        # -- metrics (all under _mlock) -------------------------------------
+        self._mlock = threading.Lock()
+        self._submitted = 0
+        self._served = 0
+        self._failed = 0
+        self._rejected_full = 0
+        self._expired = 0
+        self._batches = 0
+        self._batch_rows = 0
+        self._batch_hist = {}              # bucket -> [batches, rows]
+        self._latencies = []               # ring buffer, newest last
+        self._lat_cap = 4096
+        self._queue_peak = 0
+        self._domain = profiler.Domain("serving")
+        self._q_counter = self._domain.new_counter("serving_queue_depth")
+
+    # -- model management ---------------------------------------------------
+    def load_model(self, name, symbol_file, param_file, input_shapes,
+                   version=None):
+        return self.registry.load(name, symbol_file, param_file,
+                                  input_shapes, version=version)
+
+    def add_model(self, name, symbol, arg_params, aux_params, input_shapes,
+                  version=None):
+        return self.registry.add(name, symbol, arg_params, aux_params,
+                                 input_shapes, version=version)
+
+    def set_default_version(self, name, version):
+        self.registry.set_default(name, version)
+
+    def unload_model(self, name, version=None):
+        """Unload + drop the version's cached executors (hot-swap tail)."""
+        self.registry.unload(name, version)
+        self.cache.invalidate(name, version)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stopping = False
+            self._drain = True
+            self._thread = threading.Thread(
+                target=self._worker, name="mxnet-serving-batcher",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop the batcher; ``drain`` serves out the queue first,
+        otherwise queued requests fail with ``ServerClosed``."""
+        with self._cv:
+            self._stopping = True
+            self._drain = bool(drain)
+            self._cv.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+        with self._cv:
+            leftovers = list(self._queue)
+            del self._queue[:]
+        for r in leftovers:
+            r.future._set_exception(ServerClosed("server stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc_info):
+        self.stop()
+
+    # -- request path -------------------------------------------------------
+    def infer(self, name, inputs, version=None, timeout_ms=None):
+        """Blocking inference: returns the model's outputs as a list of
+        numpy arrays whose batch axis matches the request's rows."""
+        return self.infer_async(name, inputs, version=version,
+                                timeout_ms=timeout_ms).result()
+
+    def infer_async(self, name, inputs, version=None, timeout_ms=None,
+                    _solo=False):
+        """Enqueue a request; returns an :class:`InferenceFuture`.
+
+        ``inputs`` maps input name -> array; a single-input model also
+        accepts the bare array.  Arrays may carry a leading batch axis
+        (1..max_batch rows) or be a single sample (the batch axis is
+        added).  Raises ``QueueFull``/``BadRequest``/``ModelNotFound``
+        synchronously — a rejected request was never enqueued."""
+        entry = self.registry.get(name, version)
+        if not isinstance(inputs, dict):
+            if len(entry.input_names) != 1:
+                raise BadRequest(
+                    "model %r has inputs %s; pass a dict"
+                    % (name, entry.input_names))
+            inputs = {entry.input_names[0]: inputs}
+        missing = [k for k in entry.input_names if k not in inputs]
+        unknown = [k for k in inputs if k not in entry.sample_shapes]
+        if missing or unknown:
+            raise BadRequest(
+                "model %r inputs are %s (missing %s, unknown %s)"
+                % (name, entry.input_names, missing, unknown))
+        arrs, rows = {}, None
+        for k in entry.input_names:
+            a = np.asarray(inputs[k], dtype=np.float32)
+            want = entry.sample_shapes[k]
+            if a.ndim == len(want):
+                a = a[None]
+            if a.ndim != len(want) + 1 or a.shape[1:] != want:
+                raise BadRequest(
+                    "input %r expects sample shape %s, got array of "
+                    "shape %s" % (k, want, a.shape))
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise BadRequest(
+                    "inconsistent batch rows across inputs: %d vs %d"
+                    % (rows, a.shape[0]))
+            arrs[k] = a
+        if rows == 0:
+            raise BadRequest("empty request (0 rows)")
+        if rows > self._max_batch:
+            raise BadRequest(
+                "request rows %d exceed the largest shape bucket %d; "
+                "split the request" % (rows, self._max_batch))
+        timeout = self._default_timeout_ms if timeout_ms is None \
+            else float(timeout_ms)
+        now = _now_ms()
+        fut = InferenceFuture(now + timeout)
+        req = _Request(entry, arrs, rows, fut, now, solo=_solo)
+        with self._cv:
+            if self._stopping:
+                raise ServerClosed("server is stopping")
+            if len(self._queue) >= self._queue_depth:
+                with self._mlock:
+                    self._rejected_full += 1
+                raise QueueFull(
+                    "serving queue at capacity (%d requests); retry "
+                    "later" % self._queue_depth)
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cv.notify_all()
+        with self._mlock:
+            self._submitted += 1
+            if depth > self._queue_peak:
+                self._queue_peak = depth
+        self._q_counter.set_value(depth)
+        return fut
+
+    def warmup(self, name=None, version=None, buckets=None,
+               timeout_ms=600000.0):
+        """Bind AND run every (model, bucket) executor once so live
+        traffic never pays a compile; returns the (name, version,
+        bucket) triples warmed.
+
+        Executors are stateful and single-owner: when the batcher is
+        running, warmup dispatches THROUGH it (one exactly-bucket-sized
+        dummy request at a time, blocking) so a live request can never
+        race warmup's forward on the same predictor.  Only a not-yet-
+        started server warms inline."""
+        names = [name] if name is not None \
+            else sorted(self.registry.describe())
+        with self._cv:
+            batcher_owns = self._thread is not None \
+                and self._thread.is_alive() and not self._stopping
+        if buckets is not None:
+            rogue = [b for b in buckets if int(b) not in self._buckets]
+            if rogue:
+                raise ValueError(
+                    "warmup buckets %s are not on the ladder %s — "
+                    "steady-state traffic only ever selects ladder "
+                    "rungs, so warming them would not prevent any "
+                    "recompile" % (rogue, self._buckets))
+        warmed = []
+        for n in names:
+            entry = self.registry.get(n, version)
+            for b in (buckets if buckets is not None else self._buckets):
+                b = int(b)
+                feed = {k: np.zeros((b,) + s, np.float32)
+                        for k, s in entry.sample_shapes.items()}
+                if batcher_owns:
+                    self.infer_async(n, feed, version=entry.version,
+                                     timeout_ms=timeout_ms,
+                                     _solo=True).result()
+                else:
+                    pred = self.cache.get(entry, b)
+                    pred.forward(**feed)
+                    for i in range(entry.num_outputs):
+                        pred.get_output(i).asnumpy()
+                warmed.append((n, entry.version, b))
+        return warmed
+
+    # -- batcher ------------------------------------------------------------
+    def _worker(self):
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return
+            reqs, entry, bucket = batch
+
+            def deliver(exc, _reqs=reqs):
+                got, gone = 0, 0
+                for r in _reqs:
+                    if r.future._set_exception(exc):
+                        got += 1
+                    else:
+                        gone += 1       # client already cancelled
+                with self._mlock:
+                    self._failed += got
+                    self._expired += gone
+                return got > 0
+
+            with engine.worker_scope(deliver):
+                self._execute(reqs, entry, bucket)
+
+    def _collect_batch(self):
+        with self._cv:
+            while True:
+                if self._stopping and not self._drain:
+                    return None     # stop() fails the remaining queue
+                self._prune_locked()
+                if self._queue:
+                    head = self._queue[0]
+                    window = head.t_submit + self._batch_wait_ms - _now_ms()
+                    if (not head.solo and not self._stopping and
+                            window > 0 and
+                            self._rows_queued_locked(head.gkey)
+                            < self._max_batch):
+                        # hold the head open for co-batchable arrivals
+                        self._cv.wait(window / 1000.0)
+                        continue
+                    return self._pop_batch_locked(head)
+                if self._stopping:
+                    return None
+                self._cv.wait(0.1)
+
+    def _prune_locked(self):
+        """Drop cancelled/expired requests before they cost a dispatch."""
+        now = _now_ms()
+        keep = []
+        for r in self._queue:
+            if r.future.cancelled():
+                with self._mlock:
+                    self._expired += 1
+                continue
+            if r.future._expired(now):
+                r.future._set_exception(DeadlineExceeded(
+                    "deadline passed while queued"))
+                with self._mlock:
+                    self._expired += 1
+                continue
+            keep.append(r)
+        if len(keep) != len(self._queue):
+            self._queue[:] = keep
+
+    def _rows_queued_locked(self, gkey):
+        return sum(r.rows for r in self._queue if r.gkey == gkey)
+
+    def _pop_batch_locked(self, head):
+        if head.solo:            # exactly this request, exactly its bucket
+            self._queue.remove(head)
+            self._q_counter.set_value(len(self._queue))
+            return [head], head.entry, pick_bucket(head.rows, self._buckets)
+        taken, rows = [], 0
+        rest = []
+        for r in self._queue:
+            if (not r.solo and r.gkey == head.gkey
+                    and rows + r.rows <= self._max_batch):
+                taken.append(r)
+                rows += r.rows
+            else:
+                rest.append(r)
+        self._queue[:] = rest
+        self._q_counter.set_value(len(rest))
+        return taken, head.entry, pick_bucket(rows, self._buckets)
+
+    def _execute(self, reqs, entry, bucket):
+        rows_total = sum(r.rows for r in reqs)
+        span_args = {"model": entry.name, "version": entry.version,
+                     "bucket": bucket, "rows": rows_total}
+        with profiler.scope("serving:batch", cat="serving", args=span_args):
+            pred = self.cache.get(entry, bucket)
+            feed = {}
+            for k in entry.input_names:
+                feed[k], _ = pad_batch([r.inputs[k] for r in reqs], bucket)
+            pred.forward(**feed)
+            outs = [pred.get_output(i).asnumpy()
+                    for i in range(entry.num_outputs)]
+        t_done = _now_ms()
+        off = 0
+        for r in reqs:
+            sl = [o[off:off + r.rows] for o in outs]
+            off += r.rows
+            if r.future._set_result(sl):
+                with self._mlock:
+                    self._served += 1
+                    self._latencies.append(t_done - r.t_submit)
+                    if len(self._latencies) > self._lat_cap:
+                        del self._latencies[:-self._lat_cap]
+            else:
+                with self._mlock:
+                    self._expired += 1
+        with self._mlock:
+            self._batches += 1
+            self._batch_rows += rows_total
+            h = self._batch_hist.setdefault(bucket, [0, 0])
+            h[0] += 1
+            h[1] += rows_total
+
+    # -- observability ------------------------------------------------------
+    def stats(self):
+        """One consistent /stats snapshot (all counters since start)."""
+        with self._cv:
+            depth = len(self._queue)
+        with self._mlock:
+            lats = list(self._latencies)
+            occupancy = {
+                b: {"batches": n, "rows": r,
+                    "fill": round(r / float(n * b), 4)}
+                for b, (n, r) in sorted(self._batch_hist.items())}
+            snap = {
+                "queue": {"depth": depth, "peak": self._queue_peak,
+                          "limit": self._queue_depth},
+                "requests": {"submitted": self._submitted,
+                             "served": self._served,
+                             "failed": self._failed,
+                             "rejected_queue_full": self._rejected_full,
+                             "expired": self._expired},
+                "batches": {"count": self._batches,
+                            "rows": self._batch_rows,
+                            "occupancy": occupancy},
+                "buckets": list(self._buckets),
+            }
+        snap["latency_ms"] = {
+            "count": len(lats),
+            "p50": round(float(np.percentile(lats, 50)), 3) if lats else None,
+            "p99": round(float(np.percentile(lats, 99)), 3) if lats else None,
+        }
+        snap["executor_cache"] = self.cache.stats()
+        snap["models"] = self.registry.describe()
+        return snap

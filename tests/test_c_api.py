@@ -663,3 +663,54 @@ def test_profiler_and_kv_barrier_block(lib, tmp_path):
     _check(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)), lib)
     _check(lib.MXKVStoreBarrier(kv), lib)
     _check(lib.MXKVStoreFree(kv), lib)
+
+
+def test_infer_shape_positional_null_keys(lib):
+    """Reference contract: keys==NULL means positional mode — shapes map
+    onto list_arguments() order (ndim 0 = unknown, infer it).  Used to
+    segfault in PyUnicode_FromString (ADVICE r5, medium)."""
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                name="fc")
+    h = ctypes.c_void_p()
+    _check(lib.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                      ctypes.byref(h)), lib)
+    # arguments are (data, fc_weight, fc_bias); give data's shape only
+    ind_ptr = (ctypes.c_uint * 4)(0, 2, 2, 2)
+    shape_data = (ctypes.c_uint * 2)(5, 3)
+    in_n, out_n, aux_n = (ctypes.c_uint() for _ in range(3))
+    in_nd, out_nd, aux_nd = (ctypes.POINTER(ctypes.c_uint)()
+                             for _ in range(3))
+    in_d, out_d, aux_d = (ctypes.POINTER(ctypes.POINTER(ctypes.c_uint))()
+                          for _ in range(3))
+    complete = ctypes.c_int()
+    _check(lib.MXSymbolInferShape(
+        h, 3, None, ind_ptr, shape_data,
+        ctypes.byref(in_n), ctypes.byref(in_nd), ctypes.byref(in_d),
+        ctypes.byref(out_n), ctypes.byref(out_nd), ctypes.byref(out_d),
+        ctypes.byref(aux_n), ctypes.byref(aux_nd), ctypes.byref(aux_d),
+        ctypes.byref(complete)), lib)
+    assert complete.value == 1
+
+    def shapes(n, nd_, d):
+        return [tuple(d[i][j] for j in range(nd_[i]))
+                for i in range(n.value)]
+    assert shapes(in_n, in_nd, in_d) == [(5, 3), (8, 3), (8,)]
+    assert shapes(out_n, out_nd, out_d) == [(5, 8)]
+    _check(lib.MXSymbolFree(h), lib)
+
+
+def test_mark_variables_null_handles(lib):
+    """NULL grad handle for grad_req 'null' is legal (no buffer to
+    attach); a NULL variable handle is an error return, not a segfault
+    (ADVICE r5, low)."""
+    x = _nd_from_np(lib, np.array([1.0, 2.0], np.float32))
+    vars_ = (ctypes.c_void_p * 1)(x.value)
+    grads = (ctypes.c_void_p * 1)(None)      # NULL grad
+    reqs = (ctypes.c_uint * 1)(0)            # grad_req 'null'
+    _check(lib.MXAutogradMarkVariables(1, vars_, reqs, grads), lib)
+    # NULL variable handle -> clean rc=-1 + message
+    bad_vars = (ctypes.c_void_p * 1)(None)
+    rc = lib.MXAutogradMarkVariables(1, bad_vars, reqs, grads)
+    assert rc == -1
+    assert b"null variable handle" in lib.MXGetLastError()
+    _check(lib.MXNDArrayFree(x), lib)

@@ -1,0 +1,423 @@
+"""mxnet_tpu.serving — dynamic batching, bucketed executor cache.
+
+Reference analogues: TF-Serving's BatchingSession contract (coalesce,
+pad to allowed batch sizes, slice back; RESOURCE_EXHAUSTED on a full
+queue, DEADLINE_EXCEEDED on expiry) and the threaded engine's
+exception isolation (a poisoned job fails its waiters, the worker
+survives — tests/python/unittest/test_exc_handling.py).
+
+The acceptance pins: batched outputs numerically match the
+single-request ``Predictor`` oracle across >=3 shape buckets; the
+executor-cache miss count stays FLAT (zero recompiles) across 100+
+mixed-size requests after warmup; queue-full and deadline-exceeded
+requests fail with typed errors while the server keeps serving.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.serving import (BadRequest, DeadlineExceeded, ExecutorCache,
+                               ModelNotFound, ModelRegistry, ModelServer,
+                               QueueFull, ServerClosed, pick_bucket,
+                               shape_buckets)
+
+IN_DIM = 6
+HID = 4
+
+
+def _make_model(seed=0):
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=HID, name="fc")
+    out = sym.softmax(fc, name="prob")
+    rng = np.random.RandomState(seed)
+    arg_params = {
+        "fc_weight": nd.array(rng.randn(HID, IN_DIM).astype(np.float32)),
+        "fc_bias": nd.array(rng.randn(HID).astype(np.float32))}
+    return out, arg_params
+
+
+@pytest.fixture()
+def server():
+    symb, args = _make_model()
+    srv = ModelServer(max_batch=8, batch_wait_ms=1.0, queue_depth=256,
+                      default_timeout_ms=30000.0)
+    srv.add_model("m", symb, args, {}, {"data": (1, IN_DIM)})
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+def _oracle(symb, args, x):
+    p = mx.Predictor.from_parts(symb, args, {},
+                                {"data": (x.shape[0], IN_DIM)})
+    p.forward(data=x)
+    out = p.get_output(0).asnumpy()
+    p.free()
+    return out
+
+
+# -- bucketing unit surface --------------------------------------------------
+def test_shape_bucket_ladder():
+    assert shape_buckets(8) == [1, 2, 4, 8]
+    assert shape_buckets(12) == [1, 2, 4, 8, 12]
+    assert shape_buckets(1) == [1]
+    assert pick_bucket(3, [1, 2, 4, 8]) == 4
+    assert pick_bucket(8, [1, 2, 4, 8]) == 8
+    assert pick_bucket(9, [1, 2, 4, 8]) is None
+    with pytest.raises(ValueError):
+        shape_buckets(0)
+
+
+def test_pad_batch_repeats_last_row():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b = np.arange(3, dtype=np.float32).reshape(1, 3) + 100
+    mat, rows = mx.io.pad_batch([a, b], 8)
+    assert rows == 3 and mat.shape == (8, 3)
+    assert np.array_equal(mat[:2], a) and np.array_equal(mat[2], b[0])
+    assert np.array_equal(mat[3:], np.tile(b[0], (5, 1)))
+    with pytest.raises(ValueError):
+        mx.io.pad_batch([np.zeros((4, 3))], 2)
+
+
+# -- correctness vs the unbatched oracle -------------------------------------
+def test_bucketed_outputs_match_predictor_oracle(server):
+    """Requests of 1/3/5/8 rows land in buckets 1/4/8 (>=3 distinct
+    buckets); every padded+sliced output must equal the dedicated
+    single-request Predictor bound at the request's exact shape."""
+    symb, args = _make_model()
+    rng = np.random.RandomState(7)
+    hit_buckets = set()
+    for rows in (1, 3, 5, 8, 2, 4):
+        x = rng.rand(rows, IN_DIM).astype(np.float32)
+        got = server.infer("m", {"data": x})
+        assert len(got) == 1 and got[0].shape == (rows, HID)
+        ref = _oracle(symb, args, x)
+        assert np.abs(got[0] - ref).max() < 1e-5
+        hit_buckets.add(pick_bucket(rows, server.stats()["buckets"]))
+    assert len(hit_buckets) >= 3
+    occ = server.stats()["batches"]["occupancy"]
+    assert sum(v["batches"] for v in occ.values()) >= 6
+
+
+def test_single_sample_and_bare_array_requests(server):
+    symb, args = _make_model()
+    x = np.random.RandomState(3).rand(IN_DIM).astype(np.float32)
+    got = server.infer("m", x)            # bare array, no batch axis
+    assert got[0].shape == (1, HID)
+    ref = _oracle(symb, args, x[None])
+    assert np.abs(got[0] - ref).max() < 1e-5
+
+
+# -- zero recompiles after warmup --------------------------------------------
+def test_zero_recompiles_after_warmup(server):
+    warmed = server.warmup("m")
+    assert [b for (_, _, b) in warmed] == [1, 2, 4, 8]
+    misses_after_warmup = server.cache.stats()["misses"]
+    assert misses_after_warmup == 4
+    rng = np.random.RandomState(11)
+    futs = []
+    for i in range(120):
+        rows = int(rng.randint(1, 9))
+        x = rng.rand(rows, IN_DIM).astype(np.float32)
+        futs.append((server.infer_async("m", {"data": x}), rows))
+    for f, rows in futs:
+        assert f.result()[0].shape == (rows, HID)
+    cache = server.cache.stats()
+    assert cache["misses"] == misses_after_warmup, \
+        "mixed-size traffic after warmup must not bind new executors"
+    assert cache["recompiles"] == misses_after_warmup
+    assert cache["hits"] >= 120 // 8
+
+
+def test_warmup_solo_requests_never_coalesce():
+    """A warmup dummy must compile ITS bucket: if the batcher merged it
+    with concurrent live traffic the combined rows would land in a
+    different bucket and the intended one would stay uncompiled,
+    breaking the zero-steady-state-recompiles contract."""
+    symb, args = _make_model()
+    srv = ModelServer(max_batch=8, batch_wait_ms=50.0)
+    srv.add_model("m", symb, args, {}, {"data": (1, IN_DIM)})
+    # queue live traffic and a warmup-style solo dummy BEFORE starting,
+    # so the batcher sees both at once and coalescing would be possible
+    live = srv.infer_async("m", {"data": np.zeros((2, IN_DIM), np.float32)})
+    solo = srv.infer_async("m", {"data": np.zeros((4, IN_DIM), np.float32)},
+                           _solo=True)
+    srv.start()
+    assert live.result()[0].shape == (2, HID)
+    assert solo.result()[0].shape == (4, HID)
+    occ = srv.stats()["batches"]["occupancy"]
+    assert set(occ) == {2, 4}, occ     # merged would have been bucket 8
+    assert occ[2]["rows"] == 2 and occ[4]["rows"] == 4
+    srv.stop()
+    srv.cache.clear()
+
+
+# -- typed rejection paths ---------------------------------------------------
+def test_deadline_exceeded_and_server_survives(server):
+    x = np.zeros((1, IN_DIM), np.float32)
+    with pytest.raises(DeadlineExceeded):
+        server.infer("m", {"data": x}, timeout_ms=0.0)
+    # the server keeps serving afterwards
+    out = server.infer("m", {"data": x})
+    assert out[0].shape == (1, HID)
+    assert server.stats()["requests"]["expired"] >= 1
+
+
+def test_queue_full_rejection():
+    symb, args = _make_model()
+    srv = ModelServer(max_batch=8, queue_depth=3, batch_wait_ms=1.0)
+    srv.add_model("m", symb, args, {}, {"data": (1, IN_DIM)})
+    # worker not started: submissions park in the bounded queue
+    x = np.zeros((1, IN_DIM), np.float32)
+    futs = [srv.infer_async("m", {"data": x}) for _ in range(3)]
+    with pytest.raises(QueueFull):
+        srv.infer_async("m", {"data": x})
+    assert srv.stats()["requests"]["rejected_queue_full"] == 1
+    # backpressure clears once the batcher drains
+    srv.start()
+    for f in futs:
+        assert f.result()[0].shape == (1, HID)
+    assert srv.infer("m", {"data": x})[0].shape == (1, HID)
+    srv.stop()
+    srv.cache.clear()
+
+
+def test_bad_request_rejections(server):
+    x = np.zeros((1, IN_DIM), np.float32)
+    with pytest.raises(ModelNotFound):
+        server.infer("nope", {"data": x})
+    with pytest.raises(ModelNotFound):
+        server.infer("m", {"data": x}, version=99)
+    with pytest.raises(BadRequest):
+        server.infer("m", {"wrong_name": x})
+    with pytest.raises(BadRequest):                 # wrong sample shape
+        server.infer("m", {"data": np.zeros((1, IN_DIM + 1), np.float32)})
+    with pytest.raises(BadRequest):                 # beyond largest bucket
+        server.infer("m", {"data": np.zeros((9, IN_DIM), np.float32)})
+    with pytest.raises(BadRequest):                 # empty
+        server.infer("m", {"data": np.zeros((0, IN_DIM), np.float32)})
+
+
+# -- fault isolation ---------------------------------------------------------
+def test_poisoned_batch_fails_own_requests_only(server):
+    """A model whose graph only binds at SOME buckets fails at bind
+    time INSIDE the batcher; its requests get the typed error, the
+    batcher thread survives, healthy traffic keeps flowing, and the
+    global engine slot stays clean (the error was delivered)."""
+    # reshape to a fixed 6-element target: bucket 1 binds, bucket 2
+    # (12 elements) fails shape inference in the worker thread
+    bad_sym = sym.reshape(sym.Variable("data"), shape=(3, 2))
+    server.add_model("poison", bad_sym, {}, {}, {"data": (1, IN_DIM)})
+    mx.engine.clear_exception()
+    x = np.zeros((2, IN_DIM), np.float32)
+    fut = server.infer_async("poison", {"data": x})
+    with pytest.raises(mx.MXNetError):
+        fut.result()
+    # delivered to its own future -> NOT re-raised at global sync points
+    mx.engine.check_raise()
+    # the batcher thread is alive and healthy models still serve
+    out = server.infer("m", {"data": x})
+    assert out[0].shape == (2, HID)
+    assert server.stats()["requests"]["failed"] >= 1
+
+
+def test_worker_scope_orphan_routes_to_engine_sync_point():
+    """engine.worker_scope: when delivery reports no live receiver the
+    exception lands in the deferred slot and rethrows at the next sync
+    point — the ThreadedEngine exception_ptr contract."""
+    mx.engine.clear_exception()
+    boom = RuntimeError("orphaned worker failure")
+
+    def worker():
+        with mx.engine.worker_scope(deliver=lambda exc: False):
+            raise boom
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    with pytest.raises(RuntimeError, match="orphaned worker failure"):
+        mx.engine.check_raise()
+    mx.engine.check_raise()      # slot cleared by the rethrow
+
+    # delivered=True consumes it
+    def worker2():
+        with mx.engine.worker_scope(deliver=lambda exc: True):
+            raise boom
+    t = threading.Thread(target=worker2)
+    t.start()
+    t.join()
+    mx.engine.check_raise()      # nothing deferred
+
+
+# -- registry / hot swap -----------------------------------------------------
+def test_hot_swap_and_unload(server):
+    symb2, args2 = _make_model(seed=42)
+    x = np.random.RandomState(5).rand(2, IN_DIM).astype(np.float32)
+    v1_out = server.infer("m", {"data": x})[0]
+    v2 = server.add_model("m", symb2, args2, {}, {"data": (1, IN_DIM)})
+    assert v2 == 2
+    # not promoted yet: default still serves v1
+    assert np.abs(server.infer("m", {"data": x})[0] - v1_out).max() < 1e-6
+    server.set_default_version("m", 2)
+    v2_out = server.infer("m", {"data": x})[0]
+    assert np.abs(v2_out - _oracle(symb2, args2, x)).max() < 1e-5
+    assert np.abs(v2_out - v1_out).max() > 1e-3   # weights actually changed
+    # pinned-version requests still reach v1
+    assert np.abs(server.infer("m", {"data": x}, version=1)[0]
+                  - v1_out).max() < 1e-6
+    server.unload_model("m", version=1)
+    with pytest.raises(ModelNotFound):
+        server.infer("m", {"data": x}, version=1)
+    # v2 (now the only version) keeps serving
+    assert server.infer("m", {"data": x})[0].shape == (2, HID)
+
+
+def test_registry_standalone():
+    reg = ModelRegistry()
+    symb, args = _make_model()
+    assert reg.add("a", symb, args, {}, {"data": (1, IN_DIM)}) == 1
+    assert reg.add("a", symb, args, {}, {"data": (1, IN_DIM)}) == 2
+    assert reg.get("a").version == 1          # first registered is default
+    with pytest.raises(BadRequest):
+        reg.add("a", symb, args, {}, {"data": (1, IN_DIM)}, version=2)
+    reg.set_default("a", 2)
+    assert reg.get("a").version == 2
+    reg.unload("a", 2)
+    assert reg.get("a").version == 1          # default falls back
+    reg.unload("a")
+    with pytest.raises(ModelNotFound):
+        reg.get("a")
+
+
+def test_executor_cache_lru_eviction():
+    symb, args = _make_model()
+    reg = ModelRegistry()
+    reg.add("m", symb, args, {}, {"data": (1, IN_DIM)})
+    entry = reg.get("m")
+    cache = ExecutorCache(capacity=2)
+    cache.get(entry, 1)
+    cache.get(entry, 2)
+    cache.get(entry, 1)          # refresh 1's recency
+    cache.get(entry, 4)          # evicts bucket 2
+    st = cache.stats()
+    assert st["size"] == 2 and st["evictions"] == 1 and st["misses"] == 3
+    cache.get(entry, 2)          # miss again after eviction
+    assert cache.stats()["misses"] == 4
+    assert cache.invalidate("m") == 2
+    cache.clear()
+
+
+def test_module_export_serving():
+    m = mx.mod.Module(symbol=_make_model()[0], data_names=("data",),
+                      label_names=None)
+    m.bind(data_shapes=[("data", (4, IN_DIM))], for_training=False)
+    m.init_params()
+    srv = ModelServer(max_batch=4, batch_wait_ms=1.0)
+    v = m.export_serving("from_module", srv)
+    assert v == 1
+    with srv:
+        out = srv.infer("from_module",
+                        {"data": np.zeros((2, IN_DIM), np.float32)})
+        assert out[0].shape == (2, HID)
+    srv.cache.clear()
+
+
+# -- metrics & profiler ------------------------------------------------------
+def test_stats_snapshot_shape_and_profiler_spans(server):
+    import json
+    from mxnet_tpu import profiler
+    profiler.set_state("run")
+    try:
+        x = np.zeros((3, IN_DIM), np.float32)
+        server.infer("m", {"data": x})
+    finally:
+        profiler.set_state("stop")
+    snap = server.stats()
+    for section in ("queue", "requests", "batches", "latency_ms",
+                    "executor_cache", "models", "buckets"):
+        assert section in snap, section
+    assert snap["queue"]["limit"] == 256
+    assert snap["requests"]["served"] >= 1
+    assert snap["latency_ms"]["p50"] is not None
+    assert snap["latency_ms"]["p99"] >= snap["latency_ms"]["p50"]
+    occ = snap["batches"]["occupancy"]
+    assert occ and all(0.0 < v["fill"] <= 1.0 for v in occ.values())
+    assert snap["models"]["m"]["default"] == 1
+    # the batch emitted a chrome-trace span through profiler.py
+    trace = json.loads(profiler.dumps(reset=True))
+    spans = [e for e in trace["traceEvents"]
+             if e["name"] == "serving:batch"]
+    assert spans and spans[0]["args"]["model"] == "m"
+    assert spans[0]["args"]["bucket"] == 4
+
+
+def test_stop_drain_false_fails_queued():
+    symb, args = _make_model()
+    srv = ModelServer(max_batch=8, batch_wait_ms=1.0)
+    srv.add_model("m", symb, args, {}, {"data": (1, IN_DIM)})
+    x = np.zeros((1, IN_DIM), np.float32)
+    futs = [srv.infer_async("m", {"data": x}) for _ in range(4)]
+    srv.stop(drain=False)        # never started: queue fails wholesale
+    for f in futs:
+        with pytest.raises(ServerClosed):
+            f.result()
+    with pytest.raises(ServerClosed):
+        srv.infer_async("m", {"data": x})
+    srv.cache.clear()
+
+
+# -- concurrency soak --------------------------------------------------------
+@pytest.mark.slow
+def test_concurrency_soak():
+    """Many client threads, random request sizes, sustained for several
+    hundred requests: everything succeeds, outputs stay correct, and
+    the cache never recompiles past warmup."""
+    symb, args = _make_model()
+    srv = ModelServer(max_batch=8, batch_wait_ms=1.0, queue_depth=512,
+                      default_timeout_ms=60000.0)
+    srv.add_model("m", symb, args, {}, {"data": (1, IN_DIM)})
+    srv.start()
+    srv.warmup("m")
+    base_misses = srv.cache.stats()["misses"]
+    base_served = srv.stats()["requests"]["served"]
+    errors = []
+    N_THREADS, N_REQ = 16, 40
+
+    def client(tid):
+        rng = np.random.RandomState(tid)
+        for i in range(N_REQ):
+            rows = int(rng.randint(1, 9))
+            x = rng.rand(rows, IN_DIM).astype(np.float32)
+            try:
+                out = srv.infer("m", {"data": x})
+                if out[0].shape != (rows, HID):
+                    errors.append("shape %s" % (out[0].shape,))
+                if i % 10 == 0:
+                    ref = _oracle(symb, args, x)
+                    if np.abs(out[0] - ref).max() > 1e-4:
+                        errors.append("numeric drift")
+            except Exception as exc:   # noqa: BLE001
+                errors.append(repr(exc))
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(N_THREADS)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    assert not errors, errors[:5]
+    snap = srv.stats()
+    assert snap["requests"]["served"] - base_served == N_THREADS * N_REQ
+    assert srv.cache.stats()["misses"] == base_misses
+    assert snap["batches"]["count"] < N_THREADS * N_REQ, \
+        "soak traffic must actually coalesce (got 1 batch per request)"
+    srv.stop()
+    srv.cache.clear()
+    assert wall < 300
