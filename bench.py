@@ -92,7 +92,24 @@ def main():
         _fail("tpu relay unreachable (socket connect to 127.0.0.1:8082 "
               "refused/timed out before jax init); no measurement taken", 2)
 
-    img_s, err = _measure(210, 20, HARD_TIMEOUT_S)
+    # telemetry rides the primary leg: the training subprocess emits
+    # per-step JSONL and writes a Prometheus exposition at exit, so every
+    # BENCH capture carries the why (compiles, transfer bytes, io stalls)
+    # alongside the img/s.  Near-zero overhead: host-side counters only.
+    for stale in ("BENCH_STEPS.jsonl", "BENCH_TELEMETRY.prom"):
+        try:
+            os.unlink(os.path.join(HERE, stale))
+        except OSError:
+            pass
+    telemetry_env = {
+        "MXNET_TELEMETRY": "1",
+        "MXNET_TELEMETRY_STEP_LOG": os.path.join(HERE,
+                                                 "BENCH_STEPS.jsonl"),
+        "MXNET_TELEMETRY_STEP_INTERVAL": "1",
+        "MXNET_TELEMETRY_PROM_FILE": os.path.join(HERE,
+                                                  "BENCH_TELEMETRY.prom"),
+    }
+    img_s, err = _measure(210, 20, HARD_TIMEOUT_S, extra_env=telemetry_env)
     if err is not None:
         _fail(err[0], err[1])
     # the ONE stdout JSON line goes out IMMEDIATELY: nothing that runs

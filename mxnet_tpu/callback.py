@@ -86,6 +86,11 @@ class Speedometer:
             if self.auto_reset:
                 param.eval_metric.reset()
         speed = self.frequent * self.batch_size / (time.time() - self._tick)
+        from . import telemetry
+        if telemetry.enabled():
+            telemetry.gauge(
+                "mxnet_speed_samples_per_sec",
+                "Speedometer window throughput").set(round(speed, 3))
         head = ("Epoch[%d]" % param.epoch) if metric_parts \
             else ("Iter[%d]" % param.epoch)
         logging.info("\t".join(

@@ -135,3 +135,15 @@ register_env("MXNET_SERVING_DEFAULT_TIMEOUT_MS", float, 5000.0,
 register_env("MXNET_SERVING_EXECUTOR_CACHE", int, 16,
              "LRU capacity of the serving executor cache, in bound "
              "(model, version, bucket) programs; misses are recompiles")
+register_env("MXNET_TELEMETRY", bool, False,
+             "master switch for hot-path metrics instrumentation "
+             "(XLA compiles, device->host transfers, io fetch latency, "
+             "kvstore traffic); the registry itself is always live")
+register_env("MXNET_TELEMETRY_STEP_LOG", str, None,
+             "path for per-step JSONL emitted during fit() — one JSON "
+             "object per step with samples/sec and counter deltas")
+register_env("MXNET_TELEMETRY_STEP_INTERVAL", int, 1,
+             "emit a step-JSONL record every N batches")
+register_env("MXNET_TELEMETRY_PROM_FILE", str, None,
+             "write the registry's Prometheus text exposition to this "
+             "path at process exit (telemetry.write_prometheus)")

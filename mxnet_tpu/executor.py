@@ -72,6 +72,17 @@ class Executor:
         self._outputs = None
         self._cached_grads = None
         self._monitor_callback = None
+        # telemetry: a dispatch whose (program, shape-signature) pair is
+        # new compiles an XLA program; track pairs so compile count and
+        # compile-time histograms come from the bind/dispatch path itself
+        # (the serving cache's miss==recompile insight, generalized)
+        self._compile_seen = set()
+        from . import telemetry as _telemetry
+        if _telemetry.enabled():
+            _telemetry.counter(
+                "mxnet_executor_binds_total",
+                "executor binds (each bind's first dispatch per shape "
+                "compiles)").inc()
         self._is_loss_graph = bool(symbol._flat_outputs()) and all(
             (not n.is_variable) and n.op.name in _LOSS_OPS
             for (n, _i) in symbol._flat_outputs())
@@ -267,9 +278,10 @@ class Executor:
         if self._jit_fbu is None:
             self._jit_fbu = self._build_fbu()
         self._replay_key_data = key_dev  # for backward(out_grads) replay
-        outs, new_diff, new_states, new_aux, new_key = self._jit_fbu(
-            diff, rest, aux, key_dev, seeds, self._fused_state, lrs_dev,
-            wds_dev)
+        outs, new_diff, new_states, new_aux, new_key = \
+            self._dispatch_compiled(
+                "fbu", self._jit_fbu, diff, diff, rest, aux, key_dev,
+                seeds, self._fused_state, lrs_dev, wds_dev)
         self._fused_key = new_key
         self._fused_state = new_states
         for j, i in enumerate(self._diff_idx):
@@ -372,6 +384,46 @@ class Executor:
         self._last_key = sub
         return sub
 
+    def _dispatch_compiled(self, tag, fn, sig_arrays, *call_args):
+        """Dispatch a jitted program, accounting XLA compiles.
+
+        A compile is detected EXACTLY: jax's jit cache growing across
+        the call (``_cache_size``), so a program compiled before
+        telemetry was enabled is never miscounted as a recompile when a
+        measurement window opens mid-run.  The call's wall time is the
+        compile cost (dispatch itself is async and returns in
+        microseconds).  Disabled telemetry pays one boolean check and
+        an extra frame.  Fallback for jit objects without a cache-size
+        probe: a per-executor (tag, shapes) signature set."""
+        from . import telemetry
+        if not telemetry.enabled():
+            return fn(*call_args)
+        import time as _time
+        size_fn = getattr(fn, "_cache_size", None)
+        if size_fn is not None:
+            before = size_fn()
+            t0 = _time.perf_counter()
+            out = fn(*call_args)
+            compiled = size_fn() > before
+        else:
+            sig = (tag, tuple(tuple(a.shape) for a in sig_arrays))
+            compiled = sig not in self._compile_seen
+            t0 = _time.perf_counter()
+            out = fn(*call_args)
+            if compiled:
+                self._compile_seen.add(sig)
+        if compiled:
+            telemetry.counter(
+                "mxnet_xla_compiles_total",
+                "XLA program compilations observed at dispatch "
+                "(jit-cache growth; cache-miss == recompile)").inc()
+            telemetry.histogram(
+                "mxnet_xla_compile_seconds",
+                "wall time of compiling dispatches (trace + XLA compile)",
+                buckets=telemetry.exponential_buckets(0.001, 4.0, 12)
+            ).observe(_time.perf_counter() - t0)
+        return out
+
     def _args(self):
         return [self.arg_dict[n]._data for n in self.arg_names]
 
@@ -407,13 +459,18 @@ class Executor:
         elif is_train and self._diff_idx and self._is_loss_graph:
             key = self._next_key()
             seeds = self._default_seeds(args, aux, key)
-            outs, grads, new_aux = self._jit_fb(args, aux, key, seeds)
+            outs, grads, new_aux = self._dispatch_compiled(
+                "fb", self._jit_fb, args, args, aux, key, seeds)
             self._cached_grads = grads
             self._updates_applied = False
         else:
             key = self._next_key()
-            outs, new_aux = (self._jit_fwd_train(args, aux, key) if is_train
-                             else self._jit_fwd_eval(args, aux, key))
+            outs, new_aux = (
+                self._dispatch_compiled("fwd_train", self._jit_fwd_train,
+                                        args, args, aux, key)
+                if is_train else
+                self._dispatch_compiled("fwd_eval", self._jit_fwd_eval,
+                                        args, args, aux, key))
             self._cached_grads = None
         self._commit(outs, new_aux)
         if self._monitor_callback is not None and \
@@ -470,7 +527,8 @@ class Executor:
             else:
                 key = self._last_key
             args, aux = self._args(), self._aux()
-            _, grads, _ = self._jit_fb(args, aux, key, seeds)
+            _, grads, _ = self._dispatch_compiled(
+                "fb", self._jit_fb, args, args, aux, key, seeds)
         else:
             if self._cached_grads is None:
                 raise MXNetError(

@@ -18,14 +18,69 @@ TPU-native redesign:
 """
 from __future__ import annotations
 
+import functools
 import os
 import pickle
+import threading
+import time as _time
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
 __all__ = ["KVStore", "KVStoreDist", "create"]
+
+
+# -- telemetry ---------------------------------------------------------------
+# push/pull entry points are decorated with _instrumented("push"/"pull");
+# a thread-local reentrancy flag keeps super() chains (KVStoreTPU.pull ->
+# KVStore.pull) from double-counting one user-visible call.
+_TELEM_TL = threading.local()
+
+
+def _payload_nbytes(v):
+    """Host-metadata byte count of a push value / pull out tree."""
+    if isinstance(v, NDArray):
+        return int(v.size) * v.dtype.itemsize
+    if isinstance(v, (list, tuple)):
+        return sum(_payload_nbytes(x) for x in v)
+    if isinstance(v, dict):
+        return sum(_payload_nbytes(x) for x in v.values())
+    return 0
+
+
+def _instrumented(op):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, key, *args, **kwargs):
+            from . import telemetry
+            if not telemetry.enabled() or getattr(_TELEM_TL, "busy", False):
+                return fn(self, key, *args, **kwargs)
+            _TELEM_TL.busy = True
+            t0 = _time.perf_counter()
+            try:
+                result = fn(self, key, *args, **kwargs)
+            finally:
+                _TELEM_TL.busy = False
+            # success path only: a raising push/pull (spool-full timeout,
+            # uninitialized key) must not masquerade as delivered traffic
+            dt = _time.perf_counter() - t0
+            payload = (args[0] if args else
+                       kwargs.get("value") or kwargs.get("out"))
+            telemetry.counter(
+                "mxnet_kvstore_ops_total",
+                "completed kvstore data-plane calls").labels(op=op).inc()
+            telemetry.counter(
+                "mxnet_kvstore_bytes_total",
+                "payload bytes moved through kvstore push/pull"
+            ).labels(op=op).inc(_payload_nbytes(payload))
+            telemetry.histogram(
+                "mxnet_kvstore_op_seconds",
+                "wall time of completed kvstore push/pull calls").labels(
+                op=op).observe(dt)
+            return result
+        return wrapper
+    return deco
 
 
 def _ctype_key_value(keys, vals):
@@ -95,6 +150,7 @@ class KVStore:
             merged = NDArray(self._gc.compress_decompress(k, merged._data))
         return merged
 
+    @_instrumented("push")
     def push(self, key, value, priority=0):
         """Aggregate values into the store, applying the updater if set
         (reference: kvstore.py:158; server ApplyUpdates
@@ -109,6 +165,7 @@ class KVStore:
             else:
                 self._store[k] += merged
 
+    @_instrumented("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored values into out arrays (reference: kvstore.py:238)."""
         assert out is not None
@@ -275,6 +332,7 @@ class KVStoreTPU(KVStore):
             return None
         return type(o).__name__
 
+    @_instrumented("push")
     def push(self, key, value, priority=0):
         if self._updater is None or self._fused_kind() is None:
             return super().push(key, value, priority)
@@ -294,6 +352,7 @@ class KVStoreTPU(KVStore):
                 merged = self._gc.compress_decompress(k, merged)
             self._pending[k] = merged
 
+    @_instrumented("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if self._pending:
             self._flush()
@@ -758,6 +817,7 @@ class KVStoreDistAsync(KVStore):
                         % len(self._spool_files()))
                 time.sleep(0.005)
 
+    @_instrumented("push")
     def push(self, key, value, priority=0):
         """Spool the merged gradient and RETURN — no barrier, no wait;
         the server applies it on arrival.  A full spool blocks first
@@ -793,6 +853,7 @@ class KVStoreDistAsync(KVStore):
                     pass
             raise
 
+    @_instrumented("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Read the server's CURRENT weights — possibly missing pushes
         still in flight (that staleness is the async contract)."""

@@ -45,12 +45,17 @@ class Monitor:
         Regex; only node/array names matching it are recorded.
     sort : bool
         Sort the per-step report by name before returning it.
+    monitor_all : bool
+        Default for :meth:`install`: tap every internal node output
+        (reference monitor.py's monitor_all), not just the graph heads.
     """
 
-    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
         self.interval = int(interval)
         self.stat_func = stat_func or _mean_abs
         self.sort = sort
+        self.monitor_all = bool(monitor_all)
         self._name_filter = re.compile(pattern)
         self._records = []      # (step, name, raw stat) collected this window
         self._collecting = False
@@ -63,14 +68,18 @@ class Monitor:
         if self._collecting and self._name_filter.match(name):
             self._records.append((self._step, name, self.stat_func(arr)))
 
-    def install(self, exe):
-        """Attach to an executor.
+    def install(self, exe, monitor_all=False):
+        """Attach to an executor (reference signature:
+        ``python/mxnet/monitor.py`` ``install(exe, monitor_all=False)``).
 
-        ``monitor_all=True`` reproduces the reference's per-op engine tap
-        (graph_executor.cc:1444): every internal node output reaches
-        ``stat_helper``, with ``pattern`` deciding what is kept.
-        """
-        exe.set_monitor_callback(self.stat_helper, monitor_all=True)
+        With the default ``monitor_all=False`` only graph-head outputs
+        reach ``stat_helper`` (plus the argument snapshot ``toc`` takes
+        itself).  ``monitor_all=True`` — here or on the constructor —
+        reproduces the reference's per-op engine tap
+        (graph_executor.cc:1444): every internal node output is
+        reported, with ``pattern`` deciding what is kept."""
+        exe.set_monitor_callback(self.stat_helper,
+                                 monitor_all=monitor_all or self.monitor_all)
         self._executors.append(exe)
 
     # -- user-facing step protocol ----------------------------------

@@ -40,6 +40,32 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "arange", "empty",
            "imdecode", "moveaxis", "onehot_encode"]
 
 
+# sync-point metric handles, cached per registry generation (hot path:
+# one dict lookup per asnumpy would still be cheap, but these run per
+# output per request under the serving batcher — avoid the registry lock)
+_SYNC_METRICS = None
+
+
+def _sync_metrics():
+    global _SYNC_METRICS
+    from .. import telemetry
+    reg = telemetry.get_registry()
+    gen = reg.generation
+    if _SYNC_METRICS is None or _SYNC_METRICS[0] != gen:
+        _SYNC_METRICS = (
+            gen,
+            reg.counter("mxnet_sync_waits_total",
+                        "host blocks on device work "
+                        "(wait_to_read/waitall)").labels(),
+            reg.counter("mxnet_transfer_d2h_total",
+                        "device->host copies (asnumpy sync points)"
+                        ).labels(),
+            reg.counter("mxnet_transfer_d2h_bytes_total",
+                        "bytes copied device->host at asnumpy sync "
+                        "points").labels())
+    return _SYNC_METRICS
+
+
 def _dev_ctx(jarr):
     try:
         dev = next(iter(jarr.devices()))
@@ -221,17 +247,27 @@ class NDArray:
     def wait_to_read(self):
         """Reference: NDArray::WaitToRead (include/mxnet/ndarray.h:305);
         sync points rethrow deferred worker exceptions."""
-        from .. import engine
+        from .. import engine, telemetry
         engine.check_raise()
+        if telemetry.enabled():
+            _sync_metrics()[1].inc()
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
 
     def asnumpy(self):
-        """Blocking copy to host (reference: ndarray.py asnumpy)."""
-        from .. import engine
+        """Blocking copy to host (reference: ndarray.py asnumpy).
+
+        Telemetry: each call is one device->host transfer of the whole
+        buffer — the sync point the ISSUE's transfer accounting counts."""
+        from .. import engine, telemetry
         engine.check_raise()
-        return np.asarray(self._data)
+        data = self._data
+        if telemetry.enabled():
+            _gen, _sync, d2h, d2h_bytes = _sync_metrics()
+            d2h.inc()
+            d2h_bytes.inc(int(data.size) * np.dtype(data.dtype).itemsize)
+        return np.asarray(data)
 
     def asscalar(self):
         if self.size != 1:
@@ -752,7 +788,9 @@ def waitall():
     Rethrows exceptions recorded by worker threads (prefetchers, custom
     ops) — the reference's async-exception contract
     (threaded_engine.cc:463-467, test_exc_handling.py)."""
-    from .. import engine
+    from .. import engine, telemetry
+    if telemetry.enabled():
+        _sync_metrics()[1].inc()
     (jax.effects_barrier if hasattr(jax, "effects_barrier")
      else lambda: None)()
     engine.check_raise()

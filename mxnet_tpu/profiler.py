@@ -123,9 +123,18 @@ def scope(name, cat="task", args=None):
 
 
 def dumps(reset=False):
-    """Return chrome-trace JSON string (reference: profiler.py dumps)."""
+    """Return chrome-trace JSON string (reference: profiler.py dumps).
+
+    Telemetry bridge: the metrics registry's scalar totals are appended
+    as ``'C'`` counter events, so one dumped trace carries spans AND
+    counters (the ISSUE's one-trace contract)."""
+    try:
+        from . import telemetry as _telemetry
+        extra = _telemetry.chrome_counter_events(_now_us())
+    except Exception:
+        extra = []
     with _STATE["lock"]:
-        events = list(_STATE["events"])
+        events = list(_STATE["events"]) + extra
         if reset:
             _STATE["events"] = []
     return json.dumps({"traceEvents": events, "displayTimeUnit": "ms"},
@@ -134,9 +143,16 @@ def dumps(reset=False):
 
 def dump(finished=True, profile_process="worker"):
     """Write chrome-trace JSON to the configured file (reference:
-    profiler.py dump)."""
+    profiler.py dump).
+
+    ``finished=True`` matches the reference contract: profiling is over
+    — an active jax device trace is stopped, the profiler stops, and
+    the dumped events are cleared so a later window starts clean.
+    ``finished=False`` is a mid-run flush that keeps everything going."""
     with open(_STATE["filename"], "w") as f:
-        f.write(dumps())
+        f.write(dumps(reset=finished))
+    if finished and _STATE["running"]:
+        set_state("stop")   # one stop sequence (jax trace incl.)
 
 
 dump_profile = dump  # deprecated alias (reference keeps it)
@@ -213,25 +229,36 @@ class Event(_Span):
 
 
 class Counter:
-    """Numeric counter series (reference: profiler.py Counter)."""
+    """Numeric counter series (reference: profiler.py Counter).
+
+    increment/decrement are read-modify-writes on ``_value`` shared
+    across threads (serving's queue-depth counter is poked from every
+    client thread), so they hold a per-counter lock."""
 
     def __init__(self, domain, name, value=None):
         self.domain = domain
         self.name = name
         self._value = 0
+        self._lock = threading.Lock()
         if value is not None:
             self.set_value(value)
 
-    def set_value(self, value):
+    def _set_locked(self, value):
         self._value = value
         _record(self.name, "counter", "C",
                 args={self.name: value, "domain": str(self.domain)})
 
+    def set_value(self, value):
+        with self._lock:
+            self._set_locked(value)
+
     def increment(self, delta=1):
-        self.set_value(self._value + delta)
+        with self._lock:
+            self._set_locked(self._value + delta)
 
     def decrement(self, delta=1):
-        self.set_value(self._value - delta)
+        with self._lock:
+            self._set_locked(self._value - delta)
 
     def __iadd__(self, v):
         self.increment(v)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 
+from .. import telemetry
 from ..predictor import Predictor
 
 __all__ = ["ExecutorCache"]
@@ -35,6 +36,14 @@ class ExecutorCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # per-instance ints stay the stats() source of truth; the shared
+        # telemetry namespace mirrors them so one snapshot()/exposition
+        # correlates serving recompiles with the executor's XLA-compile
+        # counter (a miss is a bind, a bind's first forward compiles)
+        self._t_events = telemetry.counter(
+            "mxnet_serving_cache_events_total",
+            "executor-cache lookups by outcome (hit/miss/eviction); "
+            "miss count IS the serving recompile count")
 
     def get(self, entry, bucket):
         """The bound predictor for ``entry`` (a ModelVersion) at
@@ -52,6 +61,7 @@ class ExecutorCache:
             cached = self._entries.get(key)
             if cached is not None:
                 self.hits += 1
+                self._t_events.labels(outcome="hit").inc()
                 self._entries.move_to_end(key)
                 return cached[1]
         # bind OUTSIDE the lock: a compile can take seconds and must not
@@ -63,13 +73,16 @@ class ExecutorCache:
             race = self._entries.get(key)
             if race is not None:        # another thread bound it first
                 self.hits += 1
+                self._t_events.labels(outcome="hit").inc()
                 self._entries.move_to_end(key)
                 return race[1]
             self.misses += 1
+            self._t_events.labels(outcome="miss").inc()
             self._entries[key] = (entry, pred)
             while len(self._entries) > self._capacity:
                 self._entries.popitem(last=False)
                 self.evictions += 1
+                self._t_events.labels(outcome="eviction").inc()
             return pred
 
     def invalidate(self, name, version=None):

@@ -44,6 +44,7 @@ import numpy as np
 from .. import config
 from .. import engine
 from .. import profiler
+from .. import telemetry
 from ..io import pad_batch
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
@@ -185,21 +186,46 @@ class ModelServer:
         self._stopping = False
         self._drain = True
         self._thread = None
-        # -- metrics (all under _mlock) -------------------------------------
+        # -- metrics --------------------------------------------------------
+        # dual-written: per-instance ints back stats() — an EXACT
+        # per-server view even with several servers alive in one process
+        # — while the process-wide telemetry registry mirrors every
+        # increment under mxnet_serving_* so serving and training share
+        # one metric namespace (snapshot()/Prometheus see cross-server
+        # totals).
+        self._t_requests = telemetry.counter(
+            "mxnet_serving_requests_total",
+            "serving requests by outcome (submitted/served/failed/"
+            "rejected_queue_full/expired)")
+        self._t_batches = telemetry.counter(
+            "mxnet_serving_batches_total",
+            "executed micro-batches per shape bucket")
+        self._t_batch_rows = telemetry.counter(
+            "mxnet_serving_batch_rows_total",
+            "rows dispatched per shape bucket (fill = rows / "
+            "(batches * bucket))")
+        self._t_queue_depth = telemetry.gauge(
+            "mxnet_serving_queue_depth",
+            "requests currently queued for the batcher")
+        self._t_latency = telemetry.histogram(
+            "mxnet_serving_latency_ms",
+            "submit-to-result latency of served requests",
+            buckets=telemetry.exponential_buckets(0.5, 2.0, 14))
         self._mlock = threading.Lock()
-        self._submitted = 0
-        self._served = 0
-        self._failed = 0
-        self._rejected_full = 0
-        self._expired = 0
-        self._batches = 0
-        self._batch_rows = 0
+        self._req_counts = {o: 0 for o in ("submitted", "served", "failed",
+                                           "rejected_queue_full", "expired")}
         self._batch_hist = {}              # bucket -> [batches, rows]
         self._latencies = []               # ring buffer, newest last
         self._lat_cap = 4096
         self._queue_peak = 0
         self._domain = profiler.Domain("serving")
         self._q_counter = self._domain.new_counter("serving_queue_depth")
+
+    def _req_inc(self, outcome, n=1):
+        if n:
+            with self._mlock:
+                self._req_counts[outcome] += n
+            self._t_requests.labels(outcome=outcome).inc(n)
 
     # -- model management ---------------------------------------------------
     def load_model(self, name, symbol_file, param_file, input_shapes,
@@ -316,19 +342,19 @@ class ModelServer:
             if self._stopping:
                 raise ServerClosed("server is stopping")
             if len(self._queue) >= self._queue_depth:
-                with self._mlock:
-                    self._rejected_full += 1
+                self._req_inc("rejected_queue_full")
                 raise QueueFull(
                     "serving queue at capacity (%d requests); retry "
                     "later" % self._queue_depth)
             self._queue.append(req)
             depth = len(self._queue)
             self._cv.notify_all()
+        self._req_inc("submitted")
         with self._mlock:
-            self._submitted += 1
             if depth > self._queue_peak:
                 self._queue_peak = depth
         self._q_counter.set_value(depth)
+        self._t_queue_depth.set(depth)
         return fut
 
     def warmup(self, name=None, version=None, buckets=None,
@@ -389,9 +415,8 @@ class ModelServer:
                         got += 1
                     else:
                         gone += 1       # client already cancelled
-                with self._mlock:
-                    self._failed += got
-                    self._expired += gone
+                self._req_inc("failed", got)
+                self._req_inc("expired", gone)
                 return got > 0
 
             with engine.worker_scope(deliver):
@@ -424,14 +449,12 @@ class ModelServer:
         keep = []
         for r in self._queue:
             if r.future.cancelled():
-                with self._mlock:
-                    self._expired += 1
+                self._req_inc("expired")
                 continue
             if r.future._expired(now):
                 r.future._set_exception(DeadlineExceeded(
                     "deadline passed while queued"))
-                with self._mlock:
-                    self._expired += 1
+                self._req_inc("expired")
                 continue
             keep.append(r)
         if len(keep) != len(self._queue):
@@ -444,6 +467,7 @@ class ModelServer:
         if head.solo:            # exactly this request, exactly its bucket
             self._queue.remove(head)
             self._q_counter.set_value(len(self._queue))
+            self._t_queue_depth.set(len(self._queue))
             return [head], head.entry, pick_bucket(head.rows, self._buckets)
         taken, rows = [], 0
         rest = []
@@ -456,6 +480,7 @@ class ModelServer:
                 rest.append(r)
         self._queue[:] = rest
         self._q_counter.set_value(len(rest))
+        self._t_queue_depth.set(len(rest))
         return taken, head.entry, pick_bucket(rows, self._buckets)
 
     def _execute(self, reqs, entry, bucket):
@@ -476,45 +501,55 @@ class ModelServer:
             sl = [o[off:off + r.rows] for o in outs]
             off += r.rows
             if r.future._set_result(sl):
+                lat = t_done - r.t_submit
+                self._req_inc("served")
+                self._t_latency.observe(lat)
                 with self._mlock:
-                    self._served += 1
-                    self._latencies.append(t_done - r.t_submit)
+                    self._latencies.append(lat)
                     if len(self._latencies) > self._lat_cap:
                         del self._latencies[:-self._lat_cap]
             else:
-                with self._mlock:
-                    self._expired += 1
+                self._req_inc("expired")
         with self._mlock:
-            self._batches += 1
-            self._batch_rows += rows_total
             h = self._batch_hist.setdefault(bucket, [0, 0])
             h[0] += 1
             h[1] += rows_total
+        self._t_batches.labels(bucket=bucket).inc()
+        self._t_batch_rows.labels(bucket=bucket).inc(rows_total)
 
     # -- observability ------------------------------------------------------
     def stats(self):
-        """One consistent /stats snapshot (all counters since start)."""
+        """One consistent /stats snapshot (all counters since start).
+
+        Every counter here is mirrored into the process-wide telemetry
+        registry under the ``mxnet_serving_*`` names, so the same
+        numbers (summed across servers) appear in
+        ``telemetry.snapshot()`` and the Prometheus exposition."""
         with self._cv:
             depth = len(self._queue)
         with self._mlock:
             lats = list(self._latencies)
-            occupancy = {
-                b: {"batches": n, "rows": r,
-                    "fill": round(r / float(n * b), 4)}
-                for b, (n, r) in sorted(self._batch_hist.items())}
-            snap = {
-                "queue": {"depth": depth, "peak": self._queue_peak,
-                          "limit": self._queue_depth},
-                "requests": {"submitted": self._submitted,
-                             "served": self._served,
-                             "failed": self._failed,
-                             "rejected_queue_full": self._rejected_full,
-                             "expired": self._expired},
-                "batches": {"count": self._batches,
-                            "rows": self._batch_rows,
-                            "occupancy": occupancy},
-                "buckets": list(self._buckets),
-            }
+            peak = self._queue_peak
+            req = dict(self._req_counts)
+            hist = {b: tuple(nr) for b, nr in self._batch_hist.items()}
+        occupancy = {
+            b: {"batches": n, "rows": r,
+                "fill": round(r / float(n * b), 4)}
+            for b, (n, r) in sorted(hist.items())}
+        snap = {
+            "queue": {"depth": depth, "peak": peak,
+                      "limit": self._queue_depth},
+            "requests": {
+                "submitted": req["submitted"],
+                "served": req["served"],
+                "failed": req["failed"],
+                "rejected_queue_full": req["rejected_queue_full"],
+                "expired": req["expired"]},
+            "batches": {"count": sum(n for n, _r in hist.values()),
+                        "rows": sum(r for _n, r in hist.values()),
+                        "occupancy": occupancy},
+            "buckets": list(self._buckets),
+        }
         snap["latency_ms"] = {
             "count": len(lats),
             "p50": round(float(np.percentile(lats, 50)), 3) if lats else None,

@@ -222,7 +222,7 @@ def test_monitor_taps_internal_nodes():
     out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(act, num_hidden=3,
                                                      name="fc2"),
                                name="softmax")
-    mon = mx.Monitor(1, pattern=".*")
+    mon = mx.Monitor(1, pattern=".*", monitor_all=True)
     mod = mx.mod.Module(out, context=mx.cpu())
     it = mx.io.NDArrayIter(np.random.rand(30, 5).astype(np.float32),
                            np.random.randint(0, 3, 30).astype(np.float32),
@@ -237,9 +237,36 @@ def test_monitor_taps_internal_nodes():
                    "softmax_output"):
         assert any(expect in n for n in names), (expect, names)
     # pattern filtering still applies
-    mon2 = mx.Monitor(1, pattern=".*relu.*")
+    mon2 = mx.Monitor(1, pattern=".*relu.*", monitor_all=True)
     mod.install_monitor(mon2)
     mon2.tic()
     mod.forward(next(it), is_train=True)
     names2 = [r[1] for r in mon2.toc()]
     assert names2 and all("relu" in n for n in names2), names2
+
+
+def test_monitor_install_default_taps_heads_only():
+    """Reference signature parity (python/mxnet/monitor.py): install's
+    default is monitor_all=False — only graph-head outputs reach the
+    callback (plus toc's own argument snapshot), NOT every internal
+    node."""
+    import numpy as np
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    out = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(act, num_hidden=3,
+                                                     name="fc2"),
+                               name="softmax")
+    mon = mx.Monitor(1, pattern=".*")          # default monitor_all=False
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(data=[nd.ones((4, 5))],
+                                label=[nd.zeros((4,))]), is_train=False)
+    names = [r[1] for r in mon.toc()]
+    assert any("softmax_output" in n for n in names), names
+    assert not any("relu1_output" in n for n in names), \
+        "internal taps require monitor_all=True"

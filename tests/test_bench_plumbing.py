@@ -73,3 +73,24 @@ def test_malformed_budget_falls_back_to_default(bench_mod, tmp_path,
     rd = json.loads((tmp_path / "BENCH_RIDERS.json").read_text())
     assert rd["unfused_metric_vs_baseline"] == 1.0  # legs still ran
     capsys.readouterr()
+
+
+def test_primary_leg_carries_telemetry_knobs(bench_mod, tmp_path, capsys,
+                                             monkeypatch):
+    """Every bench capture ships the why alongside the img/s: the
+    primary measurement subprocess runs with telemetry enabled, a
+    step-JSONL path, and a Prometheus exposition path — and stale
+    artifacts from a previous run are removed first."""
+    stale = tmp_path / "BENCH_STEPS.jsonl"
+    stale.write_text('{"old": true}\n')
+    monkeypatch.setenv("MXNET_BENCH_SECONDARY_BUDGET_S", "0")
+    bench_mod.main()
+    capsys.readouterr()
+    primary = bench_mod._test_calls[0]
+    assert primary["MXNET_TELEMETRY"] == "1"
+    assert primary["MXNET_TELEMETRY_STEP_LOG"] == \
+        str(tmp_path / "BENCH_STEPS.jsonl")
+    assert primary["MXNET_TELEMETRY_PROM_FILE"] == \
+        str(tmp_path / "BENCH_TELEMETRY.prom")
+    assert not stale.exists(), \
+        "a new bench run must not append to a previous run's step log"

@@ -89,6 +89,83 @@ def test_image_record_iter_error_reaches_waitall(tmp_path):
     it.close()
 
 
+def test_worker_scope_orphan_when_deliver_absent():
+    """No deliver callback: the error is recorded and surfaces at the
+    next sync point instead of vanishing with the worker thread."""
+    with engine.worker_scope():
+        raise ValueError("orphan-absent")
+    with pytest.raises(ValueError, match="orphan-absent"):
+        nd.waitall()
+    nd.waitall()   # cleared after the rethrow
+
+
+def test_worker_scope_orphan_when_deliver_returns_falsy():
+    """deliver reporting no live receiver (falsy return) falls back to
+    record_exception."""
+    seen = []
+    with engine.worker_scope(deliver=lambda exc: seen.append(exc) and None):
+        raise ValueError("orphan-falsy")
+    assert len(seen) == 1
+    with pytest.raises(ValueError, match="orphan-falsy"):
+        nd.waitall()
+
+
+def test_worker_scope_orphan_when_deliver_raises():
+    """A deliver that itself raises must not replace the original error
+    — the ORIGINAL exception reaches the sync point."""
+    def bad_deliver(exc):
+        raise RuntimeError("receiver infrastructure gone")
+
+    with engine.worker_scope(deliver=bad_deliver):
+        raise ValueError("orphan-raising")
+    with pytest.raises(ValueError, match="orphan-raising"):
+        nd.waitall()
+
+
+def test_worker_scope_delivered_error_skips_sync_point():
+    """A successfully delivered error (truthy return — e.g. the serving
+    batcher failing its own requests' futures) must NOT also poison the
+    global sync point."""
+    got = []
+    with engine.worker_scope(deliver=lambda exc: got.append(exc) or True):
+        raise ValueError("delivered")
+    assert len(got) == 1 and str(got[0]) == "delivered"
+    nd.waitall()   # no rethrow
+
+
+def test_worker_scope_does_not_swallow_success():
+    ran = []
+    with engine.worker_scope(deliver=lambda exc: True):
+        ran.append(1)
+    assert ran == [1]
+    nd.waitall()
+
+
+def test_nested_naive_scopes():
+    """naive() scopes nest: the flag stays active until the OUTERMOST
+    scope exits (a depth counter, not a boolean)."""
+    assert not engine.naive_scope_active()
+    with engine.naive():
+        assert engine.naive_scope_active()
+        with engine.naive():
+            assert engine.naive_scope_active()
+            a = (nd.ones((2, 2)) * 3).asnumpy()
+            assert np.array_equal(a, np.full((2, 2), 3.0))
+        # inner exit must NOT deactivate the outer scope
+        assert engine.naive_scope_active()
+    assert not engine.naive_scope_active()
+
+
+def test_nested_naive_scope_survives_exception():
+    """An exception inside an inner scope still unwinds the depth
+    correctly (finally-based decrement)."""
+    with pytest.raises(RuntimeError):
+        with engine.naive():
+            with engine.naive():
+                raise RuntimeError("inner boom")
+    assert not engine.naive_scope_active()
+
+
 def test_naive_engine_scope_matches_async():
     """The deterministic serial oracle (reference NaiveEngine) computes
     identical results to the default async path."""

@@ -121,3 +121,40 @@ def test_every_registered_env_var_is_documented():
                if not re.search(r"\b%s\b" % re.escape(name), text)]
     assert not missing, \
         "registered env vars missing from docs/faq/env_var.md: %s" % missing
+
+
+def test_telemetry_knobs_registered_and_documented():
+    """Registry-drift guard extended to the telemetry knobs: every
+    MXNET_TELEMETRY* name referenced anywhere in the package source (or
+    bench.py) must be declared via register_env AND documented in
+    docs/faq/env_var.md — a knob added at a call site without registry +
+    docs rows fails here."""
+    import glob
+    import re
+    from mxnet_tpu import config
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources = glob.glob(os.path.join(root, "mxnet_tpu", "**", "*.py"),
+                        recursive=True) + [os.path.join(root, "bench.py")]
+    used = set()
+    for path in sources:
+        with open(path) as f:
+            text = f.read()
+        for name in re.findall(r"MXNET_TELEMETRY[A-Z_]*", text):
+            name = name.rstrip("_")   # docstring wildcards like _*
+            if name:
+                used.add(name)
+    assert {"MXNET_TELEMETRY", "MXNET_TELEMETRY_STEP_LOG",
+            "MXNET_TELEMETRY_STEP_INTERVAL",
+            "MXNET_TELEMETRY_PROM_FILE"} <= used
+    unregistered = sorted(n for n in used if n not in config._REGISTRY)
+    assert not unregistered, \
+        "telemetry knobs referenced but never register_env'd: %s" \
+        % unregistered
+    doc = os.path.join(root, "docs", "faq", "env_var.md")
+    with open(doc) as f:
+        doc_text = f.read()
+    undocumented = sorted(
+        n for n in used
+        if not re.search(r"\b%s\b" % re.escape(n), doc_text))
+    assert not undocumented, \
+        "telemetry knobs missing from docs/faq/env_var.md: %s" % undocumented

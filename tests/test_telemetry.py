@@ -1,0 +1,420 @@
+"""Runtime telemetry: the unified metrics registry and its hooks.
+
+Pins the ISSUE-3 acceptance surface:
+- registry semantics (Counter/Gauge/Histogram, labels, thread safety),
+- JSON snapshot + Prometheus exposition validity (round-tripped
+  through ``telemetry.validate_exposition``),
+- XLA-compile accounting: the compile counter matches the serving
+  executor cache's observed miss count (miss == bind == recompile),
+- fit() emits parseable per-step JSONL and bridges counters into the
+  profiler's chrome-trace stream,
+- the disabled fast path records nothing (near-zero overhead guard),
+- profiler satellite fixes: dump(finished=) honored, Counter locked.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, telemetry
+
+
+@pytest.fixture()
+def fresh(monkeypatch):
+    """A clean, ENABLED registry; everything off again afterwards."""
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _train_iter(n=40, batch=10):
+    return mx.io.NDArrayIter(
+        np.random.rand(n, 6).astype(np.float32),
+        np.random.randint(0, 4, n).astype(np.float32),
+        batch_size=batch, label_name="softmax_label")
+
+
+# -- registry semantics ------------------------------------------------------
+def test_counter_gauge_histogram_semantics(fresh):
+    c = fresh.counter("c_total", "a counter")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = fresh.gauge("g")
+    g.set(7)
+    g.dec(2)
+    g.inc()
+    assert g.value == 6
+    h = fresh.histogram("h_seconds", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4 and abs(h.sum - 55.55) < 1e-9
+    buckets = h.buckets()
+    assert buckets[-1][1] == 4          # +Inf cumulative == count
+    assert [b for _le, b in buckets] == [1, 2, 3, 4]   # cumulative
+    # type mismatch on an existing name fails loudly
+    with pytest.raises(ValueError):
+        fresh.gauge("c_total")
+
+
+def test_labels_and_family_total(fresh):
+    fam = fresh.counter("ops_total")
+    fam.labels(op="push").inc(3)
+    fam.labels(op="pull").inc(2)
+    assert fam.labels(op="push").value == 3
+    assert fam.total() == 5
+    pairs = {tuple(l.items()) for l, _c in fam.items()}
+    assert (("op", "push"),) in pairs and (("op", "pull"),) in pairs
+
+
+def test_counter_thread_safety(fresh):
+    c = fresh.counter("race_total")
+    n_threads, n_incs = 8, 10000
+
+    def worker():
+        for _ in range(n_incs):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_incs
+
+
+def test_exponential_buckets():
+    assert telemetry.exponential_buckets(1, 2, 4) == [1, 2, 4, 8]
+    with pytest.raises(ValueError):
+        telemetry.exponential_buckets(0, 2, 4)
+    with pytest.raises(ValueError):
+        telemetry.exponential_buckets(1, 1, 4)
+
+
+# -- views -------------------------------------------------------------------
+def test_snapshot_is_json_serializable(fresh):
+    fresh.counter("a_total").inc()
+    fresh.gauge("b").set(2.5)
+    fresh.histogram("c_seconds").observe(0.1)
+    fresh.counter("labeled_total").labels(kind="x").inc(7)
+    snap = json.loads(fresh.snapshot_json())
+    assert snap["a_total"]["type"] == "counter"
+    assert snap["a_total"]["values"][0]["value"] == 1
+    assert snap["c_seconds"]["values"][0]["count"] == 1
+    assert snap["c_seconds"]["values"][0]["buckets"][-1][0] == "+Inf"
+    assert snap["labeled_total"]["values"][0]["labels"] == {"kind": "x"}
+
+
+def test_prometheus_exposition_roundtrips_validator(fresh):
+    fresh.counter("plain_total", "help text").inc(3)
+    fresh.gauge("depth").set(-2)
+    fresh.counter("labeled_total").labels(op="push", store='we"ird').inc()
+    h = fresh.histogram("lat_seconds", "latency",
+                        buckets=telemetry.exponential_buckets(0.01, 4, 6))
+    h.observe(0.005)
+    h.observe(3.0)
+    text = fresh.prometheus_text()
+    samples = telemetry.validate_exposition(text)
+    assert ("", "3") in samples["plain_total"]
+    assert ("", "-2") in samples["depth"]
+    assert any("+Inf" in lbl for lbl, _v in samples["lat_seconds_bucket"])
+    assert samples["lat_seconds_count"] == [("", "2")]
+
+
+def test_validator_rejects_malformed_text():
+    with pytest.raises(ValueError, match="unparseable"):
+        telemetry.validate_exposition("# TYPE x counter\nx{ 1\n")
+    with pytest.raises(ValueError, match="no # TYPE"):
+        telemetry.validate_exposition("mystery_metric 1\n")
+    bad_hist = ("# TYPE h histogram\n"
+                'h_bucket{le="1.0"} 5\nh_bucket{le="+Inf"} 3\n'
+                "h_sum 1\nh_count 3\n")
+    with pytest.raises(ValueError, match="not cumulative"):
+        telemetry.validate_exposition(bad_hist)
+
+
+# -- acceptance: compile counter == executor-cache miss count ---------------
+def test_xla_compile_counter_matches_cache_misses(fresh):
+    from mxnet_tpu.serving import ModelRegistry
+    from mxnet_tpu.serving.cache import ExecutorCache
+
+    data = mx.sym.Variable("data")
+    out = mx.sym.softmax(mx.sym.FullyConnected(data, num_hidden=4,
+                                               name="fc"))
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": nd.array(rng.randn(4, 6).astype(np.float32)),
+            "fc_bias": nd.array(rng.randn(4).astype(np.float32))}
+    reg = ModelRegistry()
+    reg.add("m", out, args, {}, {"data": (1, 6)})
+    entry = reg.get("m")
+    cache = ExecutorCache(capacity=8)
+
+    def compiles():
+        fam = fresh.get_registry().scalar_totals()
+        return fam.get("mxnet_xla_compiles_total", 0)
+
+    base = compiles()
+    for bucket in (1, 2, 4):
+        pred = cache.get(entry, bucket)
+        pred.forward(data=np.zeros((bucket, 6), np.float32))
+        pred.get_output(0).asnumpy()
+    assert cache.stats()["misses"] == 3
+    assert compiles() - base == cache.stats()["misses"], \
+        "every cache miss is a bind whose first forward compiles"
+    # steady state: repeat lookups are hits and compile nothing
+    for bucket in (1, 2, 4):
+        pred = cache.get(entry, bucket)
+        pred.forward(data=np.zeros((bucket, 6), np.float32))
+        pred.get_output(0).asnumpy()
+    assert cache.stats()["misses"] == 3
+    assert compiles() - base == 3
+    # the mirror counters share the namespace
+    snap = fresh.snapshot()
+    assert "mxnet_serving_cache_events_total" in snap
+    assert "mxnet_executor_binds_total" in snap
+
+
+def test_enabling_mid_run_does_not_count_warm_dispatches(fresh):
+    """Compile detection is exact (jit-cache growth): a program compiled
+    BEFORE telemetry was enabled must not be counted as a recompile when
+    a measurement window opens mid-run."""
+    telemetry.disable()
+    data = mx.sym.Variable("data")
+    out = mx.sym.softmax(mx.sym.FullyConnected(data, num_hidden=3,
+                                               name="fc"))
+    exe = out.simple_bind(data=(2, 5))
+    exe.forward(is_train=False, data=np.zeros((2, 5), np.float32))  # compiles
+    telemetry.enable()
+    exe.forward(is_train=False, data=np.ones((2, 5), np.float32))   # warm
+    totals = fresh.get_registry().scalar_totals()
+    assert totals.get("mxnet_xla_compiles_total", 0) == 0, \
+        "warm dispatch after enable() miscounted as a compile"
+    exe2 = exe.reshape(data=(5, 5), allow_up_sizing=True)
+    exe2.forward(is_train=False, data=np.ones((5, 5), np.float32))  # cold
+    totals = fresh.get_registry().scalar_totals()
+    assert totals["mxnet_xla_compiles_total"] == 1
+
+
+# -- fit(): step JSONL + exposition + chrome bridge --------------------------
+def test_fit_emits_step_jsonl_and_valid_exposition(fresh, tmp_path,
+                                                   monkeypatch):
+    log_path = tmp_path / "steps.jsonl"
+    prom_path = tmp_path / "final.prom"
+    monkeypatch.setenv("MXNET_TELEMETRY_STEP_LOG", str(log_path))
+    monkeypatch.setenv("MXNET_TELEMETRY_PROM_FILE", str(prom_path))
+    it = _train_iter(n=40, batch=10)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),))
+    lines = log_path.read_text().splitlines()
+    assert len(lines) == 8, "4 batches x 2 epochs, one record each"
+    records = [json.loads(l) for l in lines]          # parses line-by-line
+    for i, r in enumerate(records):
+        assert r["step"] == i + 1
+        assert "ts" in r and "epoch" in r and "nbatch" in r
+        assert r["samples"] == 10
+        assert "mxnet_xla_compiles_total" in r
+        assert "mxnet_xla_compiles_delta" in r
+    assert any("samples_per_sec" in r for r in records[1:])
+    assert records[-1]["mxnet_xla_compiles_total"] >= 1, \
+        "a training run compiles at least one XLA program"
+    # compile deltas go quiet after warmup: the last record adds none
+    assert records[-1]["mxnet_xla_compiles_delta"] == 0
+    assert "metrics" in records[-1]
+    # exposition from the same run validates + lands on disk
+    telemetry.validate_exposition(fresh.prometheus_text())
+    written = fresh.write_prometheus()
+    assert written == str(prom_path)
+    telemetry.validate_exposition(prom_path.read_text())
+
+
+def test_step_logger_direct_and_interval(fresh, tmp_path):
+    path = tmp_path / "s.jsonl"
+    fresh.counter("mxnet_io_batches_total").inc(5)
+    with telemetry.StepLogger(str(path), batch_size=4, interval=2) as sl:
+        for _ in range(4):
+            sl(None)
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in records] == [2, 4]
+    assert records[0]["mxnet_io_batches_total"] == 5
+    assert records[0]["mxnet_io_batches_delta"] == 5
+    assert records[1]["mxnet_io_batches_delta"] == 0
+
+
+def test_counters_bridge_into_chrome_trace(fresh, tmp_path):
+    from mxnet_tpu import profiler
+    fname = str(tmp_path / "trace.json")
+    fresh.counter("mxnet_xla_compiles_total").inc(2)
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.scope("step"):
+        pass
+    sl = telemetry.StepLogger(str(tmp_path / "s.jsonl"), batch_size=1)
+    sl(None)          # publishes 'C' samples into the running trace
+    sl.close()
+    profiler.set_state("stop")
+    trace = json.loads(profiler.dumps())
+    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"
+                and e["name"] == "mxnet_xla_compiles_total"]
+    assert counters, "registry counters must appear as 'C' events"
+    assert counters[-1]["args"]["mxnet_xla_compiles_total"] == 2
+    assert any(e["name"] == "step" for e in trace["traceEvents"]), \
+        "spans and counters share one trace"
+
+
+# -- disabled fast path ------------------------------------------------------
+def test_disabled_paths_record_nothing():
+    telemetry.disable()
+    telemetry.reset()
+    x = nd.ones((2, 3))
+    x.asnumpy()
+    x.wait_to_read()
+    nd.waitall()
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((4,)))
+    kv.push("w", nd.ones((4,)))
+    kv.pull("w", out=nd.zeros((4,)))
+    for _batch in _train_iter(n=20, batch=10):
+        pass
+    assert telemetry.snapshot() == {}, \
+        "disabled telemetry must leave the registry untouched"
+    # the gate itself is one list read — generous bound, not a benchmark
+    t0 = time.perf_counter()
+    for _ in range(100000):
+        telemetry.enabled()
+    assert time.perf_counter() - t0 < 1.0
+
+
+# -- instrumented subsystems -------------------------------------------------
+def test_kvstore_push_pull_accounting(fresh):
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((8,)))
+    kv.push("w", nd.ones((8,)))
+    out = nd.zeros((8,))
+    kv.pull("w", out=out)
+    snap = fresh.snapshot()
+    ops = {tuple(v["labels"].items()): v["value"]
+           for v in snap["mxnet_kvstore_ops_total"]["values"]}
+    assert ops[(("op", "push"),)] == 1
+    assert ops[(("op", "pull"),)] == 1
+    byts = {tuple(v["labels"].items()): v["value"]
+            for v in snap["mxnet_kvstore_bytes_total"]["values"]}
+    assert byts[(("op", "push"),)] == 32   # 8 x float32
+    assert byts[(("op", "pull"),)] == 32
+    hist = snap["mxnet_kvstore_op_seconds"]["values"]
+    assert sum(v["count"] for v in hist) == 2
+
+
+def test_io_fetch_latency_and_prefetch_depth(fresh):
+    it = mx.io.PrefetchingIter(_train_iter(n=20, batch=10))
+    batches = sum(1 for _b in it)
+    assert batches == 2
+    snap = fresh.snapshot()
+    fetch = snap["mxnet_io_batch_fetch_seconds"]["values"][0]
+    assert fetch["count"] == batches
+    assert snap["mxnet_io_batches_total"]["values"][0]["value"] == batches
+    depth = snap["mxnet_io_prefetch_depth"]["values"]
+    assert any(v["labels"] == {"pipeline": "prefetching"} for v in depth)
+
+
+def test_speedometer_sets_throughput_gauge(fresh):
+    from mxnet_tpu.model import BatchEndParam
+    speedo = mx.callback.Speedometer(batch_size=4, frequent=2)
+    for nbatch in range(5):
+        speedo(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    snap = fresh.snapshot()
+    assert snap["mxnet_speed_samples_per_sec"]["values"][0]["value"] > 0
+
+
+def test_serving_stats_share_registry_namespace(fresh):
+    from mxnet_tpu.serving import ModelServer
+    data = mx.sym.Variable("data")
+    out = mx.sym.softmax(mx.sym.FullyConnected(data, num_hidden=4,
+                                               name="fc"))
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": nd.array(rng.randn(4, 6).astype(np.float32)),
+            "fc_bias": nd.array(rng.randn(4).astype(np.float32))}
+    srv = ModelServer(max_batch=4, batch_wait_ms=1.0)
+    srv.add_model("m", out, args, {}, {"data": (1, 6)})
+    srv.start()
+    try:
+        for _ in range(3):
+            srv.infer("m", {"data": np.zeros((1, 6), np.float32)})
+    finally:
+        srv.stop()
+    stats = srv.stats()
+    assert stats["requests"]["submitted"] == 3
+    assert stats["requests"]["served"] == 3
+    snap = fresh.snapshot()
+    req = {v["labels"]["outcome"]: v["value"]
+           for v in snap["mxnet_serving_requests_total"]["values"]}
+    # the per-server stats() view and the registry agree (fresh registry)
+    assert req["submitted"] == 3 and req["served"] == 3
+    assert "mxnet_serving_latency_ms" in snap
+    assert "mxnet_serving_batches_total" in snap
+    occ = stats["batches"]["occupancy"]
+    assert sum(v["batches"] for v in occ.values()) >= 1
+
+
+# -- profiler satellite fixes ------------------------------------------------
+def test_profiler_dump_honors_finished(tmp_path):
+    from mxnet_tpu import profiler
+    fname = str(tmp_path / "t.json")
+    profiler.set_config(filename=fname)
+    profiler.set_state("run")
+    with profiler.scope("span-a"):
+        pass
+    profiler.dump(finished=True)
+    assert not profiler.is_running(), \
+        "dump(finished=True) must stop the profiler"
+    with open(fname) as f:
+        first = json.load(f)
+    assert any(e["name"] == "span-a" for e in first["traceEvents"])
+    # events were cleared: a second dump has no span-a
+    profiler.dump()
+    with open(fname) as f:
+        second = json.load(f)
+    assert not any(e["name"] == "span-a" for e in second["traceEvents"])
+    # finished=False flushes without stopping or clearing
+    profiler.set_state("run")
+    with profiler.scope("span-b"):
+        pass
+    profiler.dump(finished=False)
+    assert profiler.is_running()
+    profiler.dump(finished=True)
+    with open(fname) as f:
+        final = json.load(f)
+    assert any(e["name"] == "span-b" for e in final["traceEvents"])
+
+
+def test_profiler_counter_increment_is_locked():
+    from mxnet_tpu import profiler
+    c = profiler.Domain("t").new_counter("racer", 0)
+    n_threads, n_incs = 8, 5000
+
+    def worker():
+        for _ in range(n_incs):
+            c.increment()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c._value == n_threads * n_incs
