@@ -1,0 +1,33 @@
+"""graftlint — AST static analysis with TPU/JAX-aware checkers.
+
+The compile-time counterpart of the telemetry registry (PR 3): the
+runtime counts recompiles, device->host syncs, and lock races after
+they cost a step; these checkers catch the source patterns that cause
+them before they ship.  Rules:
+
+- ``recompile-hazard`` — value branching / trace-time formatting /
+  unhashable static args inside jit-compiled functions;
+- ``host-sync`` — ``.asnumpy()``/``.asscalar()``/``.item()`` in hot
+  training and serving paths;
+- ``lock-discipline`` — unguarded read-modify-writes of
+  ``# guarded-by: <lock>`` attributes;
+- ``env-knob-drift`` — ``MXNET_*`` knobs read but not registered in
+  ``config.py`` or documented in ``docs/faq/env_var.md``;
+- ``c-api-contract`` — null-deref / unchecked UTF-8 / stale-error
+  paths in the native C ABI sources.
+
+Run it with ``python -m mxnet_tpu.analysis [paths...]`` or
+``tools/lint.py``; CI gates on *new* findings only, via the committed
+``.graftlint-baseline.json`` (see ``docs/faq/static_analysis.md``).
+"""
+from __future__ import annotations
+
+from .baseline import default_path, filter_new, load, save
+from .core import (Checker, Finding, checkers, iter_source_files,
+                   register, repo_root, rule_ids, run)
+from .reporters import human_report, json_report
+
+__all__ = ["Checker", "Finding", "checkers", "default_path",
+           "filter_new", "human_report", "iter_source_files",
+           "json_report", "load", "register", "repo_root", "rule_ids",
+           "run", "save"]

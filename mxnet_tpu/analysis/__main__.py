@@ -1,0 +1,6 @@
+"""``python -m mxnet_tpu.analysis [paths...]`` — the graftlint CLI."""
+import sys
+
+from .cli import main
+
+sys.exit(main())

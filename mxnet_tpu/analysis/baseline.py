@@ -1,0 +1,65 @@
+"""Baseline file — CI gates on *new* findings only.
+
+The committed baseline records the fingerprints of deliberate,
+already-triaged findings (e.g. the serving batcher's result-delivery
+``asnumpy`` — a sync by definition).  ``filter_new`` drops findings
+whose fingerprint is baselined, so the tier-1 gate
+(``tests/test_analysis.py::test_tree_clean_against_committed_baseline``)
+fails only when a NEW instance of a bug class lands.  Fingerprints are
+line-number-free (see ``core.Finding``), so unrelated edits do not
+churn the file; refresh it with ``tools/lint.py --update-baseline``
+after triaging any intentional additions.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .core import repo_root
+
+__all__ = ["default_path", "load", "save", "filter_new"]
+
+BASELINE_NAME = ".graftlint-baseline.json"
+
+
+def default_path(root=None):
+    return os.path.join(root or repo_root(), BASELINE_NAME)
+
+
+def load(path=None):
+    """The baseline as ``{fingerprint: entry_dict}``; empty when the
+    file does not exist (a fresh tree gates on everything)."""
+    path = path or default_path()
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError("malformed baseline %s: expected "
+                         '{"version": 1, "findings": [...]}' % path)
+    return {e["fingerprint"]: e for e in data["findings"]}
+
+
+def save(findings, path=None):
+    """Write ``findings`` as the new baseline (sorted, stable diffs)."""
+    return save_entries([f.to_dict() for f in findings], path)
+
+
+def save_entries(entries, path=None):
+    path = path or default_path()
+    entries = sorted(({k: v for k, v in e.items() if k != "line"}
+                      for e in entries),      # line numbers churn
+                     key=lambda e: (e["path"], e["rule"], e["message"],
+                                    e["fingerprint"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def filter_new(findings, baseline):
+    """(new, baselined) split of ``findings`` against a loaded baseline."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old

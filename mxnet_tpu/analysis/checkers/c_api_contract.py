@@ -1,0 +1,197 @@
+"""c-api-contract — structural scan of the native C ABI sources.
+
+The ADVICE rounds 2 and 5 bug class: an exported ``MX*``/``NN*`` entry
+point that dereferences a caller pointer without a null check, or uses
+a ``PyUnicode_AsUTF8`` result without checking it, crashes the embedding
+host process instead of returning ``-1`` through ``set_error`` /
+``MXGetLastError`` — the one contract every function of the C ABI
+shares (include/mxnet/c_api.h: "every call returns int, 0 = success").
+
+Clang-free and regex-structural (the container has no libclang), tuned
+to this codebase's uniform style.  Three sub-checks per function:
+
+- **handle-null**: every ``static_cast<Handle*>(p)`` /
+  ``static_cast<PredHandle*>(p)`` over a parameter (or parameter
+  element ``p[i]``) must be preceded — on or before the first deref
+  line — by a null check naming ``p`` (``p == nullptr``,
+  ``p != nullptr``, or the ``CHECK_NULL(p)`` macro);
+- **utf8-check**: every ``PyUnicode_AsUTF8(...)`` call must be
+  followed within 3 lines by an ``if (... == / != nullptr)`` test (the
+  ``c == nullptr ? "" : c`` ternary silently swallows the pending
+  CPython exception and is NOT accepted);
+- **error-return**: in exported ``int MX*``/``NN*`` functions, every
+  ``return -1;`` must sit within 4 lines after a ``set_error`` /
+  ``capture_py_error`` / null-test of a ``shim_call`` result (which
+  captures internally) / propagated ``!= 0`` rc — an unexplained -1
+  leaves ``MXGetLastError`` stale.
+
+Suppress a deliberate exception with ``// graftlint: disable=<rule>``
+on the offending line (``keyed_nd_lists`` documents one: its callers
+CHECK_NULL the array before handing it over).
+"""
+from __future__ import annotations
+
+import re
+
+from ..core import Checker, Finding, register
+
+__all__ = ["CApiContractChecker"]
+
+_FN_RE = re.compile(r"^(?:static\s+)?(?P<ret>int|void|const char\*|"
+                    r"PyObject\*)\s+(?P<name>[A-Za-z_]\w*)\s*\(")
+_CAST_RE = re.compile(
+    r"static_cast<\s*(?:Pred)?Handle\s*\*\s*>\s*\(\s*"
+    r"(?P<expr>[A-Za-z_]\w*(?:\s*\[\s*\w+\s*\])?)\s*\)")
+_UTF8_RE = re.compile(r"PyUnicode_AsUTF8\s*\(")
+_RET_M1_RE = re.compile(r"\breturn\s+-1\s*;")
+_IF_NULLCHECK_RE = re.compile(r"if\s*\([^)]*(==|!=)\s*nullptr")
+
+
+def _functions(lines):
+    """[(name, ret, params_text, start_idx, end_idx)] over 0-based line
+    indices; bodies end at the first column-0 ``}``."""
+    out = []
+    i = 0
+    n = len(lines)
+    while i < n:
+        m = _FN_RE.match(lines[i])
+        if not m:
+            i += 1
+            continue
+        # collect the signature until the opening brace
+        sig = lines[i]
+        j = i
+        while "{" not in sig and j + 1 < n:
+            j += 1
+            sig += " " + lines[j]
+        params = sig[sig.find("(") + 1:]
+        if ")" in params:
+            params = params[:params.rfind(")")]
+        # body: brace-count from the opening line (string literals in
+        # these sources carry no braces, so plain counting is exact)
+        k = j
+        depth = 0
+        opened = False
+        while k < n:
+            depth += lines[k].count("{") - lines[k].count("}")
+            if "{" in lines[k]:
+                opened = True
+            if opened and depth <= 0:
+                break
+            k += 1
+        out.append((m.group("name"), m.group("ret"), params, i, min(k, n - 1)))
+        i = max(k, j) + 1
+    return out
+
+
+def _param_names(params_text):
+    names = set()
+    for part in params_text.split(","):
+        part = part.strip()
+        if not part or part == "void":
+            continue
+        m = re.search(r"([A-Za-z_]\w*)\s*(?:\[\s*\])?$", part)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def _in_macro_def(lines, idx):
+    """Is line ``idx`` part of a ``#define`` (continuation) block?"""
+    i = idx
+    while i >= 0:
+        stripped = lines[i].strip()
+        if stripped.startswith("#define"):
+            return True
+        if i == idx or (i < idx and lines[i].rstrip().endswith("\\")):
+            i -= 1
+            continue
+        return False
+    return False
+
+
+@register
+class CApiContractChecker(Checker):
+    rule = "c-api-contract"
+    severity = "error"
+    suffixes = (".cpp",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        lines = text.splitlines()
+        out = []
+        for name, ret, params_text, start, end in _functions(lines):
+            params = _param_names(params_text)
+            body = lines[start:end + 1]
+            self._check_handle_null(relpath, name, params, body, start, out)
+            self._check_utf8(relpath, name, body, start, out)
+            if ret == "int" and (name.startswith("MX")
+                                 or name.startswith("NN")):
+                self._check_error_return(relpath, name, body, start, out)
+        return out
+
+    def _check_handle_null(self, relpath, fn, params, body, start, out):
+        flagged = set()
+        for off, line in enumerate(body):
+            for m in _CAST_RE.finditer(line):
+                base = re.split(r"\s*\[", m.group("expr"))[0]
+                if base not in params or base in flagged:
+                    continue
+                checked = False
+                for prev in body[:off + 1]:
+                    if re.search(r"\b%s\b\s*(==|!=)\s*nullptr" % base, prev) \
+                            or re.search(r"CHECK_NULL\w*\(\s*%s\b" % base,
+                                         prev):
+                        checked = True
+                        break
+                    if prev is line:
+                        break
+                # same-line guards (ternaries in MarkVariables) count
+                if not checked and (
+                        re.search(r"\b%s\b[^;]*nullptr" % base, line)
+                        and line.index("nullptr")
+                        < line.index("static_cast")):
+                    checked = True
+                if not checked:
+                    flagged.add(base)
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, start + off + 1,
+                        "%s dereferences pointer param %r "
+                        "(static_cast<...Handle*>) without a null "
+                        "check — a null argument crashes the host "
+                        "instead of returning -1 via set_error"
+                        % (fn, base), symbol=fn))
+
+    def _check_utf8(self, relpath, fn, body, start, out):
+        for off, line in enumerate(body):
+            if not _UTF8_RE.search(line):
+                continue
+            if _in_macro_def(body, off):
+                continue
+            window = body[off:off + 4]
+            if any(_IF_NULLCHECK_RE.search(w) for w in window):
+                continue
+            out.append(Finding(
+                self.rule, self.severity, relpath, start + off + 1,
+                "%s uses a PyUnicode_AsUTF8 result without an "
+                "if (... == nullptr) check within 3 lines — on "
+                "conversion failure the pending CPython exception "
+                "leaks into the next call" % fn, symbol=fn))
+
+    def _check_error_return(self, relpath, fn, body, start, out):
+        for off, line in enumerate(body):
+            if not _RET_M1_RE.search(line):
+                continue
+            if _in_macro_def(body, off):
+                continue
+            window = body[max(0, off - 4):off + 1]
+            ok = any(
+                ("set_error" in w or "capture_py_error" in w
+                 or "CHECK_NULL" in w or "nullptr" in w
+                 or "!= 0" in w)
+                for w in window)
+            if not ok:
+                out.append(Finding(
+                    self.rule, self.severity, relpath, start + off + 1,
+                    "%s returns -1 without set_error/capture_py_error "
+                    "in reach — MXGetLastError would report a stale "
+                    "message" % fn, symbol=fn))

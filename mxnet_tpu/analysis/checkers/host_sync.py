@@ -1,0 +1,137 @@
+"""host-sync — device-to-host syncs in per-step hot paths.
+
+On TPU the silent step-time killer is a device->host transfer inside
+the training or serving loop: each ``.asnumpy()`` / ``.asscalar()`` /
+``.item()`` blocks on the XLA stream and round-trips HBM->host (the
+runtime counts them after the fact as ``mxnet_transfer_d2h_total`` —
+``docs/faq/telemetry.md``; this checker is the compile-time
+counterpart).  Two triggers:
+
+- inside a designated HOT function (the module fit loop, the serving
+  batch path, optimizer ``update``) any sync call is flagged;
+- anywhere else in a designated hot MODULE, a sync call inside a
+  ``for``/``while`` loop is flagged (one sync per iteration).
+
+``np.asarray(x)`` on a bare name is flagged only in HOT functions: on
+an NDArray it funnels through ``__array__`` -> ``asnumpy`` — the same
+sync wearing numpy clothing.
+
+Deliberate syncs (the batcher's result delivery, warmup's
+compile-forcing fetch) are suppressed inline or carried in the
+committed baseline — both are documented in
+``docs/faq/static_analysis.md``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding, register
+
+__all__ = ["HostSyncChecker", "HOT_FUNCTIONS", "HOT_MODULES"]
+
+# (path suffix, function name): any sync inside is per-step cost
+HOT_FUNCTIONS = (
+    ("module/base_module.py", "fit"),
+    ("module/base_module.py", "forward_backward"),
+    ("module/base_module.py", "score"),
+    ("serving/server.py", "_execute"),
+    ("serving/server.py", "_worker"),
+    ("serving/server.py", "_collect_batch"),
+    ("optimizer.py", "update"),
+    ("optimizer.py", "update_multi_precision"),
+)
+
+# path suffixes where a sync inside any loop is flagged
+HOT_MODULES = (
+    "module/base_module.py",
+    "module/module.py",
+    "module/executor_group.py",
+    "serving/server.py",
+    "optimizer.py",
+)
+
+_SYNC_ATTRS = frozenset(("asnumpy", "asscalar", "item", "wait_to_read"))
+
+
+def _sync_call(node):
+    """(kind, spelled) when ``node`` is a sync call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+        return func.attr, ".%s()" % func.attr
+    if (isinstance(func, ast.Attribute) and func.attr == "asarray"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in ("np", "numpy", "_np", "onp", "_onp")
+            and node.args and isinstance(node.args[0], ast.Name)):
+        return "asarray", "np.asarray(%s)" % node.args[0].id
+    return None
+
+
+@register
+class HostSyncChecker(Checker):
+    rule = "host-sync"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        rel = relpath.replace("\\", "/")
+        hot_funcs = {fn for suffix, fn in HOT_FUNCTIONS
+                     if rel.endswith(suffix)}
+        hot_module = any(rel.endswith(s) for s in HOT_MODULES)
+        if tree is None or (not hot_funcs and not hot_module):
+            return []
+
+        out = []
+
+        def scan(func, in_hot_func):
+            loop_depth = [0]
+
+            def visit(node):
+                # nested defs get their own scan pass (hot_defs below)
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    return
+                is_loop = isinstance(node, (ast.For, ast.While))
+                if is_loop:
+                    loop_depth[0] += 1
+                sync = _sync_call(node)
+                if sync is not None:
+                    kind, spelled = sync
+                    # np.asarray is ambiguous (h2d on host data, d2h on
+                    # NDArrays) — only trust it in designated hot funcs
+                    flag = in_hot_func or (loop_depth[0] > 0
+                                           and kind != "asarray")
+                    if flag:
+                        where = ("hot path" if in_hot_func
+                                 else "loop in hot module")
+                        out.append(Finding(
+                            self.rule, self.severity, relpath, node.lineno,
+                            "%s forces a device->host sync in a %s — "
+                            "each call blocks the XLA stream and "
+                            "round-trips HBM (runtime counterpart: "
+                            "mxnet_transfer_d2h_total)"
+                            % (spelled, where),
+                            symbol=func.name))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                if is_loop:
+                    loop_depth[0] -= 1
+
+            for stmt in func.body:
+                visit(stmt)
+
+        # hot-ness is inherited by enclosure: a closure defined inside a
+        # hot function still runs per step
+        hot_defs = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in hot_funcs:
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        hot_defs.add(id(sub))
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node, id(node) in hot_defs)
+        return out

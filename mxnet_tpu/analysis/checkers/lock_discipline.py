@@ -1,0 +1,229 @@
+"""lock-discipline — ``# guarded-by: <lock>`` annotations, enforced.
+
+The PR 3 Counter race class, made impossible to reintroduce silently:
+an attribute (or module-level name) declared with a trailing
+``# guarded-by: <lock>`` comment may only be read-modify-written inside
+a ``with <lock>:`` block.  Read-modify-write means:
+
+- augmented assignment (``self.hits += 1``, ``_DEPTH[0] += 1``);
+- plain assignment whose right-hand side reads the same attribute
+  (``self.x = self.x + n``);
+- assignment or deletion through a subscript of the guarded container
+  (``self._counts[i] = v``, ``del self._queue[:]``);
+- calls to mutating container methods (``append``/``pop``/``add``/
+  ``setdefault``/``update``/``clear``/...).
+
+Plain reads are NOT flagged — lock-free fast-path reads of a monotonic
+counter are a deliberate idiom here (``engine.check_raise``,
+``Counter.value``).
+
+The lock is recognized as ``with self.<lock>:``, ``with <lock>:``, a
+call through the lock name (``with self._spool_lock(...)``), or any
+``with`` whose context manager *is* the named lock attribute.  By
+convention, methods and functions whose name ends in ``_locked`` are
+assumed to run with the lock already held by the caller and are
+skipped (the ``_pop_batch_locked`` idiom in serving/server.py).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Finding, register
+
+__all__ = ["LockDisciplineChecker"]
+
+_DECL_RE = re.compile(
+    r"^\s*(?:self\.(?P<attr>[A-Za-z_]\w*)|(?P<glob>[A-Za-z_]\w*))"
+    r"\s*(?:\[[^\]]*\])?\s*=(?!=).*#\s*guarded-by:\s*"
+    r"(?P<lock>[A-Za-z_]\w*)")
+
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "pop", "popitem", "remove", "discard",
+    "add", "clear", "update", "setdefault", "move_to_end", "appendleft",
+    "popleft", "sort", "reverse"))
+
+
+def _parents(tree):
+    par = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _is_self_attr(node, attr=None):
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (attr is None or node.attr == attr))
+
+
+def _lock_exprs(item):
+    """Candidate lock names one ``with`` item asserts."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    names = set()
+    if isinstance(expr, ast.Name):
+        names.add(expr.id)
+    elif isinstance(expr, ast.Attribute):
+        names.add(expr.attr)
+    return names
+
+
+def _held_locks(node, parents):
+    """Every lock name held at ``node`` (enclosing ``with`` blocks),
+    plus the sentinel ``"*"`` when inside a ``*_locked`` function."""
+    held = set()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                held.update(_lock_exprs(item))
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and cur.name.endswith("_locked"):
+            held.add("*")
+        cur = parents.get(cur)
+    return held
+
+
+def _base_of(node):
+    """Peel subscripts: ``self._counts[i]`` -> the ``self._counts``
+    Attribute / ``_DEPTH[0]`` -> the ``_DEPTH`` Name."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def _reads_attr(expr, attr):
+    return any(_is_self_attr(n, attr) for n in ast.walk(expr))
+
+
+def _reads_name(expr, name):
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(expr))
+
+
+class _Decl:
+    __slots__ = ("lock", "line", "is_attr", "cls")
+
+    def __init__(self, lock, line, is_attr, cls=None):
+        self.lock = lock
+        self.line = line
+        self.is_attr = is_attr
+        self.cls = cls
+
+
+@register
+class LockDisciplineChecker(Checker):
+    rule = "lock-discipline"
+    severity = "error"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        if tree is None or "guarded-by" not in text:
+            return []
+        lines = text.splitlines()
+        class_spans = [(n, n.lineno, n.end_lineno or n.lineno)
+                       for n in ast.walk(tree)
+                       if isinstance(n, ast.ClassDef)]
+
+        def owning_class(lineno):
+            best = None
+            for node, lo, hi in class_spans:
+                if lo <= lineno <= hi and (
+                        best is None or lo > best.lineno):
+                    best = node
+            return best
+
+        attr_decls = {}     # (class_node, attr) -> _Decl
+        glob_decls = {}     # name -> _Decl
+        for i, line in enumerate(lines, 1):
+            m = _DECL_RE.match(line)
+            if not m:
+                continue
+            lock = m.group("lock")
+            if m.group("attr"):
+                cls = owning_class(i)
+                if cls is not None:
+                    attr_decls[(cls, m.group("attr"))] = _Decl(
+                        lock, i, True, cls)
+            elif line[:1] not in (" ", "\t"):
+                glob_decls[m.group("glob")] = _Decl(lock, i, False)
+        if not attr_decls and not glob_decls:
+            return []
+
+        parents = _parents(tree)
+        out = []
+
+        def enclosing_symbol(node):
+            cur = parents.get(node)
+            names = []
+            while cur is not None:
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    names.append(cur.name)
+                cur = parents.get(cur)
+            return ".".join(reversed(names))
+
+        def decl_for(target):
+            """The _Decl a mutated expression resolves to, or None."""
+            base = _base_of(target)
+            if _is_self_attr(base):
+                cls = owning_class(base.lineno)
+                if cls is not None:
+                    return base.attr, attr_decls.get((cls, base.attr))
+            if isinstance(base, ast.Name):
+                return base.id, glob_decls.get(base.id)
+            return None, None
+
+        def report(node, name, decl, what):
+            if decl.line == node.lineno:       # the declaration itself
+                return
+            held = _held_locks(node, parents)
+            if "*" in held or decl.lock in held:
+                return
+            # no line numbers in the message: fingerprints must survive
+            # unrelated edits shifting the declaration (baseline contract)
+            out.append(Finding(
+                self.rule, self.severity, relpath, node.lineno,
+                "%s of %r outside 'with %s' (declared guarded-by: %s)"
+                % (what, name, decl.lock, decl.lock),
+                symbol=enclosing_symbol(node)))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AugAssign):
+                name, decl = decl_for(node.target)
+                if decl is not None:
+                    report(node, name, decl, "read-modify-write")
+            elif isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        name, decl = decl_for(target)
+                        if decl is not None:
+                            report(node, name, decl, "subscript write")
+                    else:
+                        name, decl = decl_for(target)
+                        if decl is None:
+                            continue
+                        reads = (_reads_attr(node.value, name)
+                                 if _is_self_attr(target)
+                                 else _reads_name(node.value, name))
+                        if reads:
+                            report(node, name, decl, "read-modify-write")
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    if isinstance(target, ast.Subscript):
+                        name, decl = decl_for(target)
+                        if decl is not None:
+                            report(node, name, decl, "subscript delete")
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _MUTATORS):
+                    name, decl = decl_for(func.value)
+                    if decl is not None:
+                        report(node, name, decl,
+                               "mutating call .%s()" % func.attr)
+        return out

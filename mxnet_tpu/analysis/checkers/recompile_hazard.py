@@ -1,0 +1,257 @@
+"""recompile-hazard — trace-time hazards inside jit-compiled functions.
+
+``mxnet_xla_compiles_total`` (PR 3) counts a recompile only AFTER it
+has burned seconds of wall clock; this checker flags the source
+patterns that cause them, at review time:
+
+- **value branching** — ``if``/``while``/ternary/``assert`` whose test
+  reads a traced parameter by VALUE (``if x > 0``, ``if x:``,
+  ``while loss.sum() > eps``).  Under trace these either raise a
+  ``ConcretizationTypeError`` or silently force one compile per
+  distinct value.  Shape/dtype accesses (``x.shape[0]``, ``x.ndim``,
+  ``len(x)``, ``isinstance``, ``x is None``) are static under jit and
+  allowed;
+- **trace-time formatting** — an f-string / ``str()`` / ``repr()`` /
+  ``format()`` over a traced parameter's value concretizes it at trace
+  time (``f"{x.shape}"`` is static and allowed; ``f"{x}"`` is not);
+- **unhashable static args** — a parameter named in
+  ``static_argnames``/``static_argnums`` whose default is a
+  list/dict/set literal: jit hashes static args per call, so the first
+  call dies with ``unhashable type`` (or, with a tuple-coerced wrapper,
+  recompiles per call).
+
+Jit-compiled functions are found three ways: decorated with
+``[jax.]jit`` (bare, called, or via ``partial(jax.jit, ...)``); named
+as the first argument of a ``jit(...)`` call anywhere in the module
+(the ``self._jit_fb = jax.jit(fb)`` idiom executor.py uses); or a
+lambda passed inline to ``jit(...)``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, Finding, register
+
+__all__ = ["RecompileHazardChecker"]
+
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size", "aval",
+                           "weak_type", "sharding"))
+_STATIC_WRAPPERS = frozenset(("len", "isinstance", "type", "getattr",
+                              "hasattr"))
+# str/repr/format concretize to print; bool/int/float concretize to
+# python scalars — all force the traced value at trace time
+_FORMATTERS = frozenset(("str", "repr", "format", "bool", "int", "float"))
+
+
+def _is_jit_func_expr(node):
+    """Is ``node`` an expression denoting the jit transform itself?"""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _static_names_from_call(call, func_args):
+    """Parameter names made static by a ``jit(...)`` call's
+    ``static_argnames``/``static_argnums`` kwargs."""
+    static = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = []
+            if isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            static.update(v for v in vals if isinstance(v, str))
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(kw.value, ast.Constant):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            for n in nums:
+                if isinstance(n, int) and 0 <= n < len(func_args):
+                    static.add(func_args[n])
+    return static
+
+
+def _all_params(fn):
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return [n for n in names if n != "self"]
+
+
+def _jit_targets(tree):
+    """[(function_node, jit_call_or_None)] of jit-compiled callables."""
+    out = []
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                target = call.func if call else dec
+                if _is_jit_func_expr(target):
+                    out.append((node, call))
+                elif (call is not None
+                      and isinstance(target, (ast.Name, ast.Attribute))
+                      and getattr(target, "id",
+                                  getattr(target, "attr", "")) == "partial"
+                      and call.args
+                      and _is_jit_func_expr(call.args[0])):
+                    out.append((node, call))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_func_expr(node.func) \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Name):
+                for fn in defs.get(first.id, ()):
+                    out.append((fn, node))
+            elif isinstance(first, ast.Lambda):
+                out.append((first, node))
+    seen = set()
+    uniq = []
+    for fn, call in out:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            uniq.append((fn, call))
+    return uniq
+
+
+def _value_uses(expr, traced):
+    """Traced-parameter Names used by VALUE inside ``expr`` (uses under
+    static attribute access / static wrappers / ``is None`` excluded)."""
+    bad = []
+
+    def visit(node, static_ctx):
+        if isinstance(node, ast.Name):
+            if node.id in traced and not static_ctx:
+                bad.append(node)
+            return
+        if isinstance(node, ast.Attribute):
+            visit(node.value, static_ctx or node.attr in _STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            child_static = static_ctx or fname in _STATIC_WRAPPERS
+            visit(node.func, static_ctx)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                visit(a, child_static)
+            return
+        if isinstance(node, ast.Compare):
+            ops_static = all(isinstance(op, (ast.Is, ast.IsNot))
+                             for op in node.ops)
+            none_cmp = ops_static and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators)
+            visit(node.left, static_ctx or none_cmp)
+            for c in node.comparators:
+                visit(c, static_ctx or none_cmp)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, static_ctx)
+
+    visit(expr, False)
+    return bad
+
+
+@register
+class RecompileHazardChecker(Checker):
+    rule = "recompile-hazard"
+    severity = "error"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        if tree is None or "jit" not in text:
+            return []
+        out = []
+        for fn, call in _jit_targets(tree):
+            params = _all_params(fn)
+            static = (_static_names_from_call(call, params)
+                      if isinstance(call, ast.Call) else set())
+            traced = set(params) - static
+            name = getattr(fn, "name", "<lambda>")
+
+            # unhashable static arg defaults
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None]
+            pos = fn.args.posonlyargs + fn.args.args
+            pos_with_defaults = pos[len(pos) - len(fn.args.defaults):] \
+                if fn.args.defaults else []
+            kw_pairs = [(a, d) for a, d in
+                        zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+                        if d is not None]
+            for arg, default in (list(zip(pos_with_defaults,
+                                          fn.args.defaults)) + kw_pairs):
+                if arg.arg in static and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, default.lineno,
+                        "static arg %r of jitted %r defaults to an "
+                        "unhashable %s literal — jit hashes static args "
+                        "per call" % (arg.arg, name,
+                                      type(default).__name__.lower()),
+                        symbol=name))
+
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for node in [n for stmt in body for n in ast.walk(stmt)]:
+                # nested defs' own params are traced values too — their
+                # names join the traced set implicitly only when they
+                # shadow; keep it simple and treat shadowed names as
+                # traced (conservative for closures jax traces inline)
+                if isinstance(node, (ast.If, ast.While, ast.IfExp,
+                                     ast.Assert)):
+                    test = node.test
+                    for use in _value_uses(test, traced):
+                        out.append(Finding(
+                            self.rule, self.severity, relpath,
+                            use.lineno,
+                            "branch on the VALUE of traced arg %r "
+                            "inside jitted %r — concretizes at trace "
+                            "time (one compile per distinct value, or "
+                            "ConcretizationTypeError); branch on "
+                            ".shape/.ndim or hoist out of jit"
+                            % (use.id, name),
+                            symbol=name))
+                elif isinstance(node, ast.JoinedStr):
+                    for part in node.values:
+                        if not isinstance(part, ast.FormattedValue):
+                            continue
+                        for use in _value_uses(part.value, traced):
+                            out.append(Finding(
+                                self.rule, self.severity, relpath,
+                                use.lineno,
+                                "f-string formats the VALUE of traced "
+                                "arg %r inside jitted %r — trace-time "
+                                "concretization (format .shape, or log "
+                                "outside jit / via jax.debug.print)"
+                                % (use.id, name),
+                                symbol=name))
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Name)
+                      and node.func.id in _FORMATTERS):
+                    for a in node.args:
+                        for use in _value_uses(a, traced):
+                            out.append(Finding(
+                                self.rule, self.severity, relpath,
+                                use.lineno,
+                                "%s() over traced arg %r inside jitted "
+                                "%r — trace-time concretization"
+                                % (node.func.id, use.id, name),
+                                symbol=name))
+        # dedupe: one finding per (line, message)
+        seen = set()
+        uniq = []
+        for f in out:
+            key = (f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        return uniq

@@ -1,0 +1,114 @@
+"""graftlint CLI — shared by ``python -m mxnet_tpu.analysis`` and
+``tools/lint.py``.
+
+Exit status: 0 when every finding is baselined (or none), 1 when new
+findings exist, 2 on usage errors.  ``--update-baseline`` rewrites the
+committed baseline from the current run and exits 0 — the triage
+workflow is: run, fix the true positives, suppress or baseline the
+deliberate remainder, ``--update-baseline``, commit.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .core import repo_root, rule_ids, run
+from .reporters import human_report, json_report
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST static analysis with TPU/JAX-aware checkers "
+                    "(rule catalog: docs/faq/static_analysis.md)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the mxnet_tpu "
+             "package)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit a machine-readable JSON report instead of text")
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="RULE",
+        help="restrict to RULE (repeatable); see --list-rules")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the registered rule ids and exit")
+    parser.add_argument(
+        "--baseline", metavar="PATH",
+        help="baseline file (default: <repo>/%s)"
+             % baseline_mod.BASELINE_NAME)
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from this run's findings and exit 0")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="gate on every finding, ignoring the baseline")
+    parser.add_argument(
+        "--show-baselined", action="store_true",
+        help="also list baselined findings in the text report")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in rule_ids():
+            print(rule)
+        return 0
+
+    root = repo_root()
+    paths = args.paths or [os.path.join(root, "mxnet_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print("graftlint: no such path: %s" % p, file=sys.stderr)
+            return 2
+    try:
+        findings = run(paths, rules=args.rules)
+    except ValueError as exc:       # unknown --rule
+        print("graftlint: %s" % exc, file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or baseline_mod.default_path(root)
+    if args.update_baseline:
+        # a restricted run (--rule / explicit paths) only re-derives the
+        # findings in its scope: out-of-scope baseline entries are
+        # preserved, not silently dropped (a --rule update must not
+        # un-baseline every other rule's deliberate findings)
+        entries = {f.fingerprint: f.to_dict() for f in findings}
+        restricted_rules = set(args.rules) if args.rules else None
+        restricted_paths = None
+        if args.paths:
+            restricted_paths = [
+                os.path.relpath(os.path.abspath(p), root).replace(
+                    os.sep, "/")
+                for p in args.paths]
+        kept = 0
+        if restricted_rules or restricted_paths:
+            for fp, e in baseline_mod.load(baseline_path).items():
+                if fp in entries:
+                    continue
+                in_rules = (restricted_rules is None
+                            or e["rule"] in restricted_rules)
+                in_paths = restricted_paths is None or any(
+                    e["path"] == p or e["path"].startswith(p + "/")
+                    for p in restricted_paths)
+                if not (in_rules and in_paths):
+                    entries[fp] = e
+                    kept += 1
+        baseline_mod.save_entries(list(entries.values()), baseline_path)
+        print("graftlint: wrote %d finding%s to %s"
+              % (len(entries), "s" if len(entries) != 1 else "",
+                 baseline_path)
+              + (" (%d out-of-scope entr%s preserved)"
+                 % (kept, "ies" if kept != 1 else "y") if kept else ""))
+        return 0
+
+    known = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old = baseline_mod.filter_new(findings, known)
+    if args.json:
+        print(json_report(new, old))
+    else:
+        print(human_report(new, old, show_baselined=args.show_baselined))
+    return 1 if new else 0

@@ -1,0 +1,252 @@
+"""graftlint core — findings, checker registry, file walker, suppression.
+
+Reference precedent: the whole-program property checks TVM and
+TensorFlow run on the graph before execution (PAPERS.md) — here applied
+to the *source*, because this stack's costliest defects are visible in
+the AST long before the runtime counters (``docs/faq/telemetry.md``)
+can count them: a Python-value branch inside a jitted function is a
+recompile per value, an ``.asnumpy()`` in a batch loop is a
+device-to-host sync per batch, an unguarded read-modify-write on a
+``# guarded-by:`` attribute is the PR 3 Counter race all over again.
+
+The framework is deliberately dependency-free (stdlib ``ast`` + regex):
+it must be able to lint a tree whose imports are broken.
+
+Layout:
+
+- :class:`Finding` — one diagnostic (rule id, severity, path, line,
+  message, enclosing symbol, stable fingerprint);
+- :class:`Checker` — base class; subclasses register with
+  :func:`register` and receive (path, relpath, text, tree) per file;
+- :func:`run` — walk paths, dispatch checkers, apply inline
+  suppressions, return sorted findings.
+
+Inline suppression: a ``# graftlint: disable=<rule>[,<rule>...]``
+comment (``//`` in C++) on the flagged line or the line directly above
+silences those rules (``all`` silences everything); a
+``graftlint: disable-file=<rule>`` comment within the first 40 lines
+silences a rule for the whole file.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+
+__all__ = ["Finding", "Checker", "register", "checkers", "rule_ids",
+           "run", "repo_root", "iter_source_files"]
+
+SEVERITIES = ("error", "warning")
+
+# C++ sources the c-api-contract checker owns; everything else walked
+# is Python.
+C_API_BASENAMES = ("c_api.cpp", "c_predict_api.cpp")
+
+_SUPPRESS_RE = re.compile(
+    r"(?:#|//)\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"(?:#|//)\s*graftlint:\s*disable-file=([A-Za-z0-9_,\- ]+)")
+
+
+class Finding:
+    """One diagnostic.
+
+    The fingerprint is line-number-free (rule + path + enclosing symbol
+    + message + duplicate index) so a committed baseline survives
+    unrelated edits that shift line numbers."""
+
+    __slots__ = ("rule", "severity", "path", "line", "message", "symbol",
+                 "_dup")
+
+    def __init__(self, rule, severity, path, line, message, symbol=""):
+        if severity not in SEVERITIES:
+            raise ValueError("severity must be one of %r" % (SEVERITIES,))
+        self.rule = rule
+        self.severity = severity
+        self.path = path.replace(os.sep, "/")
+        self.line = int(line)
+        self.message = message
+        self.symbol = symbol or ""
+        self._dup = 0    # disambiguates otherwise-identical findings
+
+    @property
+    def fingerprint(self):
+        key = "|".join((self.rule, self.path, self.symbol, self.message,
+                        str(self._dup)))
+        return hashlib.sha1(key.encode("utf-8")).hexdigest()[:16]
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule, self.message)
+
+    def __repr__(self):
+        return "Finding(%s:%d %s [%s] %s)" % (
+            self.path, self.line, self.severity, self.rule, self.message)
+
+
+class Checker:
+    """Base checker.  Subclasses set ``rule``/``severity``/``suffixes``
+    and implement :meth:`check`.
+
+    ``check`` receives the absolute path, the repo-relative path, the
+    file text, and (for ``.py`` files that parse) the ``ast`` tree —
+    ``None`` for C++ sources and for Python files with syntax errors.
+    It yields/returns :class:`Finding` objects."""
+
+    rule = ""
+    severity = "error"
+    suffixes = (".py",)
+
+    def interested(self, path):
+        if not path.endswith(self.suffixes):
+            return False
+        if path.endswith(".cpp"):
+            return os.path.basename(path) in C_API_BASENAMES
+        return True
+
+    def check(self, path, relpath, text, tree, ctx):
+        raise NotImplementedError
+
+
+_CHECKERS = []
+
+
+def register(cls):
+    """Class decorator adding a checker to the global registry."""
+    if any(c.rule == cls.rule for c in _CHECKERS):
+        raise ValueError("duplicate checker rule id %r" % cls.rule)
+    _CHECKERS.append(cls)
+    return cls
+
+
+def checkers():
+    # import-for-effect: checker modules self-register on first use.
+    # importlib, not `from . import checkers`: the package __init__
+    # re-exports THIS function under the same name, which would shadow
+    # the subpackage in a from-import.
+    import importlib
+    importlib.import_module(".checkers", __package__)
+    return list(_CHECKERS)
+
+
+def rule_ids():
+    return sorted(c.rule for c in checkers())
+
+
+def repo_root():
+    """The tree this package lints: the directory holding ``mxnet_tpu``."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def iter_source_files(paths):
+    """Yield lintable files (``.py`` everywhere, the c_api ``.cpp``
+    sources) under ``paths`` in deterministic order."""
+    seen = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p not in seen:
+                seen.add(p)
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for name in sorted(filenames):
+                full = os.path.join(dirpath, name)
+                if full in seen:
+                    continue
+                if name.endswith(".py") or name in C_API_BASENAMES:
+                    seen.add(full)
+                    yield full
+
+
+class RunContext:
+    """Per-run shared state checkers may consult (repo root for
+    config/docs lookups, memo cache for parsed registries)."""
+
+    def __init__(self, root):
+        self.root = root
+        self.memo = {}
+
+
+def _suppressions(text):
+    """(file_level_rules, {line: rules}) from suppression comments."""
+    per_line = {}
+    file_level = set()
+    for i, line in enumerate(text.splitlines()[:40], 1):
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            file_level.update(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            per_line[i] = {r.strip() for r in m.group(1).split(",")
+                           if r.strip()}
+    return file_level, per_line
+
+
+def _suppressed(finding, file_level, per_line):
+    for rules in (file_level,
+                  per_line.get(finding.line, ()),
+                  per_line.get(finding.line - 1, ())):
+        if finding.rule in rules or "all" in rules:
+            return True
+    return False
+
+
+def run(paths, rules=None, root=None):
+    """Lint ``paths`` and return the surviving findings, sorted.
+
+    ``rules`` restricts to a subset of rule ids; ``root`` overrides the
+    repo root (fixture trees in tests carry their own ``config.py`` /
+    ``docs/faq/env_var.md``)."""
+    root = os.path.abspath(root) if root else repo_root()
+    if rules is not None:
+        rules = set(rules)
+        unknown = rules.difference(rule_ids())
+        if unknown:
+            raise ValueError("unknown rule ids: %s" % sorted(unknown))
+    active = [cls() for cls in checkers()
+              if rules is None or cls.rule in rules]
+    ctx = RunContext(root)
+    findings = []
+    for path in iter_source_files(paths):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        tree = None
+        if path.endswith(".py"):
+            try:
+                tree = ast.parse(text)
+            except SyntaxError as exc:
+                findings.append(Finding(
+                    "parse-error", "error", relpath,
+                    exc.lineno or 1, "file does not parse: %s" % exc.msg))
+                tree = None
+        file_level, per_line = _suppressions(text)
+        for checker in active:
+            if not checker.interested(path):
+                continue
+            for finding in checker.check(path, relpath, text, tree, ctx):
+                if not _suppressed(finding, file_level, per_line):
+                    findings.append(finding)
+    findings.sort(key=Finding.sort_key)
+    # disambiguate identical (rule, path, symbol, message) fingerprints
+    counts = {}
+    for f in findings:
+        key = (f.rule, f.path, f.symbol, f.message)
+        f._dup = counts.get(key, 0)
+        counts[key] = f._dup + 1
+    return findings

@@ -1,0 +1,51 @@
+"""Finding reporters — human-readable text and machine JSON."""
+from __future__ import annotations
+
+import json
+
+__all__ = ["human_report", "json_report"]
+
+
+def human_report(new, baselined=(), show_baselined=False):
+    """gcc-style ``path:line: severity: [rule] message`` lines grouped
+    by file, with a summary tail."""
+    lines = []
+    last_path = None
+    for f in new:
+        if f.path != last_path:
+            if last_path is not None:
+                lines.append("")
+            lines.append(f.path)
+            last_path = f.path
+        sym = " (%s)" % f.symbol if f.symbol else ""
+        lines.append("  %4d: %s: [%s]%s %s"
+                     % (f.line, f.severity, f.rule, sym, f.message))
+    if show_baselined and baselined:
+        lines.append("")
+        lines.append("baselined (deliberate, not gated):")
+        for f in baselined:
+            lines.append("  %s:%d [%s] %s"
+                         % (f.path, f.line, f.rule, f.message))
+    lines.append("")
+    errors = sum(1 for f in new if f.severity == "error")
+    warnings = len(new) - errors
+    lines.append("graftlint: %d new finding%s (%d error%s, %d warning%s), "
+                 "%d baselined"
+                 % (len(new), "s" if len(new) != 1 else "",
+                    errors, "s" if errors != 1 else "",
+                    warnings, "s" if warnings != 1 else "",
+                    len(baselined)))
+    return "\n".join(lines)
+
+
+def json_report(new, baselined=()):
+    return json.dumps({
+        "new": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in baselined],
+        "summary": {
+            "new": len(new),
+            "errors": sum(1 for f in new if f.severity == "error"),
+            "warnings": sum(1 for f in new if f.severity == "warning"),
+            "baselined": len(baselined),
+        },
+    }, indent=1)

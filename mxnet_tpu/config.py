@@ -147,3 +147,11 @@ register_env("MXNET_TELEMETRY_STEP_INTERVAL", int, 1,
 register_env("MXNET_TELEMETRY_PROM_FILE", str, None,
              "write the registry's Prometheus text exposition to this "
              "path at process exit (telemetry.write_prometheus)")
+register_env("MXNET_GLUON_REPO", str, None,
+             "override source for gluon model-zoo checkpoints: a local "
+             "staging directory or an apache-mxnet-style base URL "
+             "(gluon/model_zoo/model_store.py)")
+register_env("MXNET_BENCH_SKIP_NHWC", str, None,
+             "set to 1 to skip bench.py's secondary NHWC layout leg")
+register_env("MXNET_BENCH_SKIP_RIDERS", str, None,
+             "set to 1 to skip bench.py's rider benchmark legs")

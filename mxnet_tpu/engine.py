@@ -27,7 +27,12 @@ import threading
 __all__ = ["bulk", "set_bulk_size", "record_exception", "check_raise",
            "clear_exception", "naive", "naive_scope_active", "worker_scope"]
 
-_NAIVE_DEPTH = [0]
+# engine-control state is shared across worker threads (serving
+# batcher, prefetch producers, custom-op callbacks) — depth/size swaps
+# are read-modify-writes and take the lock (graftlint lock-discipline
+# caught the unguarded += here)
+_SCOPE_LOCK = threading.Lock()
+_NAIVE_DEPTH = [0]   # guarded-by: _SCOPE_LOCK
 
 
 @contextlib.contextmanager
@@ -36,20 +41,22 @@ def naive():
     until complete (the reference's NaiveEngine oracle,
     src/engine/naive_engine.cc; also selectable process-wide via
     MXNET_ENGINE_TYPE=NaiveEngine)."""
-    _NAIVE_DEPTH[0] += 1
+    with _SCOPE_LOCK:
+        _NAIVE_DEPTH[0] += 1
     try:
         yield
     finally:
-        _NAIVE_DEPTH[0] -= 1
+        with _SCOPE_LOCK:
+            _NAIVE_DEPTH[0] -= 1
 
 
 def naive_scope_active():
     return _NAIVE_DEPTH[0] > 0
 
-_BULK_SIZE = [0]
+_BULK_SIZE = [0]   # guarded-by: _SCOPE_LOCK
 
 _EXC_LOCK = threading.Lock()
-_DEFERRED_EXC = []   # first recorded exception wins, like exception_ptr
+_DEFERRED_EXC = []   # guarded-by: _EXC_LOCK — first exception wins
 
 
 def record_exception(exc):
@@ -113,9 +120,13 @@ def worker_scope(deliver=None):
 
 
 def set_bulk_size(size):
-    """Set sync-op bulking limit (reference: engine.py set_bulk_size)."""
-    prev = _BULK_SIZE[0]
-    _BULK_SIZE[0] = int(size)
+    """Set sync-op bulking limit (reference: engine.py set_bulk_size).
+
+    The read-prev/write-new swap is atomic under the scope lock, so two
+    threads nesting bulk() scopes cannot restore a torn previous size."""
+    with _SCOPE_LOCK:
+        prev = _BULK_SIZE[0]
+        _BULK_SIZE[0] = int(size)
     return prev
 
 

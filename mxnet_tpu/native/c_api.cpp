@@ -41,6 +41,17 @@ thread_local std::string g_last_error;
 
 void set_error(const std::string& msg) { g_last_error = msg; }
 
+// Null-pointer contract (ADVICE rounds 2/5; enforced by the graftlint
+// c-api-contract rule): an exported entry rejects a null pointer with
+// set_error/-1 instead of crashing the embedding host on the deref.
+#define CHECK_NULL(p)                                        \
+  do {                                                       \
+    if ((p) == nullptr) {                                    \
+      set_error(std::string(__func__) + ": " #p " is null"); \
+      return -1;                                             \
+    }                                                        \
+  } while (0)
+
 void capture_py_error() {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
@@ -222,7 +233,7 @@ int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
 }
 
 int MXNDArrayFree(NDArrayHandle handle) {
-  if (handle == nullptr) return 0;
+  if (handle == nullptr) return 0;   // freeing null is a no-op
   GIL gil;
   Handle* h = static_cast<Handle*>(handle);
   Py_XDECREF(h->obj);
@@ -233,6 +244,7 @@ int MXNDArrayFree(NDArrayHandle handle) {
 int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
                       const uint32_t** out_pdata) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* shp = shim_call("nd_shape", Py_BuildValue("(O)", h->obj));
   if (shp == nullptr) return -1;
@@ -250,6 +262,7 @@ int MXNDArrayGetShape(NDArrayHandle handle, uint32_t* out_dim,
 
 int MXNDArrayGetDType(NDArrayHandle handle, int* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* v = shim_call("nd_dtype_enum", Py_BuildValue("(O)", h->obj));
   if (v == nullptr) return -1;
@@ -261,6 +274,7 @@ int MXNDArrayGetDType(NDArrayHandle handle, int* out) {
 int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
                              size_t size) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   // size is the ELEMENT count (reference c_api.h:545); scale by itemsize
   PyObject* raw = nullptr;
@@ -283,6 +297,7 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
 
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* raw = shim_call("nd_to_bytes", Py_BuildValue("(O)", h->obj));
   if (raw == nullptr) return -1;
@@ -318,6 +333,7 @@ int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
 
 int MXNDArrayWaitToRead(NDArrayHandle handle) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("nd_wait", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -336,6 +352,11 @@ int MXNDArrayWaitAll() {
 int MXNDArraySave(const char* fname, uint32_t num_args,
                   NDArrayHandle* args, const char** keys) {
   GIL gil;
+  if (num_args > 0) CHECK_NULL(args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    CHECK_NULL(args[i]);
+    if (keys != nullptr) CHECK_NULL(keys[i]);
+  }
   PyObject* arrs = PyList_New(num_args);
   PyObject* ks = PyList_New(keys == nullptr ? 0 : num_args);
   for (uint32_t i = 0; i < num_args; ++i) {
@@ -427,6 +448,16 @@ int MXImperativeInvokeByName(const char* op_name, int num_inputs,
                              const char** param_keys,
                              const char** param_vals) {
   GIL gil;
+  if (num_inputs > 0) CHECK_NULL(inputs);
+  for (int i = 0; i < num_inputs; ++i) CHECK_NULL(inputs[i]);
+  if (num_params > 0) {
+    CHECK_NULL(param_keys);
+    CHECK_NULL(param_vals);
+  }
+  for (int i = 0; i < num_params; ++i) {
+    CHECK_NULL(param_keys[i]);
+    CHECK_NULL(param_vals[i]);
+  }
   PyObject* ins = PyList_New(num_inputs);
   for (int i = 0; i < num_inputs; ++i) {
     PyObject* o = static_cast<Handle*>(inputs[i])->obj;
@@ -467,6 +498,7 @@ int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
 
 int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* s = shim_call("sym_to_json", Py_BuildValue("(O)", h->obj));
   if (s == nullptr) return -1;
@@ -487,6 +519,7 @@ int MXSymbolFree(SymbolHandle handle) { return MXNDArrayFree(handle); }
 int MXSymbolListArguments(SymbolHandle handle, uint32_t* out_size,
                           const char*** out_array) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* l = shim_call("sym_list_arguments",
                           Py_BuildValue("(O)", h->obj));
@@ -499,6 +532,7 @@ int MXSymbolListArguments(SymbolHandle handle, uint32_t* out_size,
 int MXSymbolListOutputs(SymbolHandle handle, uint32_t* out_size,
                         const char*** out_array) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* l = shim_call("sym_list_outputs", Py_BuildValue("(O)", h->obj));
   if (l == nullptr) return -1;
@@ -510,6 +544,7 @@ int MXSymbolListOutputs(SymbolHandle handle, uint32_t* out_size,
 int MXSymbolListAuxiliaryStates(SymbolHandle handle, uint32_t* out_size,
                                 const char*** out_array) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* l = shim_call("sym_list_aux", Py_BuildValue("(O)", h->obj));
   if (l == nullptr) return -1;
@@ -529,6 +564,7 @@ static int obj_to_handle(PyObject* o, void** out) {
 int MXNDArraySlice(NDArrayHandle handle, uint32_t start, uint32_t stop,
                    NDArrayHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("nd_slice", Py_BuildValue("(OII)", h->obj, start, stop)),
@@ -537,6 +573,7 @@ int MXNDArraySlice(NDArrayHandle handle, uint32_t start, uint32_t stop,
 
 int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("nd_at", Py_BuildValue("(OI)", h->obj, idx)), out);
@@ -545,6 +582,7 @@ int MXNDArrayAt(NDArrayHandle handle, uint32_t idx, NDArrayHandle* out) {
 int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
                      NDArrayHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* shp = PyList_New(ndim);
   for (int i = 0; i < ndim; ++i) {
@@ -557,6 +595,7 @@ int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
 int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
                         int* out_dev_id) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("nd_context", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -580,6 +619,7 @@ int MXSetNumOMPThreads(int n) { (void)n; return 0; }
 
 int MXSymbolCopy(SymbolHandle handle, SymbolHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("sym_copy", Py_BuildValue("(O)", h->obj)), out);
@@ -587,11 +627,17 @@ int MXSymbolCopy(SymbolHandle handle, SymbolHandle* out) {
 
 int MXSymbolGetName(SymbolHandle handle, const char** out, int* success) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* s = shim_call("sym_name", Py_BuildValue("(O)", h->obj));
   if (s == nullptr) return -1;
   const char* c = PyUnicode_AsUTF8(s);
-  h->text = c == nullptr ? "" : c;
+  if (c == nullptr) {
+    capture_py_error();
+    Py_DECREF(s);
+    return -1;
+  }
+  h->text = c;
   Py_DECREF(s);
   *success = h->text.empty() ? 0 : 1;
   *out = h->text.c_str();
@@ -600,6 +646,7 @@ int MXSymbolGetName(SymbolHandle handle, const char** out, int* success) {
 
 int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("sym_internals", Py_BuildValue("(O)", h->obj)), out);
@@ -608,6 +655,7 @@ int MXSymbolGetInternals(SymbolHandle handle, SymbolHandle* out) {
 int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
                       SymbolHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("sym_get_output", Py_BuildValue("(OI)", h->obj, index)),
@@ -621,6 +669,14 @@ int MXSymbolGetOutput(SymbolHandle handle, uint32_t index,
 int MXSetProfilerConfig(int num_params, const char* const* keys,
                         const char* const* vals) {
   GIL gil;
+  if (num_params > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (int i = 0; i < num_params; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   PyObject* ks = PyList_New(num_params);
   PyObject* vs = PyList_New(num_params);
   for (int i = 0; i < num_params; ++i) {
@@ -653,6 +709,7 @@ int MXDumpProfile(int finished) {
 
 int MXKVStoreBarrier(void* handle) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("kv_barrier", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -666,6 +723,7 @@ int MXKVStoreBarrier(void* handle) {
 int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
                           const char** out_buf) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* raw = shim_call("nd_save_raw", Py_BuildValue("(O)", h->obj));
   if (raw == nullptr) return -1;
@@ -703,6 +761,7 @@ int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
 
 int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   PyObject* r = shim_call("sym_save_file",
                           Py_BuildValue("(Os)", h->obj, fname));
@@ -714,6 +773,7 @@ int MXSymbolSaveToFile(SymbolHandle sym, const char* fname) {
 int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
                     int* success) {
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   PyObject* v = shim_call("sym_attr_get",
                           Py_BuildValue("(Os)", h->obj, key));
@@ -741,6 +801,7 @@ int MXSymbolGetAttr(SymbolHandle sym, const char* key, const char** out,
 
 int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   PyObject* r = shim_call("sym_attr_set",
                           Py_BuildValue("(Oss)", h->obj, key, value));
@@ -752,6 +813,7 @@ int MXSymbolSetAttr(SymbolHandle sym, const char* key, const char* value) {
 static int attr_list_impl(SymbolHandle sym, const char* shim_fn,
                           uint32_t* out_size, const char*** out) {
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   PyObject* l = shim_call(shim_fn, Py_BuildValue("(O)", h->obj));
   if (l == nullptr) return -1;
@@ -785,6 +847,7 @@ int MXExecutorReshape(int partial_shaping, int allow_up_sizing,
                       /*ExecutorHandle*/ void** out) {
   (void)dev_type; (void)dev_id;
   GIL gil;
+  CHECK_NULL(shared);
   Handle* h = static_cast<Handle*>(shared);
   PyObject* ks = PyList_New(num_provided);
   PyObject* nds = PyList_New(num_provided);
@@ -882,6 +945,8 @@ int MXAutogradMarkVariables(uint32_t num_var, NDArrayHandle* var_handles,
 int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
                        NDArrayHandle* ograd_handles, int retain_graph) {
   GIL gil;
+  if (num_output > 0) CHECK_NULL(output_handles);
+  for (uint32_t i = 0; i < num_output; ++i) CHECK_NULL(output_handles[i]);
   PyObject* outs = PyList_New(num_output);
   for (uint32_t i = 0; i < num_output; ++i) {
     PyObject* o = static_cast<Handle*>(output_handles[i])->obj;
@@ -895,7 +960,10 @@ int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
   } else {
     ogs = PyList_New(num_output);
     for (uint32_t i = 0; i < num_output; ++i) {
-      PyObject* o = static_cast<Handle*>(ograd_handles[i])->obj;
+      // reference contract: a NULL entry means "ones-like for this
+      // head" (mixed None/ndarray head_grads) -> shim None
+      PyObject* o = ograd_handles[i] == nullptr
+          ? Py_None : static_cast<Handle*>(ograd_handles[i])->obj;
       Py_INCREF(o);
       PyList_SET_ITEM(ogs, i, o);
     }
@@ -910,6 +978,7 @@ int MXAutogradBackward(uint32_t num_output, NDArrayHandle* output_handles,
 
 int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   return obj_to_handle(
       shim_call("nd_get_grad", Py_BuildValue("(O)", h->obj)), out);
@@ -972,6 +1041,7 @@ static int infer_shape_impl(SymbolHandle sym, uint32_t num_args,
                             const uint32_t*** aux_shape_data,
                             int* complete) {
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   // reference contract (c_api.h): keys may be NULL — positional mode,
   // shapes matched onto list_arguments() order.  The shim resolves the
@@ -1084,6 +1154,7 @@ int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
                                 const char** name) {
   GIL gil;
+  CHECK_NULL(creator);
   Handle* h = static_cast<Handle*>(creator);
   const char* c = PyUnicode_AsUTF8(h->obj);
   if (c == nullptr) {
@@ -1101,6 +1172,7 @@ int MXSymbolGetAtomicSymbolInfo(
     const char*** arg_descriptions, const char** key_var_num_args,
     const char** return_type) {
   GIL gil;
+  CHECK_NULL(creator);
   Handle* h = static_cast<Handle*>(creator);
   PyObject* info = shim_call("creator_info", Py_BuildValue("(O)", h->obj));
   if (info == nullptr) return -1;
@@ -1162,7 +1234,16 @@ int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
                                uint32_t num_param, const char** keys,
                                const char** vals, SymbolHandle* out) {
   GIL gil;
+  CHECK_NULL(creator);
   Handle* h = static_cast<Handle*>(creator);
+  if (num_param > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (uint32_t i = 0; i < num_param; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   PyObject* ks = PyList_New(num_param);
   PyObject* vs = PyList_New(num_param);
   for (uint32_t i = 0; i < num_param; ++i) {
@@ -1187,6 +1268,12 @@ int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
 int MXSymbolCompose(SymbolHandle sym, const char* name, uint32_t num_args,
                     const char** keys, SymbolHandle* args) {
   GIL gil;
+  CHECK_NULL(sym);
+  if (num_args > 0) CHECK_NULL(args);
+  for (uint32_t i = 0; i < num_args; ++i) {
+    CHECK_NULL(args[i]);
+    if (keys != nullptr) CHECK_NULL(keys[i]);
+  }
   Handle* h = static_cast<Handle*>(sym);
   PyObject* ks;
   if (keys == nullptr) {
@@ -1224,6 +1311,7 @@ int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
                          const uint32_t* shape_ndims, ExecutorHandle* out) {
   (void)dev_type; (void)dev_id;  // XLA owns placement
   GIL gil;
+  CHECK_NULL(sym);
   Handle* h = static_cast<Handle*>(sym);
   PyObject* ks = PyList_New(num_provided_shapes);
   PyObject* nds = PyList_New(num_provided_shapes);
@@ -1250,6 +1338,7 @@ int MXExecutorFree(ExecutorHandle handle) { return MXNDArrayFree(handle); }
 static int exec_array_block(ExecutorHandle handle, const char* shim_fn,
                             uint32_t* out_size, NDArrayHandle** out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* l = shim_call(shim_fn, Py_BuildValue("(O)", h->obj));
   if (l == nullptr) return -1;
@@ -1277,6 +1366,7 @@ int MXExecutorAuxArrays(ExecutorHandle handle, uint32_t* out_size,
 
 int MXExecutorForward(ExecutorHandle handle, int is_train) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("exec_forward",
                           Py_BuildValue("(Oi)", h->obj, is_train));
@@ -1288,6 +1378,9 @@ int MXExecutorForward(ExecutorHandle handle, int is_train) {
 int MXExecutorBackward(ExecutorHandle handle, uint32_t len,
                        NDArrayHandle* head_grads) {
   GIL gil;
+  CHECK_NULL(handle);
+  if (len > 0) CHECK_NULL(head_grads);
+  for (uint32_t i = 0; i < len; ++i) CHECK_NULL(head_grads[i]);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* grads = PyList_New(len);
   for (uint32_t i = 0; i < len; ++i) {
@@ -1320,13 +1413,16 @@ int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
 
 int MXKVStoreFree(KVStoreHandle handle) { return MXNDArrayFree(handle); }
 
+// precondition: every caller CHECK_NULLs keys/vals and each element
+// before building the lists — this helper returns PyObject*, so it
+// cannot use the -1-returning macro itself.
 static PyObject* keyed_nd_lists(uint32_t num, const char** keys,
                                 NDArrayHandle* vals, PyObject** out_vals) {
   PyObject* ks = PyList_New(num);
   PyObject* vs = PyList_New(num);
   for (uint32_t i = 0; i < num; ++i) {
     PyList_SET_ITEM(ks, i, PyUnicode_FromString(keys[i]));
-    PyObject* o = static_cast<Handle*>(vals[i])->obj;
+    PyObject* o = static_cast<Handle*>(vals[i])->obj;  // graftlint: disable=c-api-contract
     Py_INCREF(o);
     PyList_SET_ITEM(vs, i, o);
   }
@@ -1337,6 +1433,15 @@ static PyObject* keyed_nd_lists(uint32_t num, const char** keys,
 int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
                     NDArrayHandle* vals) {
   GIL gil;
+  CHECK_NULL(handle);
+  if (num > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (uint32_t i = 0; i < num; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   Handle* h = static_cast<Handle*>(handle);
   PyObject* vs = nullptr;
   PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
@@ -1349,6 +1454,15 @@ int MXKVStoreInitEx(KVStoreHandle handle, uint32_t num, const char** keys,
 int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
                     NDArrayHandle* vals, int priority) {
   GIL gil;
+  CHECK_NULL(handle);
+  if (num > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (uint32_t i = 0; i < num; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   Handle* h = static_cast<Handle*>(handle);
   PyObject* vs = nullptr;
   PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
@@ -1362,6 +1476,15 @@ int MXKVStorePushEx(KVStoreHandle handle, uint32_t num, const char** keys,
 int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
                     NDArrayHandle* vals, int priority) {
   GIL gil;
+  CHECK_NULL(handle);
+  if (num > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (uint32_t i = 0; i < num; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   Handle* h = static_cast<Handle*>(handle);
   PyObject* vs = nullptr;
   PyObject* ks = keyed_nd_lists(num, keys, vals, &vs);
@@ -1374,6 +1497,7 @@ int MXKVStorePullEx(KVStoreHandle handle, uint32_t num, const char** keys,
 
 int MXKVStoreGetRank(KVStoreHandle handle, int* rank) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("kv_rank_size", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -1384,6 +1508,7 @@ int MXKVStoreGetRank(KVStoreHandle handle, int* rank) {
 
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int* size) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("kv_rank_size", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -1415,6 +1540,7 @@ int MXListDataIters(uint32_t* out_size, DataIterCreator** out_array) {
 int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
                           const char** description) {
   GIL gil;
+  CHECK_NULL(creator);
   Handle* h = static_cast<Handle*>(creator);
   PyObject* info = shim_call("data_iter_info", Py_BuildValue("(O)", h->obj));
   if (info == nullptr) return -1;
@@ -1440,7 +1566,16 @@ int MXDataIterCreateIter(DataIterCreator creator, uint32_t num_param,
                          const char** keys, const char** vals,
                          DataIterHandle* out) {
   GIL gil;
+  CHECK_NULL(creator);
   Handle* h = static_cast<Handle*>(creator);
+  if (num_param > 0) {
+    CHECK_NULL(keys);
+    CHECK_NULL(vals);
+  }
+  for (uint32_t i = 0; i < num_param; ++i) {
+    CHECK_NULL(keys[i]);
+    CHECK_NULL(vals[i]);
+  }
   PyObject* ks = PyList_New(num_param);
   PyObject* vs = PyList_New(num_param);
   for (uint32_t i = 0; i < num_param; ++i) {
@@ -1458,6 +1593,7 @@ int MXDataIterFree(DataIterHandle handle) { return MXNDArrayFree(handle); }
 
 int MXDataIterBeforeFirst(DataIterHandle handle) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("iter_before_first", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -1467,6 +1603,7 @@ int MXDataIterBeforeFirst(DataIterHandle handle) {
 
 int MXDataIterNext(DataIterHandle handle, int* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* r = shim_call("iter_next", Py_BuildValue("(O)", h->obj));
   if (r == nullptr) return -1;
@@ -1478,6 +1615,7 @@ int MXDataIterNext(DataIterHandle handle, int* out) {
 static int iter_fetch(DataIterHandle handle, const char* fn,
                       NDArrayHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
   Handle* h = static_cast<Handle*>(handle);
   PyObject* a = shim_call(fn, Py_BuildValue("(O)", h->obj));
   if (a == nullptr) return -1;

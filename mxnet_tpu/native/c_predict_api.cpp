@@ -30,6 +30,17 @@ struct PredHandle {
 
 void set_error(const std::string& msg) { g_last_error = msg; }
 
+// Null-pointer contract (ADVICE rounds 2/5; enforced by the graftlint
+// c-api-contract rule): an exported entry rejects a null pointer with
+// set_error/-1 instead of crashing the embedding host on the deref.
+#define CHECK_NULL(p)                                        \
+  do {                                                       \
+    if ((p) == nullptr) {                                    \
+      set_error(std::string(__func__) + ": " #p " is null"); \
+      return -1;                                             \
+    }                                                        \
+  } while (0)
+
 // Capture the pending Python exception into the last-error slot.
 void capture_py_error() {
   PyObject *type, *value, *tb;
@@ -116,6 +127,14 @@ int MXPredCreatePartialOut(const char* symbol_json_str,
                            const char** output_keys,
                            PredictorHandle* out) {
   GIL gil;
+  if (num_input_nodes > 0) {
+    CHECK_NULL(input_keys);
+    CHECK_NULL(input_shape_indptr);
+    CHECK_NULL(input_shape_data);
+  }
+  for (unsigned i = 0; i < num_input_nodes; ++i) CHECK_NULL(input_keys[i]);
+  if (num_output_nodes > 0) CHECK_NULL(output_keys);
+  for (unsigned i = 0; i < num_output_nodes; ++i) CHECK_NULL(output_keys[i]);
   PyObject* mod = predictor_module();
   if (mod == nullptr) {
     capture_py_error();
@@ -175,6 +194,7 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
 int MXPredSetInput(PredictorHandle handle, const char* key,
                    const float* data, unsigned size) {
   GIL gil;
+  CHECK_NULL(handle);
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* buf = PyBytes_FromStringAndSize(
       reinterpret_cast<const char*>(data), size * sizeof(float));
@@ -191,6 +211,7 @@ int MXPredSetInput(PredictorHandle handle, const char* key,
 
 int MXPredForward(PredictorHandle handle) {
   GIL gil;
+  CHECK_NULL(handle);
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* r = PyObject_CallMethod(h->predictor, "forward", nullptr);
   if (r == nullptr) {
@@ -204,6 +225,7 @@ int MXPredForward(PredictorHandle handle) {
 int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
                          unsigned** shape_data, unsigned* shape_ndim) {
   GIL gil;
+  CHECK_NULL(handle);
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* shp = PyObject_CallMethod(h->predictor, "get_output_shape", "I",
                                       index);
@@ -226,6 +248,7 @@ int MXPredGetOutputShape(PredictorHandle handle, unsigned index,
 int MXPredGetOutput(PredictorHandle handle, unsigned index, float* data,
                     unsigned size) {
   GIL gil;
+  CHECK_NULL(handle);
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* buf = PyObject_CallMethod(h->predictor, "get_output_bytes", "I",
                                       index);
@@ -252,6 +275,13 @@ int MXPredReshape(unsigned num_input_nodes, const char** input_keys,
                   const unsigned* input_shape_data, PredictorHandle handle,
                   PredictorHandle* out) {
   GIL gil;
+  CHECK_NULL(handle);
+  if (num_input_nodes > 0) {
+    CHECK_NULL(input_keys);
+    CHECK_NULL(input_shape_indptr);
+    CHECK_NULL(input_shape_data);
+  }
+  for (unsigned i = 0; i < num_input_nodes; ++i) CHECK_NULL(input_keys[i]);
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* shapes = build_shapes_dict(num_input_nodes, input_keys,
                                        input_shape_indptr, input_shape_data);
@@ -268,6 +298,7 @@ int MXPredReshape(unsigned num_input_nodes, const char** input_keys,
 }
 
 int MXPredFree(PredictorHandle handle) {
+  if (handle == nullptr) return 0;   // freeing null is a no-op
   GIL gil;
   PredHandle* h = static_cast<PredHandle*>(handle);
   PyObject* r = PyObject_CallMethod(h->predictor, "free", nullptr);

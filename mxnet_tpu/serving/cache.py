@@ -32,10 +32,10 @@ class ExecutorCache:
         self._capacity = int(capacity)
         self._lock = threading.Lock()
         # (name, version, id(entry), bucket) -> (ModelVersion, Predictor)
-        self._entries = OrderedDict()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._entries = OrderedDict()   # guarded-by: _lock
+        self.hits = 0                   # guarded-by: _lock
+        self.misses = 0                 # guarded-by: _lock
+        self.evictions = 0              # guarded-by: _lock
         # per-instance ints stay the stats() source of truth; the shared
         # telemetry namespace mirrors them so one snapshot()/exposition
         # correlates serving recompiles with the executor's XLA-compile

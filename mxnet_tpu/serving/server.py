@@ -182,7 +182,7 @@ class ModelServer:
             cache_size if cache_size is not None
             else config.get("MXNET_SERVING_EXECUTOR_CACHE"))
         self._cv = threading.Condition()
-        self._queue = []
+        self._queue = []                # guarded-by: _cv
         self._stopping = False
         self._drain = True
         self._thread = None
@@ -212,12 +212,13 @@ class ModelServer:
             "submit-to-result latency of served requests",
             buckets=telemetry.exponential_buckets(0.5, 2.0, 14))
         self._mlock = threading.Lock()
-        self._req_counts = {o: 0 for o in ("submitted", "served", "failed",
-                                           "rejected_queue_full", "expired")}
-        self._batch_hist = {}              # bucket -> [batches, rows]
-        self._latencies = []               # ring buffer, newest last
+        self._req_counts = {o: 0           # guarded-by: _mlock
+                            for o in ("submitted", "served", "failed",
+                                      "rejected_queue_full", "expired")}
+        self._batch_hist = {}              # guarded-by: _mlock
+        self._latencies = []               # guarded-by: _mlock
         self._lat_cap = 4096
-        self._queue_peak = 0
+        self._queue_peak = 0               # guarded-by: _mlock
         self._domain = profiler.Domain("serving")
         self._q_counter = self._domain.new_counter("serving_queue_depth")
 
@@ -396,7 +397,9 @@ class ModelServer:
                     pred = self.cache.get(entry, b)
                     pred.forward(**feed)
                     for i in range(entry.num_outputs):
-                        pred.get_output(i).asnumpy()
+                        # deliberate sync: warmup EXISTS to force the
+                        # compile + first execution before live traffic
+                        pred.get_output(i).asnumpy()  # graftlint: disable=host-sync
                 warmed.append((n, entry.version, b))
         return warmed
 

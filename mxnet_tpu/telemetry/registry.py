@@ -50,7 +50,7 @@ class Counter:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0   # guarded-by: _lock
 
     def inc(self, amount=1):
         if amount < 0:
@@ -70,7 +70,7 @@ class Gauge:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._value = 0
+        self._value = 0   # guarded-by: _lock
 
     def set(self, value):
         with self._lock:
@@ -99,9 +99,9 @@ class Histogram:
         if not self.bounds:
             raise ValueError("histogram needs at least one bucket bound")
         self._lock = threading.Lock()
-        self._counts = [0] * (len(self.bounds) + 1)   # last slot = +Inf
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * (len(self.bounds) + 1)   # guarded-by: _lock (last slot = +Inf)
+        self._sum = 0.0   # guarded-by: _lock
+        self._count = 0   # guarded-by: _lock
 
     def observe(self, value):
         value = float(value)
@@ -146,7 +146,7 @@ class MetricFamily:
         self.help = help
         self._factory = child_factory
         self._lock = threading.Lock()
-        self._children = OrderedDict()   # labels tuple -> series
+        self._children = OrderedDict()   # guarded-by: _lock
 
     def labels(self, **labels):
         for k in labels:
@@ -210,8 +210,8 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._families = OrderedDict()
-        self._generation = 0
+        self._families = OrderedDict()   # guarded-by: _lock
+        self._generation = 0             # guarded-by: _lock
 
     def _get_or_create(self, name, kind, help, factory):
         with self._lock:

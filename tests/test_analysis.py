@@ -1,0 +1,586 @@
+"""graftlint (mxnet_tpu/analysis): fixture-backed checker tests, the
+suppression and baseline machinery, the CLI surface, and the tier-1
+gate that runs the full analyzer over the real tree against the
+committed baseline.
+
+Each rule gets a known-bad snippet (must detect), a known-good snippet
+(must stay silent), and a suppressed variant (inline comment wins).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mxnet_tpu import analysis
+from mxnet_tpu.analysis import baseline as baseline_mod
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(tmp_path, name, source, rule, root=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return analysis.run([str(path)], rules=[rule],
+                        root=str(root or tmp_path))
+
+
+# -- recompile-hazard --------------------------------------------------------
+
+def test_recompile_hazard_value_branch_detected(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def step(w, g):
+            if g > 0:           # python-value branch under trace
+                w = w - g
+            return w
+
+        fast = jax.jit(step)
+    """, "recompile-hazard")
+    assert len(findings) == 1
+    assert findings[0].rule == "recompile-hazard"
+    assert "branch on the VALUE" in findings[0].message
+    assert findings[0].symbol == "step"
+
+
+def test_recompile_hazard_fstring_and_decorator(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        @jax.jit
+        def noisy(x):
+            print(f"x is {x}")
+            return x * 2
+    """, "recompile-hazard")
+    assert len(findings) == 1
+    assert "f-string" in findings[0].message
+
+
+def test_recompile_hazard_unhashable_static_default(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def f(x, opts=[1, 2]):
+            return x
+
+        g = jax.jit(f, static_argnames=("opts",))
+    """, "recompile-hazard")
+    assert len(findings) == 1
+    assert "unhashable" in findings[0].message
+
+
+def test_recompile_hazard_shape_branch_is_static(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        @jax.jit
+        def pad(x, y=None):
+            if y is None:                  # static: identity vs None
+                y = x
+            if x.shape[0] > 1:             # static: shapes fixed per trace
+                x = x[:1]
+            n = len(x)                     # static under jit
+            print(f"rank={x.ndim}")        # static attribute formatting
+            return x + y
+    """, "recompile-hazard")
+    assert findings == []
+
+
+def test_recompile_hazard_static_argnames_excluded(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def accum(x, axis):
+            if axis > 0:       # axis is STATIC -> plain python, fine
+                return x.sum(axis)
+            return x
+
+        jitted = jax.jit(accum, static_argnames=("axis",))
+    """, "recompile-hazard")
+    assert findings == []
+
+
+# -- host-sync ---------------------------------------------------------------
+
+def test_host_sync_detected_in_hot_path(tmp_path):
+    findings = _lint(tmp_path, "serving/server.py", """
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """, "host-sync")
+    assert len(findings) == 1
+    assert "device->host sync" in findings[0].message
+    assert findings[0].severity == "warning"
+
+
+def test_host_sync_loop_rule_and_cold_module(tmp_path):
+    # loop in a hot module, outside the designated hot functions
+    findings = _lint(tmp_path, "optimizer.py", """
+        def sweep(arrs):
+            out = 0.0
+            for a in arrs:
+                out += a.asscalar()
+            return out
+    """, "host-sync")
+    assert len(findings) == 1
+    # identical code in a cold module: silent
+    assert _lint(tmp_path, "image/image.py", """
+        def sweep(arrs):
+            out = 0.0
+            for a in arrs:
+                out += a.asscalar()
+            return out
+    """, "host-sync") == []
+
+
+def test_host_sync_suppression_comment(tmp_path):
+    findings = _lint(tmp_path, "serving/server.py", """
+        class S:
+            def _execute(self, reqs):
+                # deliberate: result delivery
+                return [r.out.asnumpy() for r in reqs]  # graftlint: disable=host-sync
+    """, "host-sync")
+    assert findings == []
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+_LOCK_SRC = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.hits = 0      # guarded-by: _lock
+            self.rows = []     # guarded-by: _lock
+
+        def locked_inc(self):
+            with self._lock:
+                self.hits += 1
+                self.rows.append(1)
+
+        def racy_inc(self):
+            self.hits += 1
+
+        def racy_append(self):
+            self.rows.append(1)
+
+        def _inc_locked(self):
+            self.hits += 1     # caller holds the lock by convention
+"""
+
+
+def test_lock_discipline_detects_unguarded_rmw(tmp_path):
+    findings = _lint(tmp_path, "m.py", _LOCK_SRC, "lock-discipline")
+    assert {f.symbol for f in findings} == {"Cache.racy_inc",
+                                           "Cache.racy_append"}
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_lock_discipline_module_level_and_suppression(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import threading
+        _L = threading.Lock()
+        _DEPTH = [0]   # guarded-by: _L
+
+        def enter():
+            _DEPTH[0] += 1
+
+        def exit():
+            with _L:
+                _DEPTH[0] -= 1
+
+        def forced():
+            _DEPTH[0] += 1  # graftlint: disable=lock-discipline
+    """, "lock-discipline")
+    assert len(findings) == 1
+    assert findings[0].symbol == "enter"
+
+
+def test_lock_discipline_fingerprint_survives_decl_shift(tmp_path):
+    """The finding message must not embed the declaration's line number
+    — a baselined lock-discipline entry has to survive unrelated edits
+    above the '# guarded-by:' declaration (the baseline contract)."""
+    src = """
+        import threading
+        _L = threading.Lock()
+        _DEPTH = [0]   # guarded-by: _L
+
+        def enter():
+            _DEPTH[0] += 1
+    """
+    f1 = _lint(tmp_path, "m.py", src, "lock-discipline")
+    (tmp_path / "m.py").write_text(
+        "# an unrelated line shifting the declaration\n"
+        + textwrap.dedent(src))
+    f2 = analysis.run([str(tmp_path / "m.py")], rules=["lock-discipline"],
+                      root=str(tmp_path))
+    assert f1[0].fingerprint == f2[0].fingerprint
+
+
+def test_lock_discipline_reads_not_flagged(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0     # guarded-by: _lock
+
+            def peek(self):
+                return self.n          # lock-free read is the idiom
+    """, "lock-discipline")
+    assert findings == []
+
+
+# -- env-knob-drift ----------------------------------------------------------
+
+def _env_fixture(tmp_path):
+    (tmp_path / "mxnet_tpu").mkdir(exist_ok=True)
+    (tmp_path / "mxnet_tpu" / "config.py").write_text(textwrap.dedent("""
+        def register_env(name, typ=str, default=None, description=""):
+            pass
+        register_env("MXNET_GOOD_KNOB", str, None, "fine")
+        register_env("MXNET_UNDOCUMENTED_KNOB", str, None, "no docs row")
+    """))
+    docs = tmp_path / "docs" / "faq"
+    docs.mkdir(parents=True, exist_ok=True)
+    (docs / "env_var.md").write_text(
+        "| `MXNET_GOOD_KNOB` | str | unset | fine |\n")
+
+
+def test_env_knob_drift_detects_unregistered_and_undocumented(tmp_path):
+    _env_fixture(tmp_path)
+    findings = _lint(tmp_path, "mxnet_tpu/io.py", """
+        import os
+
+        def knobs():
+            good = os.getenv("MXNET_GOOD_KNOB")
+            bad = os.getenv("MXNET_TYPOED_KNOB")
+            return good, bad
+    """, "env-knob-drift", root=tmp_path)
+    assert len(findings) == 1
+    assert "MXNET_TYPOED_KNOB" in findings[0].message
+    assert "never register_env'd" in findings[0].message
+
+
+def test_env_knob_drift_registered_needs_docs_row(tmp_path):
+    _env_fixture(tmp_path)
+    findings = analysis.run([str(tmp_path / "mxnet_tpu" / "config.py")],
+                            rules=["env-knob-drift"], root=str(tmp_path))
+    assert len(findings) == 1
+    assert "MXNET_UNDOCUMENTED_KNOB" in findings[0].message
+    assert "env_var.md" in findings[0].message
+
+
+def test_env_knob_drift_skips_docstrings(tmp_path):
+    _env_fixture(tmp_path)
+    findings = _lint(tmp_path, "mxnet_tpu/io.py", '''
+        def ref():
+            """Mentions the reference macro MXNET_REGISTER_IO_ITER and
+            the wildcard family MXNET_WHATEVER_* without reading them."""
+            return None
+    ''', "env-knob-drift", root=tmp_path)
+    assert findings == []
+
+
+# -- c-api-contract ----------------------------------------------------------
+
+_CPP_BAD = """
+    #include <string>
+    namespace { std::string g; void set_error(const std::string& m) { g = m; } }
+    struct Handle { void* obj; };
+    extern "C" {
+    int MXThingGetShape(void* handle, int* out) {
+      Handle* h = static_cast<Handle*>(handle);
+      (void)h;
+      *out = 1;
+      return 0;
+    }
+    int MXThingName(void* s, const char** out) {
+      const char* c = PyUnicode_AsUTF8(s);
+      *out = c ? c : "";
+      return 0;
+    }
+    int MXThingFail(void* s) {
+      if (s) {
+        return -1;
+      }
+      return 0;
+    }
+    }
+"""
+
+_CPP_GOOD = """
+    #include <string>
+    namespace { std::string g; void set_error(const std::string& m) { g = m; } }
+    struct Handle { void* obj; };
+    extern "C" {
+    int MXThingGetShape(void* handle, int* out) {
+      if (handle == nullptr) {
+        set_error("null handle");
+        return -1;
+      }
+      Handle* h = static_cast<Handle*>(handle);
+      (void)h;
+      *out = 1;
+      return 0;
+    }
+    int MXThingName(void* s, const char** out) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c == nullptr) {
+        set_error("bad utf8");
+        return -1;
+      }
+      *out = c;
+      return 0;
+    }
+    }
+"""
+
+
+def test_c_api_contract_detects_all_three_classes(tmp_path):
+    findings = _lint(tmp_path, "native/c_api.cpp", _CPP_BAD,
+                     "c-api-contract")
+    msgs = "\n".join(f.message for f in findings)
+    assert "without a null check" in msgs          # handle deref
+    assert "PyUnicode_AsUTF8" in msgs              # unchecked utf8
+    assert "returns -1 without set_error" in msgs  # stale error
+    assert len(findings) == 3
+
+
+def test_c_api_contract_clean_and_suppressed(tmp_path):
+    assert _lint(tmp_path, "native/c_api.cpp", _CPP_GOOD,
+                 "c-api-contract") == []
+    suppressed = _CPP_BAD.replace(
+        "Handle* h = static_cast<Handle*>(handle);",
+        "Handle* h = static_cast<Handle*>(handle);  "
+        "// graftlint: disable=c-api-contract")
+    findings = _lint(tmp_path, "native/c_api.cpp", suppressed,
+                     "c-api-contract")
+    assert all("null check" not in f.message for f in findings)
+
+
+def test_c_api_contract_ignores_other_cpp(tmp_path):
+    # only the c_api sources are in scope, not arbitrary .cpp files
+    assert _lint(tmp_path, "native/recordio_core.cpp", _CPP_BAD,
+                 "c-api-contract") == []
+
+
+# -- suppression / baseline / reporters --------------------------------------
+
+def test_file_level_suppression(tmp_path):
+    findings = _lint(tmp_path, "optimizer.py", """
+        # graftlint: disable-file=host-sync
+
+        def sweep(arrs):
+            for a in arrs:
+                a.asnumpy()
+    """, "host-sync")
+    assert findings == []
+
+
+def test_fingerprints_stable_across_line_shifts(tmp_path):
+    src = """
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """
+    f1 = _lint(tmp_path, "serving/server.py", src, "host-sync")
+    shifted = "\n\n\n# a comment pushing everything down\n" + \
+        textwrap.dedent(src)
+    (tmp_path / "serving" / "server.py").write_text(shifted)
+    f2 = analysis.run([str(tmp_path / "serving" / "server.py")],
+                      rules=["host-sync"], root=str(tmp_path))
+    assert f1[0].line != f2[0].line
+    assert f1[0].fingerprint == f2[0].fingerprint
+
+
+def test_baseline_roundtrip_filters_known_findings(tmp_path):
+    src = """
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """
+    findings = _lint(tmp_path, "serving/server.py", src, "host-sync")
+    bl_path = tmp_path / "bl.json"
+    baseline_mod.save(findings, str(bl_path))
+    known = baseline_mod.load(str(bl_path))
+    new, old = baseline_mod.filter_new(findings, known)
+    assert new == [] and len(old) == 1
+    # a NEW finding in the same file still gates
+    worse = textwrap.dedent(src) + textwrap.dedent("""
+        class T:
+            def _execute(self, reqs):
+                reqs[0].wait_to_read()
+    """)
+    (tmp_path / "serving" / "server.py").write_text(worse)
+    findings = analysis.run([str(tmp_path / "serving" / "server.py")],
+                            rules=["host-sync"], root=str(tmp_path))
+    new, old = baseline_mod.filter_new(findings, known)
+    assert len(old) == 1 and len(new) == 1
+    assert "wait_to_read" in new[0].message
+
+
+def test_reporters(tmp_path):
+    findings = _lint(tmp_path, "serving/server.py", """
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """, "host-sync")
+    text = analysis.human_report(findings)
+    assert "serving/server.py" in text and "[host-sync]" in text
+    assert "1 new finding" in text
+    data = json.loads(analysis.json_report(findings))
+    assert data["summary"] == {"new": 1, "errors": 0, "warnings": 1,
+                               "baselined": 0}
+    assert data["new"][0]["rule"] == "host-sync"
+
+
+def test_unknown_rule_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown rule"):
+        analysis.run([str(tmp_path)], rules=["no-such-rule"])
+
+
+# -- CLI (tools/lint.py + python -m mxnet_tpu.analysis) ----------------------
+
+@pytest.mark.slow
+def test_cli_flags_roundtrip(tmp_path):
+    bad = tmp_path / "serving" / "server.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+            str(bad), "--rule", "host-sync",
+            "--baseline", str(tmp_path / "bl.json")]
+    r = subprocess.run(base + ["--json"], capture_output=True, text=True,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 1, r.stderr
+    assert json.loads(r.stdout)["summary"]["new"] == 1
+    r = subprocess.run(base + ["--update-baseline"], capture_output=True,
+                       text=True, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    r = subprocess.run(base + ["--json"], capture_output=True, text=True,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["summary"]["new"] == 0 and out["summary"]["baselined"] == 1
+    r = subprocess.run(base + ["--list-rules"], capture_output=True,
+                       text=True, env=env, cwd=ROOT)
+    assert r.returncode == 0
+    assert set(r.stdout.split()) >= {"host-sync", "c-api-contract",
+                                     "env-knob-drift", "lock-discipline",
+                                     "recompile-hazard"}
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+def test_tree_clean_against_committed_baseline():
+    """THE gate: the full analyzer over the real mxnet_tpu/ tree must
+    produce no findings beyond the committed baseline.  Seeding any
+    known-bad pattern (an unguarded RMW on a guarded-by attribute, an
+    unchecked handle deref in c_api.cpp, an unregistered MXNET_* knob)
+    fails this test."""
+    findings = analysis.run([os.path.join(ROOT, "mxnet_tpu")])
+    known = baseline_mod.load(analysis.default_path(ROOT))
+    new, _old = baseline_mod.filter_new(findings, known)
+    assert not new, "new graftlint findings:\n%s" % analysis.human_report(new)
+
+
+def test_committed_baseline_carries_no_dead_entries():
+    """Baseline hygiene: every committed entry still matches a live
+    finding — fixed findings must leave the baseline (run
+    tools/lint.py --update-baseline) so the file never masks a
+    REINTRODUCTION of a once-fixed bug."""
+    findings = analysis.run([os.path.join(ROOT, "mxnet_tpu")])
+    live = {f.fingerprint for f in findings}
+    known = baseline_mod.load(analysis.default_path(ROOT))
+    dead = sorted(set(known) - live)
+    assert not dead, "baseline entries with no matching finding: %s" % dead
+
+
+def test_seeded_regression_is_caught(tmp_path):
+    """End-to-end proof the gate bites: copy one real source file,
+    seed the PR 3 race pattern (unguarded += on a guarded-by counter),
+    and the analyzer flags exactly that line."""
+    real = os.path.join(ROOT, "mxnet_tpu", "serving", "cache.py")
+    dst = tmp_path / "serving" / "cache.py"
+    dst.parent.mkdir(parents=True)
+    with open(real) as f:
+        src = f.read()
+    seeded = src.replace(
+        "    def clear(self):",
+        "    def racy_touch(self):\n"
+        "        self.hits += 1\n"
+        "\n"
+        "    def clear(self):")
+    assert seeded != src, "cache.py no longer has the clear() anchor"
+    dst.write_text(seeded)
+    findings = analysis.run([str(dst)], rules=["lock-discipline"],
+                            root=str(tmp_path))
+    assert len(findings) == 1
+    assert findings[0].symbol == "ExecutorCache.racy_touch"
+    # the unseeded original is clean
+    dst.write_text(src)
+    assert analysis.run([str(dst)], rules=["lock-discipline"],
+                        root=str(tmp_path)) == []
+
+
+def test_host_sync_closure_inherits_hotness(tmp_path):
+    """A closure defined inside a hot function runs per step — hot-ness
+    is inherited by enclosure, not derived from the closure's name."""
+    findings = _lint(tmp_path, "serving/server.py", """
+        class S:
+            def _execute(self, reqs):
+                def deliver(r):
+                    return r.out.asnumpy()
+                return [deliver(r) for r in reqs]
+    """, "host-sync")
+    assert len(findings) == 1
+    assert findings[0].symbol == "deliver"
+
+
+def test_update_baseline_restricted_run_preserves_out_of_scope(tmp_path):
+    """--update-baseline on a --rule/path-restricted run must merge:
+    out-of-scope baseline entries survive instead of being silently
+    dropped (which would make the next full run gate on old debt)."""
+    hot = tmp_path / "serving" / "server.py"
+    hot.parent.mkdir(parents=True)
+    hot.write_text(textwrap.dedent("""
+        class S:
+            def _execute(self, reqs):
+                return [r.out.asnumpy() for r in reqs]
+    """))
+    lock = tmp_path / "m.py"
+    lock.write_text(textwrap.dedent(_LOCK_SRC))
+    bl = tmp_path / "bl.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+            "--baseline", str(bl)]
+    # full-ish run over both files -> 3 baselined findings
+    r = subprocess.run(base + [str(hot), str(lock), "--update-baseline"],
+                       capture_output=True, text=True, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    assert len(baseline_mod.load(str(bl))) == 3
+    # restricted re-run must NOT drop the 2 lock-discipline entries
+    r = subprocess.run(base + [str(hot), "--rule", "host-sync",
+                               "--update-baseline"],
+                       capture_output=True, text=True, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    assert "preserved" in r.stdout
+    known = baseline_mod.load(str(bl))
+    assert len(known) == 3
+    assert sorted({e["rule"] for e in known.values()}) == \
+        ["host-sync", "lock-discipline"]
+
+
+test_update_baseline_restricted_run_preserves_out_of_scope = pytest.mark.slow(
+    test_update_baseline_restricted_run_preserves_out_of_scope)

@@ -714,3 +714,104 @@ def test_mark_variables_null_handles(lib):
     assert rc == -1
     assert b"null variable handle" in lib.MXGetLastError()
     _check(lib.MXNDArrayFree(x), lib)
+
+
+def test_null_pointer_contract(lib):
+    """Every exported entry rejects a NULL handle with rc=-1 and a
+    message through MXGetLastError instead of crashing the host — the
+    CHECK_NULL contract graftlint's c-api-contract rule enforces over
+    native/c_api.cpp (ADVICE rounds 2/5 bug class)."""
+    dim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    rc = lib.MXNDArrayGetShape(None, ctypes.byref(dim), ctypes.byref(pdata))
+    assert rc == -1
+    assert b"handle is null" in lib.MXGetLastError()
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(None, ctypes.byref(dt)) == -1
+    assert lib.MXNDArrayWaitToRead(None) == -1
+    assert lib.MXExecutorForward(None, 1) == -1
+    out = ctypes.c_void_p()
+    assert lib.MXSymbolCopy(None, ctypes.byref(out)) == -1
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(None, ctypes.byref(n),
+                                     ctypes.byref(arr)) == -1
+    # freeing NULL stays a no-op (reference MXNDArrayFree contract)
+    assert lib.MXNDArrayFree(None) == 0
+
+
+def test_null_array_element_contract(lib):
+    """A NULL ELEMENT inside a non-null handle array is rejected up
+    front (before any Python list is half-built), same rc/-1 path."""
+    x = _nd_from_np(lib, np.array([[1.0, 2.0]], np.float32))
+    ins = (ctypes.c_void_p * 2)(x.value, None)     # second entry NULL
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    rc = lib.MXImperativeInvokeByName(
+        b"elemwise_add", 2, ins, ctypes.byref(n_out), ctypes.byref(outs),
+        0, None, None)
+    assert rc == -1
+    assert b"is null" in lib.MXGetLastError()
+    # save with a NULL element: same contract
+    keys = (ctypes.c_char_p * 2)(b"a", b"b")
+    rc = lib.MXNDArraySave(b"/tmp/_graftlint_nowrite.nd", 2, ins, keys)
+    assert rc == -1
+    _check(lib.MXNDArrayFree(x), lib)
+
+
+def test_null_string_key_element_contract(lib):
+    """A NULL string element inside a non-null key/value array is
+    rejected with rc=-1 (PyUnicode_FromString(NULL) would strlen-crash
+    the host otherwise)."""
+    x = _nd_from_np(lib, np.array([[1.0, 2.0]], np.float32))
+    ins = (ctypes.c_void_p * 1)(x.value)
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    keys = (ctypes.c_char_p * 1)(None)       # NULL key element
+    vals = (ctypes.c_char_p * 1)(b"1")
+    rc = lib.MXImperativeInvokeByName(
+        b"sum", 1, ins, ctypes.byref(n_out), ctypes.byref(outs),
+        1, keys, vals)
+    assert rc == -1
+    assert b"is null" in lib.MXGetLastError()
+    # save with a NULL key element (keys array itself non-null)
+    rc = lib.MXNDArraySave(b"/tmp/_graftlint_nowrite.nd", 1, ins, keys)
+    assert rc == -1
+    _check(lib.MXNDArrayFree(x), lib)
+
+
+def test_autograd_backward_null_ograd_entry_means_ones(lib):
+    """Reference contract: a NULL ENTRY in ograd_handles means
+    'ones-like for this head' (mixed None/ndarray head grads), not an
+    error — it must match an all-default backward, not return -1."""
+    def grad_of_double(ograds):
+        x = _nd_from_np(lib, np.array([1.0, 2.0, 3.0], np.float32))
+        gbuf = _nd_from_np(lib, np.zeros(3, np.float32))
+        vars_ = (ctypes.c_void_p * 1)(x.value)
+        grads = (ctypes.c_void_p * 1)(gbuf.value)
+        reqs = (ctypes.c_uint * 1)(1)            # write
+        _check(lib.MXAutogradMarkVariables(1, vars_, reqs, grads), lib)
+        prev = ctypes.c_int()
+        _check(lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)), lib)
+        n_out = ctypes.c_int()
+        outs = ctypes.POINTER(ctypes.c_void_p)()
+        two = _nd_from_np(lib, np.array([2.0, 2.0, 2.0], np.float32))
+        ins = (ctypes.c_void_p * 2)(x.value, two.value)
+        _check(lib.MXImperativeInvokeByName(
+            b"elemwise_mul", 2, ins, ctypes.byref(n_out),
+            ctypes.byref(outs), 0, None, None), lib)
+        head = ctypes.c_void_p(outs[0])
+        _check(lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)), lib)
+        heads = (ctypes.c_void_p * 1)(head.value)
+        _check(lib.MXAutogradBackward(1, heads, ograds, 0), lib)
+        g = ctypes.c_void_p()
+        _check(lib.MXNDArrayGetGrad(x, ctypes.byref(g)), lib)
+        out = _nd_to_np(lib, g)
+        for h in (head, two, x, gbuf):
+            _check(lib.MXNDArrayFree(h), lib)
+        return out
+
+    ref = grad_of_double(None)                       # whole array NULL
+    mixed = grad_of_double((ctypes.c_void_p * 1)(None))  # NULL ENTRY
+    assert np.allclose(ref, 2.0)
+    assert np.allclose(mixed, ref)

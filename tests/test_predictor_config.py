@@ -105,56 +105,33 @@ def test_role_predicates():
 
 
 def test_every_registered_env_var_is_documented():
-    """docs/faq/env_var.md is the contract surface for knobs (reference
-    docs/faq/env_var.md documents its env registry); every var in the
-    live config registry must appear there — a new register_env without
-    a docs row fails here, so the doc cannot drift."""
-    from mxnet_tpu import config
-    doc = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docs", "faq", "env_var.md")
-    with open(doc) as f:
-        text = f.read()
-    import re
-    # word-boundary match: a var must appear as its own token, not as a
-    # substring of a longer documented name
-    missing = [name for name in config._REGISTRY
-               if not re.search(r"\b%s\b" % re.escape(name), text)]
-    assert not missing, \
-        "registered env vars missing from docs/faq/env_var.md: %s" % missing
+    """docs/faq/env_var.md is the contract surface for knobs; every var
+    in the config registry must appear there.  Thin wrapper over the
+    graftlint env-knob-drift checker (the single source of truth for
+    this property — docs/faq/static_analysis.md)."""
+    from mxnet_tpu.analysis.checkers import env_knobs
+    rep = env_knobs.drift_report()
+    assert not rep["registered_undocumented"], \
+        "registered env vars missing from docs/faq/env_var.md: %s" \
+        % rep["registered_undocumented"]
 
 
 def test_telemetry_knobs_registered_and_documented():
-    """Registry-drift guard extended to the telemetry knobs: every
-    MXNET_TELEMETRY* name referenced anywhere in the package source (or
-    bench.py) must be declared via register_env AND documented in
-    docs/faq/env_var.md — a knob added at a call site without registry +
-    docs rows fails here."""
-    import glob
-    import re
-    from mxnet_tpu import config
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sources = glob.glob(os.path.join(root, "mxnet_tpu", "**", "*.py"),
-                        recursive=True) + [os.path.join(root, "bench.py")]
-    used = set()
-    for path in sources:
-        with open(path) as f:
-            text = f.read()
-        for name in re.findall(r"MXNET_TELEMETRY[A-Z_]*", text):
-            name = name.rstrip("_")   # docstring wildcards like _*
-            if name:
-                used.add(name)
+    """Registry-drift guard for the telemetry knob family: every
+    MXNET_TELEMETRY* name the source (or bench.py) reads must be
+    register_env'd AND documented.  Thin wrapper over the graftlint
+    env-knob-drift checker — the enforcement logic lives once, in
+    mxnet_tpu/analysis/checkers/env_knobs.py."""
+    from mxnet_tpu.analysis.checkers import env_knobs
+    rep = env_knobs.drift_report(prefix="MXNET_TELEMETRY",
+                                 extra_sources=("bench.py",))
+    # sanity: the scan really sees the family before asserting clean
     assert {"MXNET_TELEMETRY", "MXNET_TELEMETRY_STEP_LOG",
             "MXNET_TELEMETRY_STEP_INTERVAL",
-            "MXNET_TELEMETRY_PROM_FILE"} <= used
-    unregistered = sorted(n for n in used if n not in config._REGISTRY)
-    assert not unregistered, \
+            "MXNET_TELEMETRY_PROM_FILE"} <= set(rep["used"])
+    assert not rep["unregistered"], \
         "telemetry knobs referenced but never register_env'd: %s" \
-        % unregistered
-    doc = os.path.join(root, "docs", "faq", "env_var.md")
-    with open(doc) as f:
-        doc_text = f.read()
-    undocumented = sorted(
-        n for n in used
-        if not re.search(r"\b%s\b" % re.escape(n), doc_text))
-    assert not undocumented, \
-        "telemetry knobs missing from docs/faq/env_var.md: %s" % undocumented
+        % rep["unregistered"]
+    assert not rep["undocumented"], \
+        "telemetry knobs missing from docs/faq/env_var.md: %s" \
+        % rep["undocumented"]

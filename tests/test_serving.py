@@ -421,3 +421,22 @@ def test_concurrency_soak():
     srv.stop()
     srv.cache.clear()
     assert wall < 300
+
+
+def test_serving_knobs_registered_and_documented():
+    """Env-drift guard for the MXNET_SERVING_* knob family — thin
+    wrapper over the graftlint env-knob-drift checker (single source of
+    truth, docs/faq/static_analysis.md); the enforcement logic lives in
+    mxnet_tpu/analysis/checkers/env_knobs.py."""
+    from mxnet_tpu.analysis.checkers import env_knobs
+    rep = env_knobs.drift_report(prefix="MXNET_SERVING")
+    assert {"MXNET_SERVING_MAX_BATCH", "MXNET_SERVING_QUEUE_DEPTH",
+            "MXNET_SERVING_BATCH_WAIT_MS",
+            "MXNET_SERVING_DEFAULT_TIMEOUT_MS",
+            "MXNET_SERVING_EXECUTOR_CACHE"} <= set(rep["used"])
+    assert not rep["unregistered"], \
+        "serving knobs referenced but never register_env'd: %s" \
+        % rep["unregistered"]
+    assert not rep["undocumented"], \
+        "serving knobs missing from docs/faq/env_var.md: %s" \
+        % rep["undocumented"]

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""graftlint launcher — ``tools/lint.py [paths...] [--json] [--rule R]
+[--update-baseline]``.
+
+Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
+by putting the repo root on ``sys.path`` first.  See
+``docs/faq/static_analysis.md`` for the rule catalog, suppression
+syntax, and the baseline workflow.
+"""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from mxnet_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
