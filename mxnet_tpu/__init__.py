@@ -60,5 +60,6 @@ from .torch import TorchModule as _TorchModule
 th = _TorchModule("torch")
 from . import predictor  # noqa: F401
 from .predictor import Predictor  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import serving  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -19,13 +19,45 @@ __all__ = ["module_checkpoint", "do_checkpoint", "log_train_metric",
            "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
 
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint a module every `period` epochs (reference: callback.py:28)."""
+def module_checkpoint(mod, prefix=None, period=1, save_optimizer_states=False,
+                      manager=None):
+    """Checkpoint a module every `period` epochs (reference: callback.py:28).
+
+    ``period`` counts from the last SUCCESSFUL save: a failed or
+    refused save (disk error, async writer busy) is retried at the next
+    epoch instead of silently waiting another full period — the old
+    modulo schedule could stretch the gap between durable snapshots to
+    ``2*period - 1`` epochs after one bad epoch.
+
+    ``manager``: route saves through a ``checkpoint.CheckpointManager``
+    (atomic, sharded, full resume state) instead of — when ``prefix``
+    is None — or in addition to the legacy prefix files."""
     period = int(max(1, period))
+    if prefix is None and manager is None:
+        raise ValueError("module_checkpoint needs a prefix, a manager, "
+                         "or both")
+    last_saved = [0]   # epochs completed at the last successful save
 
     def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+        done = iter_no + 1
+        if done - last_saved[0] < period:
+            return
+        try:
+            if manager is not None:
+                if not manager.save_module(mod, epoch=done):
+                    return   # writer busy — retry next epoch
+                if prefix is not None:
+                    # manager=False: the managed save just happened —
+                    # don't let MXNET_CKPT_DIR route a second one
+                    mod.save_checkpoint(prefix, done, save_optimizer_states,
+                                        manager=False)
+            else:
+                mod.save_checkpoint(prefix, done, save_optimizer_states)
+        except Exception:
+            logging.warning("checkpoint at epoch %d failed; retrying next "
+                            "epoch", done, exc_info=True)
+            return
+        last_saved[0] = done
     return _callback
 
 

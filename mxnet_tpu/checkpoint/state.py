@@ -1,0 +1,249 @@
+"""TrainState — everything needed to resume training bit-identically.
+
+The legacy ``model.save_checkpoint`` persists params only; a restore
+from it replays a DIFFERENT training run: the optimizer restarts with
+zeroed momentum, the lr scheduler falls back to update 0, the data
+iterator starts the epoch over, and the RNG chain re-deals every
+dropout mask.  TrainState closes each of those gaps:
+
+- **params / aux states** — staged to host numpy (one ``device_get``
+  per array, off the step path) and stored as raw shards;
+- **optimizer state** — the kvstore-facing :class:`~mxnet_tpu.optimizer.
+  Updater` pickled WITH its optimizer (``get_states(dump_optimizer=
+  True)``), which carries momentum/variance arrays, ``num_update``,
+  the per-index update counts, and the live ``lr_scheduler`` object —
+  so the restored schedule continues from the exact step it left;
+- **RNG** — the host-side ``(seed, count)`` threefry chain of
+  ``mxnet_tpu.random`` AND the global numpy generator (which
+  ``NDArrayIter(shuffle=True)`` draws from at every epoch reset);
+  restoring both makes every post-resume key derivation and every
+  later epoch's shuffle order identical to the uninterrupted run;
+- **iterator position** — cursor (plus the shuffled index order when
+  present) of any iterator exposing the ``NDArrayIter`` contract;
+- **loop position** — epoch / nbatch / global step;
+- **serving handoff** — the symbol JSON and bound input shapes, so the
+  serving registry can hot-swap a committed checkpoint without the
+  training script's help.
+
+A TrainState is a plain host-side value: capture is cheap staging, all
+serialization/hashing happens later (the async writer thread).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+import warnings
+
+import numpy as np
+
+from .. import random as _random
+
+__all__ = ["TrainState", "capture_iter_state", "restore_iter_state"]
+
+_ARG_PREFIX = "arg/"
+_AUX_PREFIX = "aux/"
+_ITER_IDX_KEY = "iter/idx"
+_OPTIMIZER_BLOB = "optimizer"
+_SYMBOL_BLOB = "symbol"
+_NP_RANDOM_BLOB = "np_random"
+
+
+def capture_iter_state(data_iter):
+    """Snapshot a data iterator's position: ``(meta_dict, idx_array)``.
+
+    Supports the in-memory iterator contract (``cursor`` int attribute,
+    optional ``idx`` permutation — ``NDArrayIter``, ``LibSVMIter``);
+    returns ``(None, None)`` for iterators with no capturable position
+    (streaming/prefetching readers), in which case resume restarts the
+    epoch — documented, not silent: callers get a warning."""
+    if data_iter is None:
+        return None, None
+    cursor = getattr(data_iter, "cursor", None)
+    if not isinstance(cursor, (int, np.integer)):
+        warnings.warn(
+            "data iterator %s exposes no cursor; resume will restart "
+            "the current epoch" % type(data_iter).__name__, stacklevel=3)
+        return None, None
+    meta = {"cursor": int(cursor),
+            "iter_class": type(data_iter).__name__}
+    idx = getattr(data_iter, "idx", None)
+    return meta, (np.asarray(idx) if idx is not None else None)
+
+
+def restore_iter_state(data_iter, meta, idx):
+    """Reposition ``data_iter`` to a captured state (inverse of
+    :func:`capture_iter_state`)."""
+    if data_iter is None or not meta:
+        return False
+    if not hasattr(data_iter, "cursor"):
+        warnings.warn(
+            "data iterator %s cannot be repositioned; resuming from "
+            "the top of the epoch" % type(data_iter).__name__, stacklevel=3)
+        return False
+    if idx is not None and hasattr(data_iter, "idx"):
+        # restore the epoch's shuffle order BEFORE the cursor so the
+        # remaining batches are the uninterrupted run's batches
+        data_iter.idx = np.array(idx)
+    data_iter.cursor = int(meta["cursor"])
+    return True
+
+
+class TrainState:
+    """One resumable snapshot of a training job (host-side value)."""
+
+    def __init__(self, arg_params, aux_params, meta, optimizer_state=None,
+                 symbol_json=None, iter_idx=None, np_random_state=None):
+        self.arg_params = dict(arg_params)       # name -> numpy array
+        self.aux_params = dict(aux_params)       # name -> numpy array
+        self.meta = dict(meta)
+        self.optimizer_state = optimizer_state   # pickle bytes or None
+        self.symbol_json = symbol_json           # str or None
+        self.iter_idx = iter_idx                 # numpy permutation or None
+        self.np_random_state = np_random_state   # pickle bytes or None
+
+    # -- capture -------------------------------------------------------------
+    @classmethod
+    def capture(cls, module, epoch=0, nbatch=0, global_step=None,
+                train_data=None):
+        """Snapshot ``module`` + the loop/RNG/iterator state around it.
+
+        ``get_params`` syncs the master copies from the devices; the
+        per-array ``asnumpy`` is the ``device_get`` staging step — after
+        capture returns, the snapshot shares nothing with device memory
+        and training may proceed while a writer serializes it."""
+        arg_params, aux_params = module.get_params()
+        args = {k: v.asnumpy() for k, v in arg_params.items()}
+        auxs = {k: v.asnumpy() for k, v in aux_params.items()}
+
+        optimizer_state = None
+        updater = getattr(module, "_updater", None)
+        if updater is None:
+            kvstore = getattr(module, "_kvstore", None)
+            updater = getattr(kvstore, "_updater", None)
+        if updater is not None:
+            optimizer_state = updater.get_states(dump_optimizer=True)
+        elif getattr(module, "optimizer_initialized", False):
+            warnings.warn(
+                "optimizer state lives server-side (distributed kvstore) "
+                "and is not captured; resume restarts optimizer slots",
+                stacklevel=2)
+
+        # the framework chain plus the GLOBAL numpy generator: iterator
+        # reshuffles (NDArrayIter.reset with shuffle=True) draw from the
+        # latter, so later epochs' batch order depends on it
+        np_random_state = pickle.dumps(np.random.get_state())
+        meta = {"epoch": int(epoch), "nbatch": int(nbatch),
+                "rng": _random.get_state()}
+        if global_step is not None:
+            meta["global_step"] = int(global_step)
+        optimizer = getattr(module, "_optimizer", None)
+        if optimizer is not None:
+            meta["num_update"] = int(getattr(optimizer, "num_update", 0))
+
+        iter_meta, iter_idx = capture_iter_state(train_data)
+        if iter_meta is not None:
+            meta["iter"] = iter_meta
+
+        symbol_json = None
+        if getattr(module, "symbol", None) is not None:
+            symbol_json = module.symbol.tojson()
+        if getattr(module, "binded", False):
+            meta["input_shapes"] = {d.name: list(d.shape)
+                                    for d in module.data_shapes}
+        return cls(args, auxs, meta, optimizer_state=optimizer_state,
+                   symbol_json=symbol_json, iter_idx=iter_idx,
+                   np_random_state=np_random_state)
+
+    # -- store payload -------------------------------------------------------
+    def to_payload(self):
+        """``(arrays, blobs, meta)`` in the store's manifest vocabulary."""
+        arrays = {_ARG_PREFIX + k: v for k, v in self.arg_params.items()}
+        arrays.update({_AUX_PREFIX + k: v
+                       for k, v in self.aux_params.items()})
+        if self.iter_idx is not None:
+            arrays[_ITER_IDX_KEY] = self.iter_idx
+        blobs = {}
+        if self.optimizer_state is not None:
+            blobs[_OPTIMIZER_BLOB] = self.optimizer_state
+        if self.symbol_json is not None:
+            blobs[_SYMBOL_BLOB] = self.symbol_json.encode()
+        if self.np_random_state is not None:
+            blobs[_NP_RANDOM_BLOB] = self.np_random_state
+        return arrays, blobs, self.meta
+
+    @classmethod
+    def from_payload(cls, arrays, blobs, meta):
+        """Rebuild a TrainState from a store ``read()`` result."""
+        args = {k[len(_ARG_PREFIX):]: v for k, v in arrays.items()
+                if k.startswith(_ARG_PREFIX)}
+        auxs = {k[len(_AUX_PREFIX):]: v for k, v in arrays.items()
+                if k.startswith(_AUX_PREFIX)}
+        symbol_json = blobs.get(_SYMBOL_BLOB)
+        return cls(args, auxs, meta,
+                   optimizer_state=blobs.get(_OPTIMIZER_BLOB),
+                   symbol_json=(symbol_json.decode()
+                                if symbol_json is not None else None),
+                   iter_idx=arrays.get(_ITER_IDX_KEY),
+                   np_random_state=blobs.get(_NP_RANDOM_BLOB))
+
+    # -- restore -------------------------------------------------------------
+    def restore_into(self, module, train_data=None, restore_rng=True):
+        """Load this snapshot into ``module`` (and optionally reposition
+        ``train_data`` / the global RNG chain).
+
+        A bound module gets ``set_params(force_init=True)``; an unbound
+        one gets its master param dicts assigned directly (the
+        ``Module.load`` deferred path — ``bind`` pushes them to devices
+        later).  When the module's optimizer is initialized and driven
+        by a local updater, the pickled updater payload restores slot
+        arrays AND the optimizer object itself (scheduler position,
+        ``num_update``), which is then re-linked as the module's
+        optimizer so later ``borrow_optimizer``/save cycles see it."""
+        from .. import ndarray as nd
+        args = {k: nd.array(v) for k, v in self.arg_params.items()}
+        auxs = {k: nd.array(v) for k, v in self.aux_params.items()}
+        if getattr(module, "binded", False):
+            module.set_params(args, auxs, force_init=True)
+        else:
+            module._arg_params = args
+            module._aux_params = auxs
+            module.params_initialized = True
+
+        if self.optimizer_state is not None and \
+                getattr(module, "optimizer_initialized", False):
+            updater = getattr(module, "_updater", None)
+            if updater is None:
+                updater = getattr(getattr(module, "_kvstore", None),
+                                  "_updater", None)
+            if updater is not None:
+                updater.set_states(self.optimizer_state)
+                module._optimizer = updater.optimizer
+            else:
+                logging.warning(
+                    "checkpoint has optimizer state but module has no "
+                    "local updater; optimizer slots not restored")
+
+        if restore_rng and "rng" in self.meta:
+            _random.set_state(self.meta["rng"])
+        if restore_rng and self.np_random_state is not None:
+            np.random.set_state(pickle.loads(self.np_random_state))
+        if train_data is not None:
+            restore_iter_state(train_data, self.meta.get("iter"),
+                               self.iter_idx)
+        return self
+
+    # -- conveniences --------------------------------------------------------
+    @property
+    def epoch(self):
+        return int(self.meta.get("epoch", 0))
+
+    @property
+    def nbatch(self):
+        return int(self.meta.get("nbatch", 0))
+
+    def __repr__(self):
+        return ("TrainState(epoch=%d, nbatch=%d, params=%d, aux=%d, "
+                "optimizer=%s)"
+                % (self.epoch, self.nbatch, len(self.arg_params),
+                   len(self.aux_params),
+                   "yes" if self.optimizer_state is not None else "no"))

@@ -1,0 +1,352 @@
+"""On-disk checkpoint layout — sharded, integrity-checked, atomic.
+
+Layout under one checkpoint root::
+
+    <root>/
+      ckpt-00000007/              # one COMMITTED checkpoint
+        manifest.json             # schema below; written last, inside tmp
+        arg.fc1_weight.bin        # one raw little-endian shard per array
+        aux.bn_moving_mean.bin
+        optimizer.pkl             # opaque blobs (optimizer state, symbol)
+        symbol.json
+      .tmp-ckpt-00000008-<pid>-<nonce>/   # an in-flight or crashed write
+
+Commit protocol (the crash-safety core): every shard and finally the
+manifest are written into a hidden ``.tmp-*`` sibling directory; the
+commit is ONE ``os.replace(tmp, final)``.  Directory rename is atomic
+on POSIX, so a reader can never observe a half-written checkpoint at a
+``ckpt-*`` name — a crash at any instant leaves either no ``ckpt-N``
+or a complete one, plus possibly an orphan ``.tmp-*`` that
+:meth:`CheckpointStore.gc_orphans` reaps.  ``latest()`` therefore only
+ever resolves COMPLETE checkpoints, with no lock between writer and
+reader processes (the serving watcher polls the same directory).
+
+Manifest schema (``manifest.json``, version 1)::
+
+    {"format": "mxnet-tpu-checkpoint", "version": 1, "step": 7,
+     "meta":   {...caller state: epoch/nbatch/rng/iter/...},
+     "shards": {"arg/fc1_weight": {"file": "arg.fc1_weight.bin",
+                "dtype": "float32", "shape": [8, 64],
+                "bytes": 2048, "sha256": "..."}, ...},
+     "blobs":  {"optimizer": {"file": "optimizer.pkl",
+                "bytes": 123, "sha256": "..."}, ...}}
+
+Every shard/blob carries its byte size and sha256; :meth:`read`
+verifies both before handing data back, so bit rot or a torn disk is an
+:class:`IntegrityError` instead of NaNs three epochs later.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import threading
+import uuid
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CheckpointError", "IntegrityError", "CheckpointStore",
+           "RetentionPolicy", "MANIFEST_NAME", "MANIFEST_FORMAT",
+           "MANIFEST_VERSION"]
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = "mxnet-tpu-checkpoint"
+MANIFEST_VERSION = 1
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+_TMP_PREFIX = ".tmp-"
+_TMP_RE = re.compile(r"^\.tmp-ckpt-\d{8}-(?P<pid>\d+)-[0-9a-f]+$")
+
+# temp dirs any store in THIS process is actively writing: gc must never
+# reap a live in-flight save, and two managers over the same directory
+# (explicit + process-default) share this one exclusion set
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_TMP = set()   # guarded-by: _ACTIVE_LOCK
+
+
+class CheckpointError(MXNetError):
+    """A checkpoint could not be written or resolved."""
+
+
+class IntegrityError(CheckpointError):
+    """Stored bytes disagree with the manifest (size or sha256)."""
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def _shard_file(name, kind="bin", used=None):
+    """Array/blob name -> filename: path separators and anything exotic
+    flattened so a shard never escapes its checkpoint directory.
+
+    Flattening can collide (``fc1/weight`` vs ``fc1.weight``); when a
+    ``used`` set is supplied, a colliding name gets a sha-derived
+    disambiguator — the manifest records the final filename, so readers
+    never care."""
+    base = re.sub(r"[^A-Za-z0-9_.-]", ".", name)
+    fname = "%s.%s" % (base, kind)
+    if used is not None:
+        if fname in used:
+            fname = "%s.%s.%s" % (
+                base, hashlib.sha256(name.encode()).hexdigest()[:8], kind)
+        used.add(fname)
+    return fname
+
+
+def _np_dtype(name):
+    """dtype-by-name, including the ml_dtypes families numpy itself
+    does not know (bfloat16 params saved from a TPU run)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class CheckpointStore:
+    """All filesystem knowledge of the checkpoint subsystem: shard and
+    manifest encoding, the atomic directory commit, completeness
+    resolution, and orphan garbage collection.  Policy (when to save,
+    what to keep) lives above, in the manager/retention layer."""
+
+    def __init__(self, root):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- naming --------------------------------------------------------------
+    def path(self, step):
+        return os.path.join(self.root, "ckpt-%08d" % int(step))
+
+    # -- write / commit ------------------------------------------------------
+    def write(self, step, arrays, blobs=None, meta=None):
+        """Write one checkpoint and atomically commit it; returns the
+        committed directory path.
+
+        ``arrays``: ``{name: numpy array}`` — one raw shard each.
+        ``blobs``: ``{name: bytes}`` — opaque payloads (optimizer pickle,
+        symbol JSON).  On ANY failure the temp directory is left in
+        place for :meth:`gc_orphans` — a failed save and a crashed save
+        look identical on disk, so recovery is one code path."""
+        step = int(step)
+        final = self.path(step)
+        if os.path.isdir(final):
+            raise CheckpointError("checkpoint step %d already committed at %s"
+                                  % (step, final))
+        tmp = os.path.join(self.root, "%sckpt-%08d-%d-%s" % (
+            _TMP_PREFIX, step, os.getpid(), uuid.uuid4().hex[:8]))
+        with _ACTIVE_LOCK:
+            _ACTIVE_TMP.add(tmp)
+        try:
+            os.makedirs(tmp)
+            manifest = {"format": MANIFEST_FORMAT,
+                        "version": MANIFEST_VERSION,
+                        "step": step,
+                        "meta": dict(meta or {}),
+                        "shards": {},
+                        "blobs": {}}
+            used_names = set()
+            for name, arr in arrays.items():
+                arr = np.ascontiguousarray(arr)
+                data = arr.tobytes()
+                fname = _shard_file(name, used=used_names)
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                manifest["shards"][name] = {
+                    "file": fname, "dtype": str(arr.dtype),
+                    "shape": list(arr.shape), "bytes": len(data),
+                    "sha256": _sha256(data)}
+            for name, data in (blobs or {}).items():
+                data = bytes(data)
+                fname = _shard_file(name, kind="blob", used=used_names)
+                with open(os.path.join(tmp, fname), "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                manifest["blobs"][name] = {
+                    "file": fname, "bytes": len(data),
+                    "sha256": _sha256(data)}
+            # manifest last: inside the temp dir it is still invisible
+            # to readers; its presence after the rename is what makes
+            # the directory a checkpoint
+            with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+            self._fsync_root()
+            return final
+        finally:
+            with _ACTIVE_LOCK:
+                _ACTIVE_TMP.discard(tmp)
+
+    def _fsync_root(self):
+        """Persist the rename itself (the directory entry) so a machine
+        crash right after commit cannot un-commit."""
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    # -- resolution ----------------------------------------------------------
+    def steps(self):
+        """Sorted steps of every COMPLETE checkpoint: a ``ckpt-N``
+        directory whose manifest exists and parses.  ``.tmp-*`` dirs —
+        in-flight or crashed writes — are invisible here by
+        construction."""
+        out = []
+        for name in os.listdir(self.root):
+            m = _CKPT_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.root, name, MANIFEST_NAME)) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if manifest.get("format") == MANIFEST_FORMAT:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest(self):
+        """Newest complete step, or None."""
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def manifest(self, step):
+        path = os.path.join(self.path(step), MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as exc:
+            raise CheckpointError("checkpoint step %d has no readable "
+                                  "manifest (%s)" % (int(step), exc))
+
+    def read(self, step, verify=True):
+        """Load one checkpoint -> ``(manifest, arrays, blobs)``.
+
+        With ``verify`` every shard/blob is size- and sha256-checked
+        against the manifest; a mismatch raises :class:`IntegrityError`
+        naming the offending shard."""
+        manifest = self.manifest(step)
+        base = self.path(step)
+        arrays, blobs = {}, {}
+        for name, spec in manifest.get("shards", {}).items():
+            with open(os.path.join(base, spec["file"]), "rb") as f:
+                data = f.read()
+            if verify and (len(data) != spec["bytes"]
+                           or _sha256(data) != spec["sha256"]):
+                raise IntegrityError(
+                    "checkpoint step %d shard %r fails verification "
+                    "(%d bytes on disk vs %d in manifest)"
+                    % (int(step), name, len(data), spec["bytes"]))
+            arrays[name] = np.frombuffer(
+                data, dtype=_np_dtype(spec["dtype"])).reshape(spec["shape"])
+        for name, spec in manifest.get("blobs", {}).items():
+            with open(os.path.join(base, spec["file"]), "rb") as f:
+                data = f.read()
+            if verify and (len(data) != spec["bytes"]
+                           or _sha256(data) != spec["sha256"]):
+                raise IntegrityError(
+                    "checkpoint step %d blob %r fails verification"
+                    % (int(step), name))
+            blobs[name] = data
+        return manifest, arrays, blobs
+
+    # -- lifecycle -----------------------------------------------------------
+    def delete(self, step):
+        shutil.rmtree(self.path(step), ignore_errors=True)
+
+    def gc_orphans(self):
+        """Remove ``.tmp-*`` residue of crashed or failed writes; never
+        a temp dir a live writer still owns — in-process writers via the
+        shared active set (one set for ALL stores, so two managers on
+        one directory cannot reap each other's in-flight save), writers
+        in OTHER processes on this host via the pid embedded in the
+        temp name.  Returns the removed paths."""
+        with _ACTIVE_LOCK:
+            active = set(_ACTIVE_TMP)
+        removed = []
+        for name in os.listdir(self.root):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self.root, name)
+            if path in active or self._writer_alive(name):
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+        if removed:
+            logging.info("checkpoint: collected %d orphan temp dir(s) in %s",
+                         len(removed), self.root)
+        return removed
+
+    @staticmethod
+    def _writer_alive(tmp_name):
+        """Does the process that owns this temp dir still run (on this
+        host)?  Our own pid does not count — our live writes are covered
+        exactly by the active set, so anything of ours NOT in it is a
+        failed write awaiting collection."""
+        m = _TMP_RE.match(tmp_name)
+        if not m:
+            return False   # unrecognized residue: collect
+        pid = int(m.group("pid"))
+        if pid == os.getpid():
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except OSError:
+            pass   # EPERM: exists under another uid
+        return True
+
+    def total_bytes(self, step):
+        """Committed payload size of one checkpoint per its manifest."""
+        manifest = self.manifest(step)
+        return (sum(s["bytes"] for s in manifest.get("shards", {}).values())
+                + sum(b["bytes"] for b in manifest.get("blobs", {}).values()))
+
+
+class RetentionPolicy:
+    """keep-last-N / keep-every-K pruning over COMPLETE checkpoints.
+
+    ``keep_last`` most recent steps always survive; additionally any
+    step divisible by ``keep_every`` (when > 0) is pinned forever — the
+    classic "hourly forever, every-step for the last hour" ladder.  The
+    newest complete checkpoint is unconditionally exempt: retention can
+    never race a writer into leaving zero restorable state.
+    ``keep_last <= 0`` disables pruning entirely."""
+
+    def __init__(self, keep_last=5, keep_every=0):
+        self.keep_last = int(keep_last)
+        self.keep_every = int(keep_every)
+
+    def victims(self, steps):
+        """Which of ``steps`` (sorted ascending) to delete."""
+        if not steps or self.keep_last <= 0:
+            return []
+        keep = set(steps[-self.keep_last:])
+        keep.add(steps[-1])
+        if self.keep_every > 0:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        return [s for s in steps if s not in keep]
+
+    def apply(self, store):
+        """Prune ``store`` in place; returns the deleted steps."""
+        victims = self.victims(store.steps())
+        for step in victims:
+            store.delete(step)
+        return victims

@@ -151,6 +151,31 @@ register_env("MXNET_GLUON_REPO", str, None,
              "override source for gluon model-zoo checkpoints: a local "
              "staging directory or an apache-mxnet-style base URL "
              "(gluon/model_zoo/model_store.py)")
+register_env("MXNET_CKPT_DIR", str, None,
+             "checkpoint directory; when set, fit() checkpoints into it "
+             "via a CheckpointManager and Module.save_checkpoint mirrors "
+             "saves there (docs/faq/checkpoint.md)")
+register_env("MXNET_CKPT_PERIOD_STEPS", int, 0,
+             "save a checkpoint every N training batches during fit() "
+             "(0 disables step-periodic saves)")
+register_env("MXNET_CKPT_PERIOD_EPOCHS", int, 1,
+             "save a checkpoint every N epochs at epoch end during "
+             "fit() (0 disables epoch-periodic saves)")
+register_env("MXNET_CKPT_KEEP_LAST", int, 5,
+             "retention: keep this many most-recent complete "
+             "checkpoints (<= 0 keeps everything)")
+register_env("MXNET_CKPT_KEEP_EVERY", int, 0,
+             "retention: additionally pin every checkpoint whose step "
+             "id divides by K, forever (0 disables)")
+register_env("MXNET_CKPT_ASYNC", bool, True,
+             "serialize checkpoints on a background worker (at most one "
+             "in flight); 0 saves synchronously on the training thread")
+register_env("MXNET_CKPT_ON_SIGTERM", bool, True,
+             "during fit(), SIGTERM triggers one final synchronous "
+             "checkpoint before exiting (preemption grace-window save)")
+register_env("MXNET_CKPT_WATCH_INTERVAL_S", float, 10.0,
+             "poll period of serving ModelRegistry.watch_checkpoints "
+             "for newly committed checkpoint versions")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

@@ -111,7 +111,11 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
     Format-compatible with the reference: params file is an NDArray
     container with 'arg:'/'aux:' prefixed names (src/ndarray/ndarray.cc
-    V2 stream)."""
+    V2 stream).  Both files commit atomically (write-to-temp +
+    ``os.replace`` inside ``Symbol.save``/``nd.save``), so a crash
+    mid-save cannot corrupt an existing checkpoint in place; for full
+    resume state (optimizer/RNG/iterator) use ``mxnet_tpu.checkpoint``
+    (docs/faq/checkpoint.md)."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}

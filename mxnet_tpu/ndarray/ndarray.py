@@ -836,7 +836,12 @@ def _read_ndarray(f):
 
 
 def save(fname, data):
-    """Save dict/list of NDArrays (reference: mx.nd.save, c_api.cc:261)."""
+    """Save dict/list of NDArrays (reference: mx.nd.save, c_api.cc:261).
+
+    Crash-safe: the container is written to a hidden temp sibling and
+    committed with one ``os.replace`` — a killed writer leaves either
+    the previous complete file or the new one, never a truncated
+    container at the target name."""
     if isinstance(data, NDArray):
         data = [data]
     names, arrays = [], []
@@ -846,7 +851,8 @@ def save(fname, data):
             arrays.append(v)
     else:
         arrays = list(data)
-    with open(fname, "wb") as f:
+    from .._atomic_io import atomic_writer
+    with atomic_writer(fname) as f:
         f.write(struct.pack("<Q", 0x112))  # container magic (kMXAPINDArrayListMagic)
         f.write(struct.pack("<Q", 0))
         f.write(struct.pack("<Q", len(arrays)))

@@ -25,6 +25,21 @@ def seed(seed_state=0, ctx="all"):
     _STATE["count"] = 0
 
 
+def get_state():
+    """The full RNG chain position as a plain dict — because the chain
+    is host-side ``(seed, count)``, this pair IS the complete generator
+    state (checkpoint capture serializes it; no device read needed)."""
+    return {"seed": int(_STATE["seed"]), "count": int(_STATE["count"])}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot: every subsequent
+    ``next_key`` draw equals the uninterrupted run's draw (checkpoint
+    resume's bit-identical-RNG contract)."""
+    _STATE["seed"] = int(state["seed"])
+    _STATE["count"] = int(state["count"])
+
+
 def next_key():
     """A fresh subkey off the global chain (runtime internal).
 

@@ -11,10 +11,11 @@ from .bucketing import pick_bucket, shape_buckets  # noqa: F401
 from .cache import ExecutorCache  # noqa: F401
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
                      QueueFull, ServerClosed, ServingError)
-from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .registry import (CheckpointWatcher, ModelRegistry,  # noqa: F401
+                       ModelVersion)
 from .server import InferenceFuture, ModelServer  # noqa: F401
 
 __all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
            "InferenceFuture", "ServingError", "ModelNotFound", "QueueFull",
            "DeadlineExceeded", "ServerClosed", "BadRequest",
-           "shape_buckets", "pick_bucket"]
+           "CheckpointWatcher", "shape_buckets", "pick_bucket"]

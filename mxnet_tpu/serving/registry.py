@@ -14,11 +14,12 @@ version, ``set_default()``, optionally ``unload()`` the old one.
 """
 from __future__ import annotations
 
+import logging
 import threading
 
 from .errors import BadRequest, ModelNotFound
 
-__all__ = ["ModelVersion", "ModelRegistry"]
+__all__ = ["ModelVersion", "ModelRegistry", "CheckpointWatcher"]
 
 
 class ModelVersion:
@@ -145,3 +146,126 @@ class ModelRegistry:
             return {name: {"versions": sorted(vs),
                            "default": self._default[name]}
                     for name, vs in self._models.items()}
+
+    # -- checkpoint hot-swap -------------------------------------------------
+    def watch_checkpoints(self, directory, name, poll_interval=None,
+                          set_default=True, start=True):
+        """Hot-swap committed training checkpoints into this registry —
+        the train→serve loop closed: as ``checkpoint.CheckpointManager``
+        commits new versions into ``directory``, a watcher registers
+        each (version = checkpoint step id) and promotes it to the
+        serving default.  Returns the :class:`CheckpointWatcher`; call
+        ``stop()`` (or use it as a context manager) to end the watch,
+        ``poll_once()`` to drive it manually (``start=False``)."""
+        return CheckpointWatcher(self, directory, name,
+                                 poll_interval=poll_interval,
+                                 set_default=set_default, start=start)
+
+
+class CheckpointWatcher:
+    """Background poller binding a checkpoint directory to a registry
+    name.
+
+    Relies on the checkpoint store's commit atomicity: a directory that
+    ``latest()`` resolves is complete by construction, so the watcher
+    can read it with no coordination with the (possibly remote) trainer
+    process.  Checkpoints without a symbol or bound input shapes (saved
+    from an unbound module) are skipped with a warning."""
+
+    def __init__(self, registry, directory, name, poll_interval=None,
+                 set_default=True, start=True):
+        from ..checkpoint import CheckpointStore
+        if poll_interval is None:
+            from .. import config as _config
+            poll_interval = _config.get("MXNET_CKPT_WATCH_INTERVAL_S")
+        self.registry = registry
+        self.name = name
+        self.poll_interval = float(poll_interval)
+        self.set_default = bool(set_default)
+        self._store = CheckpointStore(directory)
+        self._last_step = 0
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="ckpt-watch-%s" % name, daemon=True)
+            self._thread.start()
+
+    def poll_once(self):
+        """Check for a newer complete checkpoint; load + register +
+        (optionally) promote it.  Returns the newly served version, or
+        None when nothing new (or the newest checkpoint is unservable)."""
+        from ..checkpoint import IntegrityError, TrainState
+        from .. import ndarray as nd
+        from ..symbol import load_json
+        step = self._store.latest()
+        if step is None or step <= self._last_step:
+            return None
+        try:
+            manifest, arrays, blobs = self._store.read(step, verify=True)
+        except IntegrityError as exc:
+            # permanent (bit rot): one attempt per committed version
+            self._last_step = step
+            logging.warning("checkpoint watcher %r: step %d corrupt (%s); "
+                            "skipped", self.name, step, exc)
+            return None
+        except (OSError, ValueError) as exc:
+            # transient (filesystem hiccup): leave _last_step so the
+            # NEXT poll retries — the final checkpoint of a finished
+            # run must not be skippable forever by one bad read
+            logging.warning("checkpoint watcher %r: step %d unreadable "
+                            "(%s); will retry", self.name, step, exc)
+            return None
+        self._last_step = step
+        state = TrainState.from_payload(arrays, blobs,
+                                        manifest.get("meta", {}))
+        input_shapes = state.meta.get("input_shapes")
+        if not state.symbol_json or not input_shapes:
+            logging.warning(
+                "checkpoint watcher %r: step %d lacks symbol/input shapes "
+                "(saved from an unbound module?); not servable",
+                self.name, step)
+            return None
+        symbol = load_json(state.symbol_json)
+        args = {k: nd.array(v) for k, v in state.arg_params.items()}
+        auxs = {k: nd.array(v) for k, v in state.aux_params.items()}
+        try:
+            self.registry.add(self.name, symbol, args, auxs,
+                              {k: tuple(v) for k, v in input_shapes.items()},
+                              version=step)
+        except BadRequest:
+            pass   # another watcher won the race; still promote below
+        if self.set_default:
+            self.registry.set_default(self.name, step)
+        logging.info("checkpoint watcher %r: now serving version %d",
+                     self.name, step)
+        return step
+
+    def _loop(self):
+        from .. import engine
+        while not self._stop.is_set():
+            # errors are logged, never fatal: the watcher must outlive a
+            # transiently unreadable filesystem
+            with engine.worker_scope(deliver=self._log_error):
+                self.poll_once()
+            self._stop.wait(self.poll_interval)
+
+    def _log_error(self, exc):
+        logging.warning("checkpoint watcher %r: poll failed (%s: %s)",
+                        self.name, type(exc).__name__, exc)
+        return True
+
+    @property
+    def last_step(self):
+        return self._last_step
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.stop()

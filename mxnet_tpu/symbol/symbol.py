@@ -470,8 +470,10 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic (temp + os.replace): -symbol.json is half of a legacy
+        # checkpoint pair and must never exist half-written
+        from .._atomic_io import atomic_write
+        atomic_write(fname, self.tojson(), mode="w")
 
     def debug_str(self):
         lines = []

@@ -1,0 +1,868 @@
+"""Checkpoint subsystem tests.
+
+Covers the ISSUE-5 acceptance surface: atomic commit (a crash mid-write
+can never yield a readable-but-corrupt checkpoint), integrity
+verification with fallback, retention, async at-most-one-in-flight
+saves that do not stall the caller, SIGTERM preemption saves,
+bit-identical full-state resume (params, optimizer slots, lr schedule,
+RNG, iterator position), the fit()/callback/serving integration hooks,
+and the telemetry round trip.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint, sym
+from mxnet_tpu.checkpoint import (AsyncCheckpointer, CheckpointManager,
+                                  CheckpointStore, IntegrityError,
+                                  RetentionPolicy, TrainState,
+                                  write_checkpoint)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _payload(val=0.0):
+    """Store-level arrays (already carrying the ``arg/`` namespace)."""
+    return {"arg/w": np.full((4, 3), val, np.float32),
+            "arg/b": np.arange(3, dtype=np.float32)}
+
+
+def _params(val=0.0):
+    """TrainState-level arg params (unprefixed names)."""
+    return {"w": np.full((4, 3), val, np.float32),
+            "b": np.arange(3, dtype=np.float32)}
+
+
+def _blob_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4).astype(np.float32)
+    y = (X.sum(axis=1) > 2.0).astype(np.float32)
+    return X, y
+
+
+def _net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fresh_module(net, it, np_seed):
+    """Bind + init a module deterministically from ``np_seed``."""
+    np.random.seed(np_seed)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "lr_scheduler": mx.lr_scheduler.FactorScheduler(
+                              step=2, factor=0.5)})
+    return mod
+
+
+def _train_steps(mod, it, n):
+    done = 0
+    while done < n:
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            done += 1
+            if done == n:
+                return
+        it.reset()
+
+
+# ---------------------------------------------------------------------------
+# store: atomic layout, integrity, retention
+# ---------------------------------------------------------------------------
+def test_store_roundtrip(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.write(3, _payload(1.5), blobs={"optimizer": b"opaque"},
+                meta={"epoch": 2, "nbatch": 7})
+    assert store.steps() == [3]
+    assert store.latest() == 3
+    manifest, arrays, blobs = store.read(3)
+    assert manifest["meta"] == {"epoch": 2, "nbatch": 7}
+    assert blobs["optimizer"] == b"opaque"
+    np.testing.assert_array_equal(arrays["arg/w"], _payload(1.5)["arg/w"])
+    assert arrays["arg/b"].dtype == np.float32
+    # manifest carries size + sha for every shard and blob
+    for spec in manifest["shards"].values():
+        assert spec["bytes"] > 0 and len(spec["sha256"]) == 64
+    assert store.total_bytes(3) == sum(
+        s["bytes"] for s in manifest["shards"].values()) + 6
+
+
+def test_store_rejects_duplicate_step(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.write(1, _payload())
+    with pytest.raises(checkpoint.CheckpointError):
+        store.write(1, _payload())
+
+
+def test_latest_ignores_partials_and_garbage(tmp_path):
+    store = CheckpointStore(tmp_path)
+    store.write(5, _payload())
+    # a crashed writer's temp dir: shards but no committed directory
+    orphan = tmp_path / ".tmp-ckpt-00000009-999-dead"
+    orphan.mkdir()
+    (orphan / "arg.w.bin").write_bytes(b"\x00" * 16)
+    # a committed-looking dir with an unparseable manifest
+    broken = tmp_path / "ckpt-00000007"
+    broken.mkdir()
+    (broken / "manifest.json").write_text("{not json")
+    assert store.steps() == [5]
+    assert store.latest() == 5
+
+
+def test_corrupt_shard_is_integrity_error(tmp_path):
+    store = CheckpointStore(tmp_path)
+    path = store.write(1, _payload(2.0))
+    shard = os.path.join(path, "arg.w.bin")
+    data = bytearray(open(shard, "rb").read())
+    data[0] ^= 0xFF
+    with open(shard, "wb") as f:
+        f.write(data)
+    with pytest.raises(IntegrityError):
+        store.read(1)
+    # unverified read still works (forensics path)
+    _, arrays, _ = store.read(1, verify=False)
+    assert arrays["arg/w"].shape == (4, 3)
+
+
+def test_crash_mid_commit_preserves_previous(tmp_path, monkeypatch):
+    """The acceptance fault injection: kill the writer at the commit
+    rename — no partially-written checkpoint is ever selected by
+    latest(), the orphan temp dir is garbage-collected, and the next
+    save succeeds."""
+    store = CheckpointStore(tmp_path)
+    store.write(1, _payload(1.0))
+
+    from mxnet_tpu.checkpoint import store as store_mod
+    real_replace = os.replace
+
+    def _boom(src, dst):
+        raise OSError("simulated crash at commit")
+
+    monkeypatch.setattr(store_mod.os, "replace", _boom)
+    with pytest.raises(OSError):
+        store.write(2, _payload(2.0))
+    monkeypatch.setattr(store_mod.os, "replace", real_replace)
+
+    # the failed write is invisible; its temp dir is orphaned on disk
+    assert store.latest() == 1
+    orphans = [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
+    assert len(orphans) == 1
+    removed = store.gc_orphans()
+    assert len(removed) == 1
+    assert [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")] == []
+
+    # the store recovers: same step id can commit now
+    store.write(2, _payload(2.0))
+    assert store.steps() == [1, 2]
+    _, arrays, _ = store.read(2)
+    np.testing.assert_array_equal(arrays["arg/w"], _payload(2.0)["arg/w"])
+
+
+def test_gc_skips_live_writers(tmp_path):
+    from mxnet_tpu.checkpoint import store as store_mod
+    store = CheckpointStore(tmp_path)
+    # our own pid in the name: protection comes from the active set only
+    name = ".tmp-ckpt-00000003-%d-abcd1234" % os.getpid()
+    fake_tmp = str(tmp_path / name)
+    os.makedirs(fake_tmp)
+    with store_mod._ACTIVE_LOCK:
+        store_mod._ACTIVE_TMP.add(fake_tmp)
+    try:
+        assert store.gc_orphans() == []
+        assert os.path.isdir(fake_tmp)
+    finally:
+        with store_mod._ACTIVE_LOCK:
+            store_mod._ACTIVE_TMP.discard(fake_tmp)
+    assert store.gc_orphans() == [fake_tmp]
+    # the active set is process-global: a SECOND store over the same
+    # directory must not reap another store's in-flight write either
+    alive = str(tmp_path / (".tmp-ckpt-00000004-%d-ff00ff00" % os.getpid()))
+    os.makedirs(alive)
+    with store_mod._ACTIVE_LOCK:
+        store_mod._ACTIVE_TMP.add(alive)
+    try:
+        assert CheckpointStore(tmp_path).gc_orphans() == []
+        assert os.path.isdir(alive)
+    finally:
+        with store_mod._ACTIVE_LOCK:
+            store_mod._ACTIVE_TMP.discard(alive)
+
+
+def test_gc_skips_other_live_process(tmp_path):
+    """A temp dir owned by a RUNNING foreign process (pid embedded in
+    the name) survives gc; a dead pid's residue is collected."""
+    store = CheckpointStore(tmp_path)
+    live = tmp_path / ".tmp-ckpt-00000001-1-aaaaaaaa"       # pid 1: init
+    live.mkdir()
+    dead = tmp_path / ".tmp-ckpt-00000002-999999-bbbbbbbb"  # unlikely pid
+    dead.mkdir()
+    removed = store.gc_orphans()
+    assert str(dead) in removed
+    assert os.path.isdir(live)
+    os.rmdir(live)
+
+
+def test_shard_name_collision_is_disambiguated(tmp_path):
+    """'fc1/weight' and 'fc1.weight' flatten to the same filename; the
+    writer must keep both shards distinct (silent overwrite would make
+    the checkpoint fail verification)."""
+    store = CheckpointStore(tmp_path)
+    a = np.full((2, 2), 1.0, np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    store.write(1, {"arg/fc1/weight": a, "arg/fc1.weight": b})
+    manifest, arrays, _ = store.read(1)   # read verifies every sha256
+    files = {s["file"] for s in manifest["shards"].values()}
+    assert len(files) == 2
+    np.testing.assert_array_equal(arrays["arg/fc1/weight"], a)
+    np.testing.assert_array_equal(arrays["arg/fc1.weight"], b)
+
+
+def test_retention_policy(tmp_path):
+    policy = RetentionPolicy(keep_last=2, keep_every=4)
+    assert policy.victims([1, 2, 3, 4, 5, 6, 7, 8]) == [1, 2, 3, 5, 6]
+    assert policy.victims([]) == []
+    # keep_last <= 0 disables pruning
+    assert RetentionPolicy(keep_last=0).victims([1, 2, 3]) == []
+    store = CheckpointStore(tmp_path)
+    for step in range(1, 9):
+        store.write(step, _payload(step))
+    assert policy.apply(store) == [1, 2, 3, 5, 6]
+    assert store.steps() == [4, 7, 8]
+
+
+def test_retention_never_deletes_newest():
+    # pathological config (keep_last smaller than 1 is disabled; 1 keeps
+    # exactly the newest) — the newest complete step always survives
+    assert RetentionPolicy(keep_last=1).victims([3, 9]) == [3]
+    assert 9 not in RetentionPolicy(keep_last=1, keep_every=2).victims(
+        [3, 9])
+
+
+def test_bfloat16_shard_roundtrip(tmp_path):
+    import ml_dtypes
+    store = CheckpointStore(tmp_path)
+    arr = np.arange(6, dtype=ml_dtypes.bfloat16).reshape(2, 3)
+    store.write(1, {"arg/w": arr})
+    _, arrays, _ = store.read(1)
+    assert arrays["arg/w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        arrays["arg/w"].astype(np.float32), arr.astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# async checkpointer
+# ---------------------------------------------------------------------------
+def test_async_save_does_not_block_caller(tmp_path, monkeypatch):
+    """The acceptance overlap property, made deterministic: with a slow
+    serializer the async save() returns immediately while the legacy
+    synchronous path stalls for the full write."""
+    store = CheckpointStore(tmp_path)
+    real_write = CheckpointStore.write
+
+    def slow_write(self, step, arrays, blobs=None, meta=None):
+        time.sleep(0.25)
+        return real_write(self, step, arrays, blobs=blobs, meta=meta)
+
+    monkeypatch.setattr(CheckpointStore, "write", slow_write)
+    ckpt = AsyncCheckpointer(store)
+    t0 = time.perf_counter()
+    assert ckpt.save(1, _payload()) is True
+    async_latency = time.perf_counter() - t0
+    assert async_latency < 0.15, "async save stalled the caller: %.3fs" \
+        % async_latency
+    assert ckpt.wait(timeout=10.0)
+    assert store.latest() == 1
+
+    t0 = time.perf_counter()
+    write_checkpoint(store, 2, _payload())
+    sync_latency = time.perf_counter() - t0
+    assert sync_latency >= 0.25, "sync path should pay the full write"
+
+
+def test_async_at_most_one_in_flight(tmp_path, monkeypatch):
+    store = CheckpointStore(tmp_path)
+    gate = threading.Event()
+    real_write = CheckpointStore.write
+
+    def gated_write(self, step, arrays, blobs=None, meta=None):
+        gate.wait(10.0)
+        return real_write(self, step, arrays, blobs=blobs, meta=meta)
+
+    monkeypatch.setattr(CheckpointStore, "write", gated_write)
+    ckpt = AsyncCheckpointer(store)
+    assert ckpt.save(1, _payload()) is True
+    assert ckpt.in_flight
+    # a second request while one runs is refused, not queued
+    assert ckpt.save(2, _payload()) is False
+    gate.set()
+    assert ckpt.wait(timeout=10.0)
+    assert store.steps() == [1]
+    # writer free again: next save accepted
+    assert ckpt.save(3, _payload()) is True
+    assert ckpt.wait(timeout=10.0)
+    assert store.steps() == [1, 3]
+
+
+def test_async_failure_is_contained(tmp_path, monkeypatch):
+    """A failed async save surfaces on the checkpointer's error surface
+    and does NOT poison global sync points (worker_scope delivery)."""
+    from mxnet_tpu import engine, nd
+    store = CheckpointStore(tmp_path)
+
+    def bad_write(self, *a, **k):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(CheckpointStore, "write", bad_write)
+    ckpt = AsyncCheckpointer(store)
+    assert ckpt.save(1, _payload()) is True
+    assert ckpt.wait(timeout=10.0)
+    assert isinstance(ckpt.last_error(), OSError)
+    # training sync points stay healthy
+    engine.check_raise()
+    nd.array([1.0]).asnumpy()
+    ckpt.clear_error()
+    assert ckpt.last_error() is None
+
+
+# ---------------------------------------------------------------------------
+# manager: restore fallback, monotonic ids
+# ---------------------------------------------------------------------------
+def test_manager_restore_falls_back_past_corruption(tmp_path):
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    state = TrainState(_params(1.0), {}, {"epoch": 1})
+    assert mgr.save_state(state)
+    assert mgr.save_state(TrainState(_params(9.0), {}, {"epoch": 2}))
+    # corrupt the newest committed checkpoint's shard
+    shard = os.path.join(mgr.store.path(2), "arg.w.bin")
+    with open(shard, "r+b") as f:
+        f.write(b"\xde\xad")
+    restored = mgr.restore_latest()
+    assert restored is not None and restored.epoch == 1
+    np.testing.assert_array_equal(restored.arg_params["w"],
+                                  _params(1.0)["w"])
+
+
+def test_manager_step_ids_survive_retention_and_restart(tmp_path):
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False,
+                            keep_last=1)
+    for epoch in range(3):
+        mgr.save_state(TrainState(_params(epoch), {}, {"epoch": epoch}))
+    assert mgr.steps() == [3]     # keep_last=1 pruned 1 and 2
+    # a new manager over the same dir continues past the high-water mark
+    mgr2 = CheckpointManager(directory=str(tmp_path), async_save=False,
+                             keep_last=1)
+    mgr2.save_state(TrainState(_params(7), {}, {"epoch": 7}))
+    assert mgr2.latest_step() == 4
+
+
+def test_two_managers_same_directory_do_not_collide(tmp_path):
+    """Explicit manager + process-default manager over one directory
+    (the Module.save_checkpoint mirror path): step ids must not be
+    reused even though each manager tracks its own high-water mark."""
+    mgr_a = CheckpointManager(directory=str(tmp_path), async_save=False)
+    mgr_b = CheckpointManager(directory=str(tmp_path), async_save=False)
+    assert mgr_a.save_state(TrainState(_params(1), {}, {"epoch": 1}))
+    assert mgr_b.save_state(TrainState(_params(2), {}, {"epoch": 2}))
+    assert mgr_a.save_state(TrainState(_params(3), {}, {"epoch": 3}))
+    assert mgr_a.steps() == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# full-state resume — the end-to-end acceptance
+# ---------------------------------------------------------------------------
+def test_resume_is_bit_identical():
+    """Train 6 steps straight vs train 3 → checkpoint → "crash" →
+    restore into a fresh Module → train 3 more: params, optimizer
+    slots, lr-scheduler position, and the next RNG draw must be
+    numerically IDENTICAL (not approximate)."""
+    X, y = _blob_data()
+    net = _net()
+
+    # --- uninterrupted run -------------------------------------------------
+    mx.random.seed(42)
+    it_a = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_a = _fresh_module(net, it_a, np_seed=11)
+    _train_steps(mod_a, it_a, 6)
+    args_a, _ = mod_a.get_params()
+    rng_a = mx.random.next_key_data()
+    lr_a = mod_a._optimizer._get_lr(0)
+    states_a = mod_a._updater.states
+
+    # --- interrupted run ---------------------------------------------------
+    mx.random.seed(42)
+    it_b = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_b = _fresh_module(net, it_b, np_seed=11)
+    _train_steps(mod_b, it_b, 3)
+    state = TrainState.capture(mod_b, epoch=0, nbatch=3, train_data=it_b)
+
+    # "crash": a brand-new module with DIFFERENT init — restore must
+    # overwrite every piece of state
+    mx.random.seed(999)
+    it_c = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_c = _fresh_module(net, it_c, np_seed=77)
+    state.restore_into(mod_c, train_data=it_c)
+    assert it_c.cursor == it_b.cursor
+    _train_steps(mod_c, it_c, 3)
+
+    args_c, _ = mod_c.get_params()
+    for name in args_a:
+        np.testing.assert_array_equal(
+            args_a[name].asnumpy(), args_c[name].asnumpy(),
+            err_msg="param %s diverged after resume" % name)
+    # optimizer slot arrays (momentum) identical
+    for idx, st_a in states_a.items():
+        a = st_a.asnumpy() if hasattr(st_a, "asnumpy") else st_a
+        c = mod_c._updater.states[idx]
+        c = c.asnumpy() if hasattr(c, "asnumpy") else c
+        if a is None:
+            assert c is None
+        else:
+            np.testing.assert_array_equal(a, c)
+    # lr schedule position and the RNG chain continue identically
+    assert mod_c._optimizer.num_update == mod_a._optimizer.num_update
+    assert mod_c._optimizer._get_lr(0) == lr_a
+    np.testing.assert_array_equal(rng_a, mx.random.next_key_data())
+
+
+def test_resume_restores_shuffle_order():
+    X, y = _blob_data()
+    np.random.seed(5)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+    for _ in range(3):
+        next(it)
+    meta, idx = checkpoint.capture_iter_state(it)
+    assert meta["cursor"] == it.cursor
+    np.random.seed(123)   # a fresh process would reshuffle differently
+    it2 = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+    checkpoint.restore_iter_state(it2, meta, idx)
+    np.testing.assert_array_equal(it.idx, it2.idx)
+    b1, b2 = next(it), next(it2)
+    np.testing.assert_array_equal(b1.data[0].asnumpy(),
+                                  b2.data[0].asnumpy())
+
+
+def test_resume_bit_identical_across_shuffled_epoch_boundary():
+    """The epoch-boundary hazard: NDArrayIter(shuffle=True) reshuffles
+    from the GLOBAL numpy generator at every reset(), so resume must
+    restore that generator too or the next epoch's batch order — and
+    every parameter after it — silently diverges."""
+    X, y = _blob_data()
+    net = _net()
+
+    def run(total, resume_at=None, ckpt=None):
+        np.random.seed(21); mx.random.seed(21)
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+        mod = _fresh_module(net, it, np_seed=21)
+        if resume_at is None:
+            _train_steps(mod, it, total)
+            return mod
+        _train_steps(mod, it, resume_at)
+        ckpt.append(TrainState.capture(mod, nbatch=resume_at,
+                                       train_data=it))
+        return mod
+
+    mod_a = run(12)   # crosses the epoch-1 reshuffle at step 8
+    args_a, _ = mod_a.get_params()
+
+    ckpt = []
+    run(None, resume_at=5, ckpt=ckpt)
+    np.random.seed(777); mx.random.seed(777)   # the "fresh process"
+    it_c = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=True)
+    mod_c = _fresh_module(net, it_c, np_seed=55)
+    ckpt[0].restore_into(mod_c, train_data=it_c)
+    _train_steps(mod_c, it_c, 7)   # steps 5..11, reshuffle at 8
+
+    args_c, _ = mod_c.get_params()
+    for name in args_a:
+        np.testing.assert_array_equal(
+            args_a[name].asnumpy(), args_c[name].asnumpy(),
+            err_msg="param %s diverged across shuffled epoch boundary"
+            % name)
+
+
+def test_rng_state_roundtrip():
+    mx.random.seed(7)
+    mx.random.next_key_data()
+    snap = mx.random.get_state()
+    a = mx.random.next_key_data()
+    mx.random.set_state(snap)
+    np.testing.assert_array_equal(a, mx.random.next_key_data())
+
+
+# ---------------------------------------------------------------------------
+# fit() integration + SIGTERM
+# ---------------------------------------------------------------------------
+def test_fit_periodic_and_final_saves(tmp_path):
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False,
+                            period_steps=3, period_epochs=1)
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.05},
+            checkpoint_manager=mgr)
+    steps = mgr.steps()
+    # 8 batches/epoch: step saves at nbatch 3,6 per epoch + 2 epoch-end
+    assert len(steps) >= 3
+    final = mgr.restore_latest()
+    assert final.epoch == 2 and final.nbatch == 0
+    assert final.meta["input_shapes"] == {"data": [8, 4]}
+
+
+def test_fit_periodic_save_cursor_excludes_prefetched_batch(tmp_path):
+    """The fit loop prefetches one batch ahead; the periodic save must
+    capture the iterator BEFORE that advance, or resume would skip the
+    prefetched-but-untrained batch."""
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False,
+                            period_steps=3, period_epochs=0, keep_last=0)
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.05},
+            checkpoint_manager=mgr)
+    manifest = mgr.store.manifest(mgr.steps()[0])
+    assert manifest["meta"]["nbatch"] == 3
+    # 3 batches trained -> cursor sits AT batch index 2 (= 2 * 8); the
+    # resume-side next() advances to batch 3
+    assert manifest["meta"]["iter"]["cursor"] == 16
+
+
+def test_fit_crash_restore_continue_matches_uninterrupted(tmp_path):
+    """fit K batches → crash → restore into a fresh module → finish the
+    epoch manually: params equal the uninterrupted fit's params."""
+    X, y = _blob_data()
+    net = _net()
+
+    def run_fit(mod, it, mgr=None, crash_at=None):
+        def cb(param):
+            if crash_at is not None and param.nbatch == crash_at:
+                raise RuntimeError("simulated crash")
+        kw = {"optimizer_params": {"learning_rate": 0.1, "momentum": 0.9},
+              "initializer": mx.init.Xavier(), "num_epoch": 1,
+              "batch_end_callback": cb}
+        if mgr is not None:
+            kw["checkpoint_manager"] = mgr
+        mod.fit(it, **kw)
+
+    # run A: one uninterrupted epoch (8 batches)
+    np.random.seed(13); mx.random.seed(13)
+    it_a = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_a = mx.mod.Module(net, context=mx.cpu())
+    run_fit(mod_a, it_a)
+    args_a, _ = mod_a.get_params()
+
+    # run B: crash right after the periodic save at nbatch=4
+    np.random.seed(13); mx.random.seed(13)
+    it_b = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_b = mx.mod.Module(net, context=mx.cpu())
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False,
+                            period_steps=4, period_epochs=0)
+    with pytest.raises(RuntimeError):
+        run_fit(mod_b, it_b, mgr=mgr, crash_at=3)
+
+    # replacement job: fresh module, different init, restore, finish
+    np.random.seed(99); mx.random.seed(99)
+    it_c = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod_c = mx.mod.Module(net, context=mx.cpu())
+    mod_c.bind(data_shapes=it_c.provide_data,
+               label_shapes=it_c.provide_label, for_training=True)
+    mod_c.init_params(mx.init.Xavier())
+    mod_c.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9})
+    state = mgr.restore_latest(mod_c, train_data=it_c)
+    assert state.nbatch == 4
+    _train_steps(mod_c, it_c, 4)   # batches 4..7 of the epoch
+
+    args_c, _ = mod_c.get_params()
+    for name in args_a:
+        np.testing.assert_array_equal(
+            args_a[name].asnumpy(), args_c[name].asnumpy(),
+            err_msg="param %s diverged after crash-resume" % name)
+
+
+def test_fit_env_knob_builds_default_manager(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_CKPT_ASYNC", "0")
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.05})
+    mgr = checkpoint.default_manager()
+    assert mgr is not None and mgr.latest_step() is not None
+
+
+def test_sigterm_triggers_final_save(tmp_path):
+    """Preemption drill: SIGTERM mid-fit saves the current position
+    synchronously and exits 143."""
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False,
+                            period_steps=0, period_epochs=0)
+
+    def preempt(param):
+        if param.epoch == 0 and param.nbatch == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    with pytest.raises(SystemExit) as exc_info:
+        mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.05},
+                batch_end_callback=preempt, checkpoint_manager=mgr)
+    assert exc_info.value.code == 143
+    # the previous SIGTERM disposition is restored on scope exit
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+    state = mgr.restore_latest()
+    assert state is not None
+    # the handler only sets a flag; the loop saves at the END of the
+    # iteration that observed it — deterministically after batch 2
+    # trained, i.e. position (epoch 0, nbatch 3)
+    assert (state.epoch, state.nbatch) == (0, 3)
+    # the prefetched-but-untrained batch 3 was rewound out of the
+    # captured cursor: resume re-trains it instead of skipping it
+    assert state.meta["iter"]["cursor"] == 16
+
+
+def test_sigterm_scope_noop_off_main_thread(tmp_path):
+    flags = []
+    def run():
+        with checkpoint.sigterm_flag_scope() as flag:
+            flags.append(flag)
+    t = threading.Thread(target=run)
+    t.start(); t.join()
+    assert flags and flags[0] == {"signaled": False}
+
+
+def test_sigterm_flag_scope_sets_flag_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    with checkpoint.sigterm_flag_scope() as flag:
+        assert flag["signaled"] is False
+        os.kill(os.getpid(), signal.SIGTERM)
+        # the handler only flips the flag — no save, no exit, no locks
+        assert flag["signaled"] is True
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+# ---------------------------------------------------------------------------
+# callback.module_checkpoint — period from last SUCCESSFUL save
+# ---------------------------------------------------------------------------
+class _FlakyModule:
+    def __init__(self, fail_times=1):
+        self.fail_times = fail_times
+        self.saves = []
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        manager=None):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise OSError("transient save failure")
+        self.saves.append(epoch)
+
+
+def test_module_checkpoint_retries_after_failure():
+    mod = _FlakyModule(fail_times=1)
+    cb = mx.callback.module_checkpoint(mod, "prefix", period=2)
+    cb(1)            # epoch 2 due — fails (swallowed, logged)
+    assert mod.saves == []
+    cb(2)            # old modulo schedule would wait until epoch 4
+    assert mod.saves == [3]
+    cb(3)            # only 1 epoch since last success: not due
+    assert mod.saves == [3]
+    cb(4)
+    assert mod.saves == [3, 5]
+
+
+def test_module_checkpoint_with_manager(tmp_path):
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.05},
+            epoch_end_callback=mx.callback.module_checkpoint(
+                mod, period=1, manager=mgr))
+    assert len(mgr.steps()) == 2
+
+
+def test_module_checkpoint_requires_target():
+    with pytest.raises(ValueError):
+        mx.callback.module_checkpoint(_FlakyModule())
+
+
+# ---------------------------------------------------------------------------
+# serving hot-swap
+# ---------------------------------------------------------------------------
+def test_watch_checkpoints_hot_swap(tmp_path):
+    from mxnet_tpu import serving
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = _fresh_module(_net(), it, np_seed=3)
+    _train_steps(mod, it, 2)
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    mgr.save_module(mod, epoch=0, nbatch=2)
+
+    registry = serving.ModelRegistry()
+    with registry.watch_checkpoints(str(tmp_path), "clf",
+                                    start=False) as watcher:
+        assert watcher.poll_once() == 1
+        assert registry.describe() == {"clf": {"versions": [1],
+                                               "default": 1}}
+        # nothing new: no-op
+        assert watcher.poll_once() is None
+        # trainer commits again -> new version served as default
+        _train_steps(mod, it, 2)
+        mgr.save_module(mod, epoch=0, nbatch=4)
+        assert watcher.poll_once() == 2
+        assert registry.get("clf").version == 2
+        assert registry.get("clf").sample_shapes == {"data": (4,)}
+        # served params match the trainer's committed params
+        args, _ = mod.get_params()
+        np.testing.assert_array_equal(
+            registry.get("clf").arg_params["fc1_weight"].asnumpy(),
+            args["fc1_weight"].asnumpy())
+
+
+def test_watch_retries_after_transient_read_error(tmp_path, monkeypatch):
+    """One transient filesystem error must not permanently skip a
+    version — the final checkpoint of a finished run would never be
+    served."""
+    from mxnet_tpu import serving
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = _fresh_module(_net(), it, np_seed=3)
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    mgr.save_module(mod, epoch=0)
+
+    registry = serving.ModelRegistry()
+    watcher = registry.watch_checkpoints(str(tmp_path), "clf", start=False)
+    real_read = CheckpointStore.read
+    calls = {"n": 0}
+
+    def flaky_read(self, step, verify=True):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient NFS hiccup")
+        return real_read(self, step, verify=verify)
+
+    monkeypatch.setattr(CheckpointStore, "read", flaky_read)
+    assert watcher.poll_once() is None       # transient: not consumed
+    assert watcher.poll_once() == 1          # retried and served
+    assert registry.get("clf").version == 1
+
+
+def test_watch_skips_unservable_checkpoint(tmp_path):
+    from mxnet_tpu import serving
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    # no symbol / input shapes: not servable
+    mgr.save_state(TrainState(_params(), {}, {"epoch": 0}))
+    registry = serving.ModelRegistry()
+    watcher = registry.watch_checkpoints(str(tmp_path), "clf", start=False)
+    assert watcher.poll_once() is None
+    assert registry.describe() == {}
+
+
+# ---------------------------------------------------------------------------
+# legacy path crash-safety (satellite)
+# ---------------------------------------------------------------------------
+def test_nd_save_is_atomic(tmp_path, monkeypatch):
+    from mxnet_tpu import nd, _atomic_io
+    target = str(tmp_path / "params")
+    nd.save(target, {"arg:w": nd.array([1.0, 2.0])})
+
+    def boom(src, dst):
+        raise OSError("crash at rename")
+
+    monkeypatch.setattr(_atomic_io.os, "replace", boom)
+    with pytest.raises(OSError):
+        nd.save(target, {"arg:w": nd.array([9.0, 9.0])})
+    # the original file is intact and no temp residue remains
+    loaded = nd.load(target)
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), [1.0, 2.0])
+    assert [n for n in os.listdir(tmp_path) if ".tmp-" in n] == []
+
+
+def test_symbol_save_is_atomic(tmp_path, monkeypatch):
+    from mxnet_tpu import _atomic_io
+    target = str(tmp_path / "net-symbol.json")
+    _net().save(target)
+    before = open(target).read()
+
+    monkeypatch.setattr(_atomic_io.os, "replace",
+                        lambda s, d: (_ for _ in ()).throw(OSError("x")))
+    with pytest.raises(OSError):
+        sym.Variable("other").save(target)
+    assert open(target).read() == before
+
+
+def test_save_checkpoint_mirrors_to_manager(tmp_path, monkeypatch):
+    ckpt_dir = tmp_path / "managed"
+    monkeypatch.setenv("MXNET_CKPT_DIR", str(ckpt_dir))
+    monkeypatch.setenv("MXNET_CKPT_ASYNC", "0")
+    X, y = _blob_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=8)
+    mod = _fresh_module(_net(), it, np_seed=3)
+    prefix = str(tmp_path / "legacy")
+    mod.save_checkpoint(prefix, 1)
+    # legacy pair still written (load path unchanged)...
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0001.params")
+    # ...AND one managed full-state checkpoint committed
+    mgr = checkpoint.default_manager()
+    assert mgr.latest_step() is not None
+    assert mgr.restore_latest().optimizer_state is not None
+    # manager=False suppresses the routing
+    before = mgr.steps()
+    mod.save_checkpoint(prefix, 2, manager=False)
+    assert mgr.steps() == before
+
+
+# ---------------------------------------------------------------------------
+# telemetry round trip (satellite)
+# ---------------------------------------------------------------------------
+def test_checkpoint_telemetry_round_trip(tmp_path):
+    import mxnet_tpu.telemetry as telemetry
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    mgr.save_state(TrainState(_params(), {}, {"epoch": 0}))
+    mgr.restore_latest()
+    snap = telemetry.snapshot()
+    for fam in ("mxnet_checkpoint_saves_total", "mxnet_checkpoint_bytes",
+                "mxnet_checkpoint_save_seconds",
+                "mxnet_checkpoint_restores_total",
+                "mxnet_checkpoint_restore_seconds",
+                "mxnet_checkpoint_failures_total"):
+        assert fam in snap, fam
+    assert snap["mxnet_checkpoint_saves_total"]["values"][0]["value"] >= 1
+    assert snap["mxnet_checkpoint_restores_total"]["values"][0]["value"] >= 1
+    assert snap["mxnet_checkpoint_bytes"]["values"][0]["value"] > 0
+    # the exposition that carries the family is format-valid
+    samples = telemetry.validate_exposition(telemetry.prometheus_text())
+    assert "mxnet_checkpoint_saves_total" in samples
+    assert "mxnet_checkpoint_save_seconds_bucket" in samples
+
+
+def test_checkpoint_profiler_spans(tmp_path):
+    import json
+    from mxnet_tpu import profiler
+    mgr = CheckpointManager(directory=str(tmp_path), async_save=False)
+    profiler.set_state("run")
+    try:
+        mgr.save_state(TrainState(_params(), {}, {"epoch": 0}))
+        mgr.restore_latest()
+        events = json.loads(profiler.dumps(reset=True))["traceEvents"]
+    finally:
+        profiler.set_state("stop")
+    names = {e["name"] for e in events}
+    assert "checkpoint:save" in names
+    assert "checkpoint:restore" in names
