@@ -54,6 +54,7 @@ from . import log  # noqa: F401
 from . import rtc  # noqa: F401
 from . import contrib  # noqa: F401
 from . import config  # noqa: F401
+from . import compile_cache  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import torch  # noqa: F401  (the pytorch bridge, reference mx.th)
 from .torch import TorchModule as _TorchModule

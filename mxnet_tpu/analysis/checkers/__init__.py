@@ -4,4 +4,5 @@ from . import c_api_contract    # noqa: F401
 from . import env_knobs         # noqa: F401
 from . import host_sync         # noqa: F401
 from . import lock_discipline   # noqa: F401
+from . import missing_donation  # noqa: F401
 from . import recompile_hazard  # noqa: F401

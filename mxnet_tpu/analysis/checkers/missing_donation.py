@@ -1,0 +1,94 @@
+"""missing-donation — step/update jits that never donate their buffers.
+
+A training-step or optimizer-update program rebinds its parameter /
+optimizer-state arrays to its own outputs: the caller never reads the
+input buffers again.  Without ``donate_argnums`` XLA must keep both
+generations live across the program — on TPU that doubles the HBM
+footprint of the largest arrays in the process and inserts copies the
+compiler could have elided (the executor's fused step, ``_build_fbu``,
+donates for exactly this reason; ROADMAP item 3 makes the win
+enforced, not one-off).
+
+Heuristic (both must hold, so ordinary forward/eval jits are never
+flagged):
+
+- the jitted function is **step/update-shaped**: its name matches
+  ``step``/``update``/``apply_grad*``/``sgd``/``adam``/``fbu`` as a
+  ``_``-delimited word;
+- it **takes param/optimizer-state args**: at least one parameter name
+  contains ``param``/``weight``/``state``/``slot``/``momentum``/
+  ``velocity``/``grad`` (or is literally ``w``/``ws``).
+
+A jit call carrying ``donate_argnums``/``donate_argnames`` — including
+an explicit empty ``donate_argnums=()`` — passes: the empty form is
+this tree's idiom for "donation was considered and is wrong here"
+(e.g. kvstore hands out aliased weight buffers), and it keeps the
+decision auditable.  Jit-compiled functions are located exactly as
+recompile-hazard does (decorator, ``jit(fn, ...)`` call, inline
+lambda, ``partial(jax.jit, ...)``).
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Finding, register
+from .recompile_hazard import _all_params, _jit_targets
+
+__all__ = ["MissingDonationChecker"]
+
+_STEP_NAME_RE = re.compile(
+    r"(^|_)(step|steps|update|updates|apply_grads?|apply_gradients?|"
+    r"sgd|adam|fbu)($|_)", re.IGNORECASE)
+_STATE_PARAM_RE = re.compile(
+    r"param|weight|state|slot|momentum|velocity|grad", re.IGNORECASE)
+_STATE_PARAM_EXACT = frozenset(("w", "ws"))
+
+_DONATE_KWARGS = frozenset(("donate_argnums", "donate_argnames"))
+
+
+def _donation_declared(call):
+    """Does the jit invocation carry a donation decision?  ``call`` is
+    the ``jit(...)``/``partial(jax.jit, ...)`` Call node, or None for a
+    bare ``@jax.jit`` decorator (which can declare nothing)."""
+    if not isinstance(call, ast.Call):
+        return False
+    return any(kw.arg in _DONATE_KWARGS for kw in call.keywords)
+
+
+def _state_params(params):
+    return [p for p in params
+            if p in _STATE_PARAM_EXACT or _STATE_PARAM_RE.search(p)]
+
+
+@register
+class MissingDonationChecker(Checker):
+    rule = "missing-donation"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        if tree is None or "jit" not in text:
+            return []
+        out = []
+        for fn, call in _jit_targets(tree):
+            name = getattr(fn, "name", "<lambda>")
+            if name == "<lambda>" or not _STEP_NAME_RE.search(name):
+                continue
+            stateful = _state_params(_all_params(fn))
+            if not stateful:
+                continue
+            if _donation_declared(call):
+                continue
+            line = call.lineno if isinstance(call, ast.Call) else fn.lineno
+            out.append(Finding(
+                self.rule, self.severity, relpath, line,
+                "jitted step/update %r takes param/state args %s but the "
+                "jit call passes no donate_argnums — the caller rebinds "
+                "these buffers to the outputs, so without donation XLA "
+                "keeps both generations live (double HBM for the largest "
+                "arrays) and copies where it could alias; donate them, "
+                "or write donate_argnums=() to record that donation was "
+                "considered and rejected (aliased buffers)"
+                % (name, stateful), symbol=name))
+        return out

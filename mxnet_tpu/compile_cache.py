@@ -1,0 +1,381 @@
+"""Persistent XLA compile cache — warm-start executors across restarts.
+
+Reference precedent: the TensorFlow paper's serving story and TVM's
+reuse of ahead-of-time compiled artifacts — a compiled executable is a
+deterministic function of (program, shapes, dtypes, backend) and
+should be cached on disk, not rebuilt per process.  Today every
+process pays the full compile bill from scratch (BENCH_SERVING.json:
+5.08 s of ``warmup()`` for five shape buckets); at fleet scale,
+restarts and autoscaling make those cold-start recompiles the dominant
+tail-latency event.
+
+This module wires jax's persistent compilation cache
+(``jax_compilation_cache_dir`` + thresholds) behind the
+``MXNET_COMPILE_CACHE_*`` knobs, initialized once from the executor's
+bind path so EVERY jit in the stack — executor fwd/train/fused-step,
+kvstore reduce, serving binds — reads and writes one shared on-disk
+cache.  On top of the raw wiring it adds what jax leaves out:
+
+- **hygiene** — a size cap (``MXNET_COMPILE_CACHE_MAX_BYTES``) with
+  LRU eviction by recency (jax touches a ``-atime`` sibling per read;
+  its mtime is the recency signal, falling back to the entry's own
+  mtime), swept at initialization and on demand (:func:`sweep`);
+- **degradation, never crashes** — an unwritable cache dir disables
+  the cache with one warning; a corrupted/truncated entry falls back
+  to a cold compile (``jax_raise_persistent_cache_errors`` is forced
+  off) and is counted, not raised;
+- **telemetry** — ``mxnet_compile_cache_{hits,misses,evictions,
+  errors}_total`` counters + a ``mxnet_compile_cache_size_bytes``
+  gauge, recorded via jax's monitoring events so the numbers are the
+  cache's own truth, not a parallel guess.
+
+Multi-process sharing is safe by construction: jax commits entries by
+write-to-temp + rename, readers of a just-evicted entry degrade to a
+miss, and the cache key includes the backend, so heterogeneous
+replicas can share one directory (caveats: docs/faq/compile_cache.md).
+
+The serving layer pairs this with a warmup manifest
+(``mxnet_tpu.serving.WarmupManifest``): the compile cache remembers
+the *executables*, the manifest remembers *which* (model, bucket)
+programs a replica needs — together a restarted server's ``warmup()``
+replays the manifest against the disk cache and starts hot.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+__all__ = ["ensure_initialized", "configure", "enabled", "cache_dir",
+           "stats", "sweep", "reset"]
+
+_LOCK = threading.Lock()
+_INIT_LOCK = threading.Lock()   # serializes first-time configuration so
+#                               # a concurrent bind WAITS instead of
+#                               # compiling cold before the cache is on
+_STATE = {                      # guarded-by: _LOCK
+    "checked": False,           # configuration committed (terminal)
+    "enabled": False,
+    "dir": None,
+    "max_bytes": 0,
+    "entries": 0,               # as of the last sweep()/stats(refresh=True)
+    "size_bytes": 0,            # as of the last sweep()/stats(refresh=True)
+    "listener": False,          # jax monitoring listener installed
+    "hooks": False,             # error-accounting wrappers installed
+}
+_COUNTS = {"requests": 0, "hits": 0, "misses": 0, "errors": 0,
+           "evictions": 0}
+#                               # guarded-by: _LOCK
+
+_HELP = {
+    "requests": "compile requests that consulted the persistent cache; "
+                "requests - hits == real compiles (robust to the "
+                "min-compile-time/entry-size persist thresholds, which "
+                "suppress the miss event but never this one)",
+    "hits": "persistent compile-cache hits (an XLA executable "
+            "deserialized from disk instead of compiled)",
+    "misses": "persistent compile-cache misses that then populated the "
+              "cache (compiles below the persist thresholds count in "
+              "requests - hits but not here)",
+    "errors": "persistent compile-cache failures (unreadable dir, "
+              "corrupt entry, failed write) — every one degraded to a "
+              "cold compile, never an exception",
+    "evictions": "compile-cache entries LRU-evicted by the size cap "
+                 "(MXNET_COMPILE_CACHE_MAX_BYTES)",
+}
+
+
+def _declare_counters():
+    """Create every mxnet_compile_cache_*_total family up front so the
+    exposition shows an explicit 0 from the moment the cache is
+    configured — a scraper must be able to tell "zero misses" (warm
+    restart) from "cache off" (family absent)."""
+    from . import telemetry
+    if not telemetry.enabled():
+        return
+    for kind in _COUNTS:
+        telemetry.counter("mxnet_compile_cache_%s_total" % kind,
+                          _HELP[kind])
+
+
+def _set_size_gauge(total):
+    from . import telemetry
+    if telemetry.enabled():
+        telemetry.gauge(
+            "mxnet_compile_cache_size_bytes",
+            "bytes of committed entries in the persistent compile cache "
+            "directory (updated by hygiene sweeps)").set(total)
+
+
+def _bump(kind, n=1):
+    if not n:
+        return
+    with _LOCK:
+        _COUNTS[kind] += n
+    from . import telemetry
+    if telemetry.enabled():
+        telemetry.counter("mxnet_compile_cache_%s_total" % kind,
+                          _HELP[kind]).inc(n)
+
+
+def _on_jax_event(event, **kwargs):
+    # fires only on compiling dispatches — never on the cached hot path
+    if event == "/jax/compilation_cache/compile_requests_use_cache":
+        _bump("requests")
+    elif event == "/jax/compilation_cache/cache_hits":
+        _bump("hits")
+    elif event == "/jax/compilation_cache/cache_misses":
+        _bump("misses")
+
+
+def _install_listener():
+    with _LOCK:
+        if _STATE["listener"]:
+            return
+        _STATE["listener"] = True
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_jax_event)
+    except (ImportError, AttributeError):   # jax drift: counts stay 0
+        pass
+
+
+def _install_error_hooks():
+    """Count read/write failures at the cache boundary.
+
+    jax handles them (warn + cold compile when
+    ``raise_persistent_cache_errors`` is off) but exposes no counter;
+    wrapping the two entry points gives exact error accounting without
+    changing behavior — exceptions are re-raised for jax's own
+    handling.  Degrades to no accounting if jax's internals drift."""
+    with _LOCK:
+        if _STATE["hooks"]:
+            return
+        _STATE["hooks"] = True
+    try:
+        from jax._src import compilation_cache as _cc
+    except ImportError:
+        return
+
+    def _wrap(orig):
+        def wrapper(*args, **kwargs):
+            try:
+                return orig(*args, **kwargs)
+            except Exception:
+                _bump("errors")
+                raise
+        wrapper._mxnet_compile_cache_hook = True
+        return wrapper
+
+    for name in ("get_executable_and_time", "put_executable_and_time"):
+        orig = getattr(_cc, name, None)
+        if orig is not None and not getattr(
+                orig, "_mxnet_compile_cache_hook", False):
+            setattr(_cc, name, _wrap(orig))
+
+
+def _reset_jax_cache():
+    """Drop jax's in-memory handle on the cache dir so a config change
+    takes effect (jax latches the directory on first use)."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:   # noqa: BLE001 — version drift; next init latches
+        pass
+
+
+def ensure_initialized():
+    """Read the ``MXNET_COMPILE_CACHE_*`` knobs and wire jax's
+    persistent cache, once per process — called from the executor's
+    bind path, so the first bind of anything (trainer, server, kvstore)
+    turns the cache on for every jit after it.  Returns whether the
+    cache is enabled.  After the first call this is one dict read;
+    concurrent first binds WAIT on the init lock instead of racing
+    ahead and compiling cold before the cache config lands."""
+    if _STATE["checked"]:
+        return _STATE["enabled"]
+    with _INIT_LOCK:
+        if _STATE["checked"]:
+            return _STATE["enabled"]
+        from . import config as _config
+        return configure(_config.get("MXNET_COMPILE_CACHE_DIR"))
+
+
+def configure(directory, min_compile_secs=None, min_entry_bytes=None,
+              max_bytes=None):
+    """Point jax's persistent compile cache at ``directory`` (None/empty
+    disables).  Unset thresholds come from the ``MXNET_COMPILE_CACHE_*``
+    knobs.  A directory that cannot be created or written disables the
+    cache with a warning — a bad cache mount must degrade a replica to
+    cold compiles, never crash it.  Returns whether the cache is on."""
+    import jax
+    from . import config as _config
+    if not directory:
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache()
+        with _LOCK:        # checked last: it is the commit marker the
+            _STATE["enabled"] = False      # lock-free fast path trusts
+            _STATE["dir"] = None
+            _STATE["checked"] = True
+        return False
+    directory = os.path.abspath(directory)
+    if min_compile_secs is None:
+        min_compile_secs = _config.get("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS")
+    if min_entry_bytes is None:
+        min_entry_bytes = _config.get("MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES")
+    if max_bytes is None:
+        max_bytes = _config.get("MXNET_COMPILE_CACHE_MAX_BYTES")
+    try:
+        os.makedirs(directory, exist_ok=True)
+        probe = os.path.join(directory, ".mxnet-cache-probe-%d" % os.getpid())
+        with open(probe, "wb") as f:
+            f.write(b"probe")
+        os.remove(probe)
+    except OSError as exc:
+        _bump("errors")
+        logging.warning(
+            "compile cache disabled: %r is not a writable directory (%s); "
+            "every process will pay cold compiles", directory, exc)
+        jax.config.update("jax_compilation_cache_dir", None)
+        _reset_jax_cache()
+        with _LOCK:
+            _STATE["enabled"] = False
+            _STATE["dir"] = None
+            _STATE["checked"] = True
+        return False
+    jax.config.update("jax_enable_compilation_cache", True)
+    jax.config.update("jax_compilation_cache_dir", directory)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      int(min_entry_bytes))
+    # corruption/IO errors must degrade to a cold compile, not raise
+    jax.config.update("jax_raise_persistent_cache_errors", False)
+    _reset_jax_cache()
+    _declare_counters()
+    _install_listener()
+    _install_error_hooks()
+    with _LOCK:
+        _STATE["enabled"] = True
+        _STATE["dir"] = directory
+        _STATE["max_bytes"] = int(max_bytes)
+        _STATE["checked"] = True
+    sweep()
+    return True
+
+
+def enabled():
+    return _STATE["enabled"]
+
+
+def cache_dir():
+    return _STATE["dir"]
+
+
+def _entries(directory):
+    """[(cache_path, atime_path_or_None, size, recency)] for each
+    committed entry; recency is the ``-atime`` sibling's mtime (jax
+    touches it per read) falling back to the entry's own mtime."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    present = set(names)
+    for name in names:
+        if not name.endswith("-cache"):
+            continue
+        path = os.path.join(directory, name)
+        atime_name = name[:-len("-cache")] + "-atime"
+        atime_path = (os.path.join(directory, atime_name)
+                      if atime_name in present else None)
+        try:
+            size = os.path.getsize(path)
+            recency = os.path.getmtime(atime_path or path)
+        except OSError:
+            continue        # concurrently evicted by another process
+        out.append((path, atime_path, size, recency))
+    return out
+
+
+def sweep(max_bytes=None):
+    """Enforce the size cap: evict least-recently-used entries until
+    the cache fits.  Concurrent processes may race the unlink — a
+    reader of an evicted entry degrades to a miss, so the race is
+    benign.  Returns the number of entries evicted."""
+    with _LOCK:
+        directory = _STATE["dir"]
+        if max_bytes is None:
+            max_bytes = _STATE["max_bytes"]
+    if not directory:
+        return 0
+    entries = _entries(directory)
+    total = sum(size for _p, _a, size, _r in entries)
+    evicted = 0
+    if max_bytes and max_bytes > 0 and total > max_bytes:
+        entries.sort(key=lambda e: e[3])        # oldest recency first
+        for path, atime_path, size, _recency in entries:
+            if total <= max_bytes:
+                break
+            try:
+                os.remove(path)
+            except OSError:
+                continue    # another process won the eviction race
+            if atime_path is not None:
+                try:
+                    os.remove(atime_path)
+                except OSError:
+                    pass
+            total -= size
+            evicted += 1
+    with _LOCK:
+        _STATE["entries"] = len(entries) - evicted
+        _STATE["size_bytes"] = total
+    _bump("evictions", evicted)
+    _set_size_gauge(total)
+    return evicted
+
+
+def stats(refresh=True):
+    """Snapshot for /stats surfaces and the bench harness.
+
+    ``refresh=True`` rescans the cache directory so ``entries`` /
+    ``size_bytes`` reflect what is on disk right now — O(entries)
+    stat calls, fine for a bench probe or a debugger.  ``refresh=
+    False`` is the cheap form for hot monitoring paths (the serving
+    ``stats()`` poll): counters plus the sizes recorded by the last
+    :func:`sweep`, zero disk I/O — on a network-mounted cache dir a
+    per-scrape directory walk is exactly the kind of repeated remote
+    I/O the cache exists to avoid."""
+    with _LOCK:
+        snap = dict(_COUNTS)
+        snap["enabled"] = _STATE["enabled"]
+        snap["dir"] = _STATE["dir"]
+        snap["max_bytes"] = _STATE["max_bytes"]
+        snap["entries"] = _STATE["entries"]
+        snap["size_bytes"] = _STATE["size_bytes"]
+    if refresh and snap["dir"]:
+        entries = _entries(snap["dir"])
+        snap["entries"] = len(entries)
+        snap["size_bytes"] = sum(size for _p, _a, size, _r in entries)
+        with _LOCK:
+            _STATE["entries"] = snap["entries"]
+            _STATE["size_bytes"] = snap["size_bytes"]
+        _set_size_gauge(snap["size_bytes"])
+    return snap
+
+
+def reset():
+    """Test hook: disable the cache and zero the counters so the next
+    :func:`ensure_initialized` re-reads the environment."""
+    import jax
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache()
+    with _LOCK:
+        _STATE["checked"] = False
+        _STATE["enabled"] = False
+        _STATE["dir"] = None
+        _STATE["entries"] = 0
+        _STATE["size_bytes"] = 0
+        for k in _COUNTS:
+            _COUNTS[k] = 0

@@ -176,6 +176,25 @@ register_env("MXNET_CKPT_ON_SIGTERM", bool, True,
 register_env("MXNET_CKPT_WATCH_INTERVAL_S", float, 10.0,
              "poll period of serving ModelRegistry.watch_checkpoints "
              "for newly committed checkpoint versions")
+register_env("MXNET_COMPILE_CACHE_DIR", str, None,
+             "directory for the persistent XLA compile cache; when set, "
+             "compiled executables are cached on disk and a restarted "
+             "process warm-starts instead of recompiling "
+             "(docs/faq/compile_cache.md)")
+register_env("MXNET_COMPILE_CACHE_MIN_COMPILE_SECS", float, 0.0,
+             "only compiles at least this slow are persisted (0 caches "
+             "everything — serving warmup wants every bucket back)")
+register_env("MXNET_COMPILE_CACHE_MIN_ENTRY_BYTES", int, 0,
+             "only serialized executables at least this large are "
+             "persisted (0 caches everything)")
+register_env("MXNET_COMPILE_CACHE_MAX_BYTES", int, 1073741824,
+             "compile-cache size cap; hygiene sweeps LRU-evict by "
+             "recency until the cache fits (<= 0 disables the cap)")
+register_env("MXNET_COMPILE_CACHE_MANIFEST", str, None,
+             "path of the serving warmup manifest: ModelServer records "
+             "its (model, bucket) executor key set there and a "
+             "restarted replica replays it so warmup re-binds hit the "
+             "persisted executables (docs/faq/compile_cache.md)")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

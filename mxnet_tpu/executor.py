@@ -50,6 +50,12 @@ class Executor:
 
     def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
                  compute_dtype=None, cast_exclude=()):
+        # first bind in the process wires the persistent XLA compile
+        # cache (MXNET_COMPILE_CACHE_DIR) so every jit after it —
+        # executor fwd/train/fused-step, kvstore reduce, serving binds —
+        # reads/writes the shared on-disk cache; one dict read after
+        from . import compile_cache as _compile_cache
+        _compile_cache.ensure_initialized()
         self._symbol = symbol
         self._compute_dtype = (jnp.dtype(compute_dtype)
                                if compute_dtype is not None else None)

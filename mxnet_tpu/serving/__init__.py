@@ -11,6 +11,7 @@ from .bucketing import pick_bucket, shape_buckets  # noqa: F401
 from .cache import ExecutorCache  # noqa: F401
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
                      QueueFull, ServerClosed, ServingError)
+from .manifest import WarmupManifest  # noqa: F401
 from .registry import (CheckpointWatcher, ModelRegistry,  # noqa: F401
                        ModelVersion)
 from .server import InferenceFuture, ModelServer  # noqa: F401
@@ -18,4 +19,5 @@ from .server import InferenceFuture, ModelServer  # noqa: F401
 __all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
            "InferenceFuture", "ServingError", "ModelNotFound", "QueueFull",
            "DeadlineExceeded", "ServerClosed", "BadRequest",
-           "CheckpointWatcher", "shape_buckets", "pick_bucket"]
+           "CheckpointWatcher", "WarmupManifest", "shape_buckets",
+           "pick_bucket"]

@@ -26,7 +26,7 @@ __all__ = ["ExecutorCache"]
 
 
 class ExecutorCache:
-    def __init__(self, capacity=16):
+    def __init__(self, capacity=16, on_miss=None):
         if capacity < 1:
             raise ValueError("executor cache capacity must be >= 1")
         self._capacity = int(capacity)
@@ -36,6 +36,11 @@ class ExecutorCache:
         self.hits = 0                   # guarded-by: _lock
         self.misses = 0                 # guarded-by: _lock
         self.evictions = 0              # guarded-by: _lock
+        # miss hook: the server records every freshly-bound (entry,
+        # bucket) key into the warmup manifest, so a restarted replica
+        # knows the working set to re-warm.  Called OUTSIDE the lock
+        # (it does file I/O) and never allowed to poison the bind.
+        self._on_miss = on_miss
         # per-instance ints stay the stats() source of truth; the shared
         # telemetry namespace mirrors them so one snapshot()/exposition
         # correlates serving recompiles with the executor's XLA-compile
@@ -44,6 +49,15 @@ class ExecutorCache:
             "mxnet_serving_cache_events_total",
             "executor-cache lookups by outcome (hit/miss/eviction); "
             "miss count IS the serving recompile count")
+        # evictions also get a first-class counter: cache pressure
+        # (capacity churn → recompile storms) must be visible as its
+        # own series, not a label slice someone forgets to query
+        self._t_evictions = telemetry.counter(
+            "mxnet_serving_cache_evictions_total",
+            "bound executors dropped by LRU capacity pressure; a "
+            "rising rate means the (model, version, bucket) working "
+            "set exceeds MXNET_SERVING_EXECUTOR_CACHE and steady-state "
+            "traffic is recompiling")
 
     def get(self, entry, bucket):
         """The bound predictor for ``entry`` (a ModelVersion) at
@@ -83,7 +97,13 @@ class ExecutorCache:
                 self._entries.popitem(last=False)
                 self.evictions += 1
                 self._t_events.labels(outcome="eviction").inc()
-            return pred
+                self._t_evictions.inc()
+        if self._on_miss is not None:
+            try:
+                self._on_miss(entry, bucket)
+            except Exception:   # noqa: BLE001 — manifest I/O never
+                pass            # poisons a successful bind
+        return pred
 
     def invalidate(self, name, version=None):
         """Drop cached executors for a model (hot swap / unload path)."""

@@ -1,0 +1,136 @@
+"""Warmup manifest — the executor cache's key set, persisted.
+
+The persistent compile cache (``mxnet_tpu.compile_cache``) remembers
+compiled *executables*; this manifest remembers *which* executables a
+serving replica needs: every (model, symbol sha256, shape bucket,
+dtype, backend) the ``ExecutorCache`` ever bound.  A restarted replica
+replays the manifest (``ModelServer.warmup_from_manifest``) so its
+warmup re-binds exactly last run's working set — each bind a compile-
+cache hit, not a cold trace+compile.
+
+The key deliberately hashes the SYMBOL, not the weights: a hot-swapped
+checkpoint version of the same architecture produces the same program,
+so the manifest (and the disk cache behind it) stays valid across
+``CheckpointWatcher`` promotions — that is what makes pre-warm-then-
+promote cheap.
+
+Commits reuse ``_atomic_io.atomic_write``: a crash mid-write leaves
+the previous complete manifest, never a torn one.  Reads of a corrupt
+or foreign file degrade to an empty manifest with a warning — warmup
+then falls back to the full bucket ladder, it never crashes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+from .._atomic_io import atomic_write
+
+__all__ = ["WarmupManifest"]
+
+_SCHEMA = 1
+
+
+def _default_backend():
+    import jax
+    return jax.default_backend()
+
+
+class WarmupManifest:
+    """Atomically-committed record of the serving executor key set."""
+
+    def __init__(self, path):
+        self.path = os.path.abspath(path)
+        self._lock = threading.Lock()
+        self._entries = {}     # key -> entry dict   guarded-by: _lock
+        self._loaded = False   # guarded-by: _lock
+        self._load_locked_deferred()
+
+    def _load_locked_deferred(self):
+        with self._lock:
+            if self._loaded:
+                return
+            self._loaded = True
+            try:
+                with open(self.path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                entries = doc["entries"] if isinstance(doc, dict) \
+                    and doc.get("schema") == _SCHEMA else []
+                for e in entries:
+                    self._entries[self._key(e)] = dict(e)
+            except FileNotFoundError:
+                pass            # first run: manifest grows from empty
+            except (OSError, ValueError, KeyError, TypeError) as exc:
+                logging.warning(
+                    "warmup manifest %r unreadable (%s); starting empty — "
+                    "warmup falls back to the full bucket ladder",
+                    self.path, exc)
+
+    @staticmethod
+    def _key(entry):
+        return (entry["model"], entry["symbol_sha256"], int(entry["bucket"]),
+                entry.get("dtype", "float32"), entry.get("backend", ""))
+
+    def record(self, entry, bucket, backend=None, dtype="float32"):
+        """Add one executor-cache key (``entry`` is a ModelVersion);
+        commits the file only when the key is new.  Returns whether it
+        was."""
+        if backend is None:
+            backend = _default_backend()
+        rec = {
+            "model": entry.name,
+            "version": entry.version,
+            "symbol_sha256": entry.symbol_sha,
+            "bucket": int(bucket),
+            "batch": int(bucket),
+            "dtype": dtype,
+            "backend": backend,
+            "sample_shapes": {k: list(s)
+                              for k, s in entry.sample_shapes.items()},
+        }
+        key = self._key(rec)
+        with self._lock:
+            known = self._entries.get(key)
+            if known is not None:
+                if known.get("version") == rec["version"]:
+                    return False
+                known["version"] = rec["version"]   # refresh info only
+            else:
+                self._entries[key] = rec
+            self._commit_locked()
+        return known is None
+
+    def _commit_locked(self):
+        doc = {"schema": _SCHEMA,
+               "entries": sorted(self._entries.values(),
+                                 key=lambda e: (e["model"], e["bucket"],
+                                                e["backend"]))}
+        try:
+            atomic_write(self.path,
+                         json.dumps(doc, indent=1).encode("utf-8"))
+        except OSError as exc:
+            logging.warning("warmup manifest %r not writable (%s); keys "
+                            "recorded in memory only", self.path, exc)
+
+    def entries(self):
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def buckets_for(self, name, symbol_sha, backend=None):
+        """Sorted buckets recorded for this (model name, program) —
+        what a restarted replica should warm.  ``backend`` narrows to
+        entries recorded on that backend (None accepts any: a manifest
+        written on TPU still names the right buckets on CPU; only the
+        disk-cache hit is lost)."""
+        with self._lock:
+            return sorted({e["bucket"] for e in self._entries.values()
+                           if e["model"] == name
+                           and e["symbol_sha256"] == symbol_sha
+                           and (backend is None
+                                or e["backend"] == backend)})
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)

@@ -26,7 +26,8 @@ class ModelVersion:
     """One immutable loaded checkpoint: symbol, params, input signature."""
 
     __slots__ = ("name", "version", "symbol", "arg_params", "aux_params",
-                 "sample_shapes", "input_names", "num_outputs")
+                 "sample_shapes", "input_names", "num_outputs",
+                 "_symbol_sha")
 
     def __init__(self, name, version, symbol, arg_params, aux_params,
                  input_shapes):
@@ -48,6 +49,19 @@ class ModelVersion:
             self.sample_shapes[k] = shp[1:]
         self.input_names = list(self.sample_shapes)
         self.num_outputs = len(symbol.list_outputs())
+        self._symbol_sha = None
+
+    @property
+    def symbol_sha(self):
+        """sha256 of the symbol JSON — the PROGRAM identity the warmup
+        manifest and compile cache key on: two versions of the same
+        architecture share it (weights are runtime inputs, not part of
+        the compiled executable)."""
+        if self._symbol_sha is None:
+            import hashlib
+            self._symbol_sha = hashlib.sha256(
+                self.symbol.tojson().encode("utf-8")).hexdigest()
+        return self._symbol_sha
 
     def full_shapes(self, batch):
         """Declared input shapes with the batch axis set to ``batch``."""
@@ -149,17 +163,23 @@ class ModelRegistry:
 
     # -- checkpoint hot-swap -------------------------------------------------
     def watch_checkpoints(self, directory, name, poll_interval=None,
-                          set_default=True, start=True):
+                          set_default=True, start=True, server=None):
         """Hot-swap committed training checkpoints into this registry —
         the train→serve loop closed: as ``checkpoint.CheckpointManager``
         commits new versions into ``directory``, a watcher registers
         each (version = checkpoint step id) and promotes it to the
-        serving default.  Returns the :class:`CheckpointWatcher`; call
-        ``stop()`` (or use it as a context manager) to end the watch,
-        ``poll_once()`` to drive it manually (``start=False``)."""
+        serving default.  With ``server`` (a ModelServer), the new
+        version is WARMED before promotion — its bucket executors bound
+        (manifest-recorded buckets when available, the server's ladder
+        otherwise) so the swap never exposes live traffic to a compile;
+        with the persistent compile cache on, those binds are disk hits.
+        Returns the :class:`CheckpointWatcher`; call ``stop()`` (or use
+        it as a context manager) to end the watch, ``poll_once()`` to
+        drive it manually (``start=False``)."""
         return CheckpointWatcher(self, directory, name,
                                  poll_interval=poll_interval,
-                                 set_default=set_default, start=start)
+                                 set_default=set_default, start=start,
+                                 server=server)
 
 
 class CheckpointWatcher:
@@ -173,12 +193,13 @@ class CheckpointWatcher:
     from an unbound module) are skipped with a warning."""
 
     def __init__(self, registry, directory, name, poll_interval=None,
-                 set_default=True, start=True):
+                 set_default=True, start=True, server=None):
         from ..checkpoint import CheckpointStore
         if poll_interval is None:
             from .. import config as _config
             poll_interval = _config.get("MXNET_CKPT_WATCH_INTERVAL_S")
         self.registry = registry
+        self.server = server
         self.name = name
         self.poll_interval = float(poll_interval)
         self.set_default = bool(set_default)
@@ -235,6 +256,21 @@ class CheckpointWatcher:
                               version=step)
         except BadRequest:
             pass   # another watcher won the race; still promote below
+        if self.server is not None:
+            # pre-warm THEN promote: bind the new version's bucket
+            # executors (compile-cache hits when the persistent cache
+            # is on) before any live traffic can resolve to it — a hot
+            # swap must never expose a request to a cold compile.
+            # Failures are logged and promotion proceeds: a version
+            # that cannot warm will simply compile lazily, the PR 2
+            # behavior.
+            try:
+                self.server.warmup_version(self.name, step)
+            except Exception as exc:   # noqa: BLE001 — never block a swap
+                logging.warning(
+                    "checkpoint watcher %r: warmup of version %d failed "
+                    "(%s: %s); promoting anyway (lazy compile)",
+                    self.name, step, type(exc).__name__, exc)
         if self.set_default:
             self.registry.set_default(self.name, step)
         logging.info("checkpoint watcher %r: now serving version %d",

@@ -49,6 +49,7 @@ from ..io import pad_batch
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
 from .errors import (BadRequest, DeadlineExceeded, QueueFull, ServerClosed)
+from .manifest import WarmupManifest
 from .registry import ModelRegistry
 
 __all__ = ["InferenceFuture", "ModelServer"]
@@ -153,7 +154,7 @@ class ModelServer:
 
     def __init__(self, registry=None, max_batch=None, queue_depth=None,
                  batch_wait_ms=None, default_timeout_ms=None,
-                 cache_size=None, buckets=None):
+                 cache_size=None, buckets=None, manifest_path=None):
         self.registry = registry if registry is not None else ModelRegistry()
         if buckets is not None:
             self._buckets = sorted({int(b) for b in buckets})
@@ -178,9 +179,19 @@ class ModelServer:
         self._default_timeout_ms = float(
             default_timeout_ms if default_timeout_ms is not None
             else config.get("MXNET_SERVING_DEFAULT_TIMEOUT_MS"))
+        if manifest_path is None:
+            manifest_path = config.get("MXNET_COMPILE_CACHE_MANIFEST")
+        # the warmup manifest records every bound (model, bucket) key —
+        # the cache-miss hook catches live-traffic binds warmup never
+        # saw — so a restarted replica can replay last run's working
+        # set against the persistent compile cache
+        self.manifest = WarmupManifest(manifest_path) if manifest_path \
+            else None
         self.cache = ExecutorCache(
             cache_size if cache_size is not None
-            else config.get("MXNET_SERVING_EXECUTOR_CACHE"))
+            else config.get("MXNET_SERVING_EXECUTOR_CACHE"),
+            on_miss=(self.manifest.record if self.manifest is not None
+                     else None))
         self._cv = threading.Condition()
         self._queue = []                # guarded-by: _cv
         self._stopping = False
@@ -246,6 +257,16 @@ class ModelServer:
         """Unload + drop the version's cached executors (hot-swap tail)."""
         self.registry.unload(name, version)
         self.cache.invalidate(name, version)
+
+    def watch_checkpoints(self, directory, name, poll_interval=None,
+                          set_default=True, start=True):
+        """Registry ``watch_checkpoints`` with THIS server wired in as
+        the warmer: each newly committed checkpoint version is warmed
+        (manifest buckets, compile-cache-backed) BEFORE promotion, so a
+        hot swap never exposes live traffic to a cold compile."""
+        return self.registry.watch_checkpoints(
+            directory, name, poll_interval=poll_interval,
+            set_default=set_default, start=start, server=self)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -368,12 +389,16 @@ class ModelServer:
         running, warmup dispatches THROUGH it (one exactly-bucket-sized
         dummy request at a time, blocking) so a live request can never
         race warmup's forward on the same predictor.  Only a not-yet-
-        started server warms inline."""
+        started server warms inline.
+
+        With the persistent compile cache on
+        (``MXNET_COMPILE_CACHE_DIR``), each warmup bind deserializes
+        the executable from disk instead of compiling — the warm-
+        restart path ``bench_serving.py`` measures.  Warmed keys land
+        in the warmup manifest (via the executor cache's miss hook)
+        for the next restart to replay."""
         names = [name] if name is not None \
             else sorted(self.registry.describe())
-        with self._cv:
-            batcher_owns = self._thread is not None \
-                and self._thread.is_alive() and not self._stopping
         if buckets is not None:
             rogue = [b for b in buckets if int(b) not in self._buckets]
             if rogue:
@@ -382,15 +407,80 @@ class ModelServer:
                     "steady-state traffic only ever selects ladder "
                     "rungs, so warming them would not prevent any "
                     "recompile" % (rogue, self._buckets))
-        warmed = []
+        plan = []
         for n in names:
             entry = self.registry.get(n, version)
-            for b in (buckets if buckets is not None else self._buckets):
-                b = int(b)
+            plan.append((entry, [int(b) for b in (
+                buckets if buckets is not None else self._buckets)]))
+        return self._warm(plan, timeout_ms)
+
+    def warmup_from_manifest(self, name=None, version=None,
+                             timeout_ms=600000.0):
+        """Replay the warmup manifest: warm exactly the (model, bucket)
+        working set a previous process recorded, matched by PROGRAM
+        identity (symbol sha256) so a hot-swapped version of the same
+        architecture replays its predecessor's keys.  Returns the
+        warmed triples — empty when there is no manifest, it is
+        unreadable, or nothing recorded matches a registered model
+        (callers then fall back to :meth:`warmup`'s full ladder)."""
+        if self.manifest is None:
+            return []
+        names = [name] if name is not None \
+            else sorted(self.registry.describe())
+        plan = []
+        for n in names:
+            entry = self.registry.get(n, version)
+            recorded = self.manifest.buckets_for(n, entry.symbol_sha)
+            on_ladder = [b for b in recorded if b in self._buckets]
+            dropped = sorted(set(recorded) - set(on_ladder))
+            if dropped:
+                import logging
+                logging.warning(
+                    "warmup manifest buckets %s for model %r are off the "
+                    "current ladder %s (config drift since the manifest "
+                    "was written); skipping them", dropped, n,
+                    self._buckets)
+            if on_ladder:
+                plan.append((entry, on_ladder))
+        return self._warm(plan, timeout_ms)
+
+    def warmup_version(self, name, version, timeout_ms=600000.0):
+        """Warm ONE version's executors — the checkpoint watcher's
+        pre-warm-then-promote step.  Buckets come from the manifest
+        (the working set live traffic actually used) when recorded for
+        this program, else the full ladder."""
+        entry = self.registry.get(name, version)
+        bucket_list = list(self._buckets)
+        if self.manifest is not None:
+            recorded = [b for b in
+                        self.manifest.buckets_for(name, entry.symbol_sha)
+                        if b in self._buckets]
+            if recorded:
+                bucket_list = recorded
+        return self._warm([(entry, bucket_list)], timeout_ms)
+
+    def _warm(self, plan, timeout_ms):
+        """Execute a warmup plan of (entry, buckets) pairs, timing it
+        into ``mxnet_serving_warmup_seconds{mode=warm|cold}`` — warm
+        when every compile request during the plan was served from the
+        persistent compile cache (zero cache misses), cold otherwise
+        (including cache off).  The warm/cold split is the headline
+        restart-latency series: a fleet whose restarts stop being warm
+        has lost its cache mount."""
+        from .. import compile_cache
+        with self._cv:
+            batcher_owns = self._thread is not None \
+                and self._thread.is_alive() and not self._stopping
+        before = compile_cache.stats(refresh=False)
+        t0 = time.perf_counter()
+        warmed = []
+        for entry, bucket_list in plan:
+            for b in bucket_list:
                 feed = {k: np.zeros((b,) + s, np.float32)
                         for k, s in entry.sample_shapes.items()}
                 if batcher_owns:
-                    self.infer_async(n, feed, version=entry.version,
+                    self.infer_async(entry.name, feed,
+                                     version=entry.version,
                                      timeout_ms=timeout_ms,
                                      _solo=True).result()
                 else:
@@ -400,7 +490,27 @@ class ModelServer:
                         # deliberate sync: warmup EXISTS to force the
                         # compile + first execution before live traffic
                         pred.get_output(i).asnumpy()  # graftlint: disable=host-sync
-                warmed.append((n, entry.version, b))
+                warmed.append((entry.name, entry.version, b))
+        if warmed:
+            wall = time.perf_counter() - t0
+            after = compile_cache.stats(refresh=False)
+            # warm = the persistent cache is on and the plan provoked
+            # no real compile (zero new misses) — a plan whose keys
+            # were all already bound compiled nothing either, so it
+            # counts warm, not as a fake cold restart.  Global
+            # counters mean concurrent live-traffic compiles during
+            # the plan window can flip a warm plan to cold; that
+            # over-reports cold, never under-reports it.
+            mode = "warm" if (after["enabled"]
+                              and after["misses"] == before["misses"]) \
+                else "cold"
+            telemetry.histogram(
+                "mxnet_serving_warmup_seconds",
+                "wall time of warmup plans by mode: warm = every bind "
+                "hit the persistent compile cache, cold = at least one "
+                "real compile (or cache off)",
+                buckets=telemetry.exponential_buckets(0.01, 4.0, 10)
+            ).labels(mode=mode).observe(wall)
         return warmed
 
     # -- batcher ------------------------------------------------------------
@@ -559,5 +669,14 @@ class ModelServer:
             "p99": round(float(np.percentile(lats, 99)), 3) if lats else None,
         }
         snap["executor_cache"] = self.cache.stats()
+        from .. import compile_cache
+        # cheap form: counters + last-sweep sizes, no directory walk —
+        # stats() is a monitoring poll and the cache dir may be a
+        # network mount
+        snap["compile_cache"] = compile_cache.stats(refresh=False)
+        snap["warmup_manifest"] = {
+            "path": self.manifest.path,
+            "entries": len(self.manifest),
+        } if self.manifest is not None else None
         snap["models"] = self.registry.describe()
         return snap

@@ -288,6 +288,81 @@ def test_env_knob_drift_skips_docstrings(tmp_path):
     assert findings == []
 
 
+# -- missing-donation --------------------------------------------------------
+
+def test_missing_donation_flags_undonated_step(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+
+        fast = jax.jit(train_step)
+
+        @jax.jit
+        def sgd_update(weights, grads, lr):
+            return weights
+
+        def apply_gradients(params, grads):
+            return params
+
+        also = jax.jit(apply_gradients, static_argnums=())
+    """, "missing-donation")
+    assert sorted(f.symbol for f in findings) == [
+        "apply_gradients", "sgd_update", "train_step"]
+    assert all("donate_argnums" in f.message for f in findings)
+
+
+def test_missing_donation_good_patterns_stay_silent(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+
+        # donation declared: fine
+        fast = jax.jit(train_step, donate_argnums=(0, 1))
+
+        def fused_update(ws, gs, states):
+            return ws, states
+
+        # explicit EMPTY donation records the considered-and-rejected
+        # decision (aliased buffers) — the kvstore idiom; passes
+        audited = jax.jit(fused_update, donate_argnums=())
+
+        def evaluate(params, x):
+            return x          # not step/update-shaped by name
+
+        ev = jax.jit(evaluate)
+
+        def step(x, y):
+            return x + y      # step-named but no param/state args
+
+        st = jax.jit(step)
+
+        def helper_step(params):
+            return params
+
+        # suppressed variant: the inline comment wins
+        hs = jax.jit(helper_step)  # graftlint: disable=missing-donation
+    """, "missing-donation")
+    assert findings == []
+
+
+def test_missing_donation_conditional_donate_passes(tmp_path):
+    # the trainer idiom: donate_argnums=(0, 1) if self._donate else ()
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def step(params, state, x):
+            return params, state
+
+        fast = jax.jit(step,
+                       donate_argnums=(0, 1) if True else ())
+    """, "missing-donation")
+    assert findings == []
+
+
 # -- c-api-contract ----------------------------------------------------------
 
 _CPP_BAD = """
