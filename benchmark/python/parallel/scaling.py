@@ -4,12 +4,23 @@ Reference: the dist-scaling tables in
 ``example/image-classification/README.md:311-319`` (ResNet-152 at 90%
 linear to 256 GPUs via dist_device_sync).  Here scaling is compiled-in:
 the trainer jits one SPMD program per mesh, XLA places the gradient
-all-reduce on ICI.  This harness sweeps mesh widths and reports
+collectives on ICI.  This harness sweeps mesh widths and reports
 samples/s and scaling efficiency; on a virtual CPU mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) the absolute
 numbers are meaningless but the harness is the same one a pod runs.
 
+Each row also carries the collective wire model and the measured
+optimizer-state footprint (``trainer.comm_stats()`` /
+``trainer.optimizer_state_bytes()`` — docs/faq/parallel.md), and the
+sweep finishes with a **reduction-path A/B** at the widest mesh:
+zero=0 monolithic all-reduce vs zero=2 reduce-scatter + sharded update
+(optionally compressed), the ISSUE 7 acceptance numbers —
+``grad_reduce_reduction`` (>= 1.8x bar) and
+``opt_state_per_device_ratio`` (~ 1/mesh).
+
 Usage: python scaling.py [--widths 1,2,4,8] [--batch-per-device 32]
+                         [--zero {0,1,2}] [--compression 2bit|bf16|fp8]
+                         [--optimizer sgd|adam] [--json-out F]
 """
 import argparse
 import time
@@ -31,16 +42,25 @@ def build_net(classes=10):
     return net
 
 
-def bench_width(width, batch, steps, image_size):
+def make_trainer(width, image_size, zero=0, compression=None,
+                 optimizer="sgd"):
     import jax
     devices = jax.devices()[:width]
     mesh = parallel.make_mesh(dp=width, devices=devices)
     net = build_net()
     net.initialize(mx.init.Xavier(), force_reinit=True)
-    net(nd.ones((1, 3, image_size, image_size)))  # materialize deferred shapes
-    trainer = parallel.ParallelTrainer(
-        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-        {"learning_rate": 0.05, "momentum": 0.9}, mesh=mesh)
+    net(nd.ones((1, 3, image_size, image_size)))  # materialize shapes
+    opt_params = ({"learning_rate": 0.05, "momentum": 0.9}
+                  if optimizer == "sgd" else {"learning_rate": 1e-3})
+    return parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        opt_params, mesh=mesh, zero=zero, compression=compression)
+
+
+def bench_width(width, batch, steps, image_size, zero=0, compression=None,
+                optimizer="sgd"):
+    trainer = make_trainer(width, image_size, zero=zero,
+                           compression=compression, optimizer=optimizer)
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, image_size, image_size)
                  .astype(np.float32))
@@ -52,7 +72,59 @@ def bench_width(width, batch, steps, image_size):
         loss = trainer.step(x, y)
     float(loss.asnumpy())
     dt = (time.time() - t0) / steps
-    return batch / dt
+    return batch / dt, trainer
+
+
+def _state_cols(trainer):
+    """The per-row observability columns: static wire model + measured
+    optimizer-state residency."""
+    comm = trainer.comm_stats()
+    sb = trainer.optimizer_state_bytes()
+    return {
+        "collective_bytes_per_step": comm["total_bytes"],
+        "grad_reduce_bytes_per_step": comm["grad_reduce_bytes"],
+        "collective_ops": {k: v["ops"]
+                           for k, v in comm["kinds"].items() if v["ops"]},
+        "opt_state_bytes_total": sb["total"],
+        "opt_state_bytes_per_device": sb["per_device"],
+    }
+
+
+def reduction_ab_leg(width, image_size, compression, optimizer):
+    """zero=0 monolithic all-reduce vs zero=2 reduce-scatter + sharded
+    update at the widest mesh — the ISSUE 7 acceptance comparison,
+    measured off the wire model and real shardings (no timing, so it is
+    exact on a virtual mesh too)."""
+    legs = {}
+    ab = [("allreduce_z0", 0, None), ("zero2", 2, None)]
+    if compression:
+        ab.append(("zero2_%s" % compression, 2, compression))
+    for tag, zero, comp in ab:
+        t = make_trainer(width, image_size, zero=zero, compression=comp,
+                         optimizer=optimizer)
+        legs[tag] = _state_cols(t)
+    base = legs["allreduce_z0"]
+    z2 = legs["zero2"]
+    out = {
+        "devices": width,
+        "optimizer": optimizer,
+        "legs": legs,
+        # the >= 1.8x bar: grad-reduction wire bytes, monolithic
+        # all-reduce vs reduce-scatter path (ring model)
+        "grad_reduce_reduction": round(
+            base["grad_reduce_bytes_per_step"]
+            / max(z2["grad_reduce_bytes_per_step"], 1), 3),
+        # the ~1/mesh bar: slot bytes resident per chip under ZeRO
+        "opt_state_per_device_ratio": round(
+            z2["opt_state_bytes_per_device"]
+            / max(z2["opt_state_bytes_total"], 1), 4),
+    }
+    comp_tag = "zero2_%s" % (compression or "none")
+    if compression and comp_tag in legs:
+        out["compressed_grad_reduce_reduction"] = round(
+            base["grad_reduce_bytes_per_step"]
+            / max(legs[comp_tag]["grad_reduce_bytes_per_step"], 1), 3)
+    return out
 
 
 def main():
@@ -65,6 +137,15 @@ def main():
                          "default is batch-per-device x width (weak)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--zero", type=int, default=0, choices=(0, 1, 2),
+                    help="ZeRO stage for the sweep legs")
+    ap.add_argument("--compression", default=None,
+                    help="gradient codec for the sweep legs and the "
+                         "compressed A/B leg (2bit|bf16|fp8)")
+    ap.add_argument("--optimizer", default="sgd",
+                    help="sgd (momentum slots) or adam (2x slots)")
+    ap.add_argument("--skip-ab", action="store_true",
+                    help="skip the zero=0 vs zero=2 reduction A/B leg")
     ap.add_argument("--json-out", default=None,
                     help="also write the table as one JSON file")
     args = ap.parse_args()
@@ -72,13 +153,17 @@ def main():
     n = len(jax.devices())
     base = base_w = None
     rows = []
-    print("%6s %12s %10s" % ("dp", "samples/s", "efficiency"))
-    for w in (int(x) for x in args.widths.split(",")):
+    widths = [int(x) for x in args.widths.split(",")]
+    print("%6s %12s %10s %14s %14s" % (
+        "dp", "samples/s", "efficiency", "comm B/step", "opt B/chip"))
+    for w in widths:
         if w > n:
             print("%6d %12s %10s" % (w, "(no devices)", "-"))
             continue
         batch = args.global_batch or args.batch_per_device * w
-        sps = bench_width(w, batch, args.steps, args.image_size)
+        sps, trainer = bench_width(
+            w, batch, args.steps, args.image_size, zero=args.zero,
+            compression=args.compression, optimizer=args.optimizer)
         if base is None:
             base, base_w = sps, w
         # strong scaling vs the FIRST width run: ideal = base * (w/base_w)
@@ -91,8 +176,20 @@ def main():
         key = ("throughput_vs_1dev" if base_w == 1
                else "throughput_vs_%ddev_base" % base_w)
         row[key] = round(sps / base, 3)
+        row.update(_state_cols(trainer))
         rows.append(row)
-        print("%6d %12.1f %9.0f%%" % (w, sps, 100 * eff))
+        print("%6d %12.1f %9.0f%% %14d %14d" % (
+            w, sps, 100 * eff, row["collective_bytes_per_step"],
+            row["opt_state_bytes_per_device"]))
+    reduction_ab = None
+    widest = max((w for w in widths if w <= n), default=0)
+    if not args.skip_ab and widest > 1:
+        reduction_ab = reduction_ab_leg(
+            widest, args.image_size, args.compression, args.optimizer)
+        print("reduction A/B @ dp=%d: grad-reduce cut %.2fx, "
+              "opt-state/chip = %.4f of total (1/mesh = %.4f)" % (
+                  widest, reduction_ab["grad_reduce_reduction"],
+                  reduction_ab["opt_state_per_device_ratio"], 1 / widest))
     if args.json_out:
         import json
         virtual = jax.default_backend() == "cpu"
@@ -102,16 +199,22 @@ def main():
                 "mode": ("strong (fixed global batch)"
                          if args.global_batch else "weak (per-device batch)"),
                 "platform": jax.default_backend(),
+                "zero": args.zero,
+                "compression": args.compression,
+                "optimizer": args.optimizer,
                 "note": ("virtual mesh on SHARED physical cores: widening "
                          "the mesh adds no silicon, so the ideal here is "
                          "FLAT samples/s (throughput_vs_1dev ~ 1.0 means "
                          "the SPMD partitioning + gradient collectives "
                          "cost ~nothing); efficiency_vs_linear only "
-                         "becomes meaningful on real multi-chip hardware"
+                         "becomes meaningful on real multi-chip hardware. "
+                         "collective/opt-state byte columns are the ring "
+                         "wire model + real shardings (exact everywhere)"
                          if virtual else "hardware mesh"),
                 "reference_analogue":
                     "example/image-classification/README.md:311-319",
-                "rows": rows}, f, indent=1)
+                "rows": rows,
+                "reduction_ab": reduction_ab}, f, indent=1)
 
 
 if __name__ == "__main__":

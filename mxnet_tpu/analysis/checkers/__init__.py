@@ -6,3 +6,4 @@ from . import host_sync         # noqa: F401
 from . import lock_discipline   # noqa: F401
 from . import missing_donation  # noqa: F401
 from . import recompile_hazard  # noqa: F401
+from . import replicated_state  # noqa: F401

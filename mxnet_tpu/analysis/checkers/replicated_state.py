@@ -1,0 +1,121 @@
+"""replicated-state — optimizer-state inits that re-replicate slots.
+
+The ZeRO memory contract (docs/faq/parallel.md) is that optimizer
+slots for mesh-sharded or ZeRO-flattened parameters live in 1/mesh
+shards.  The regression class that silently breaks it is an innocent
+``tree_map(zeros_like, params)`` in an optimizer's ``init`` path: the
+zeros materialize on the default device (or replicated under pjit),
+GSPMD happily keeps them that way, and every chip pays full-state HBM
+again — nothing crashes, the memory win just evaporates.  PR 7 made
+slot allocation routable (``parallel.optimizer.sharded_zeros_like``,
+``init(params, shardings=...)``); this checker keeps future optimizers
+on that path.
+
+Heuristic (all three, so ordinary eager code is never flagged):
+
+- the file is **mesh-aware**: it mentions ``NamedSharding`` /
+  ``PartitionSpec`` / ``pjit`` / ``make_mesh`` — the modules whose
+  allocations end up inside pjit'd programs;
+- the allocation is **state-init-shaped**: a ``tree_map`` whose mapped
+  function is ``zeros_like``/``ones_like``/``full_like`` (bare name,
+  ``jnp.``-style attribute, or a lambda calling one), inside a
+  function whose name says init/state (``init*``, ``*_state``,
+  ``make_state``, ``create_state*``);
+- the enclosing function has **no sharding routing**: it never touches
+  ``sharded_zeros_like`` / ``with_sharding_constraint`` /
+  ``device_put`` / ``NamedSharding`` and takes no
+  ``sharding``/``shardings`` parameter it could route through.
+
+A function that accepts a shardings tree but ignores it for one slot
+still passes — the checker enforces the *pattern* (allocation routed
+through a sharding-aware path), the numbers are enforced by
+``ParallelTrainer.optimizer_state_bytes()`` and its tests.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Finding, register
+
+__all__ = ["ReplicatedStateChecker"]
+
+_MESH_AWARE_RE = re.compile(
+    r"NamedSharding|PartitionSpec|pjit|make_mesh")
+_INIT_NAME_RE = re.compile(
+    r"(^|_)init($|_)|_state($|s$|_)|(^|_)(make|create)_state", re.IGNORECASE)
+_ALLOC_NAMES = frozenset(("zeros_like", "ones_like", "full_like"))
+_ROUTING_NAMES = frozenset((
+    "sharded_zeros_like", "with_sharding_constraint", "device_put",
+    "NamedSharding"))
+_ROUTING_PARAM_RE = re.compile(r"^shardings?$|_shardings?$")
+
+
+def _tail_name(expr):
+    """Trailing identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _is_alloc_fn(expr):
+    """Is ``expr`` (tree_map's first argument) a replicated allocator —
+    ``zeros_like``-ish by name, or a lambda calling one?"""
+    if _tail_name(expr) in _ALLOC_NAMES:
+        return True
+    if isinstance(expr, ast.Lambda):
+        return any(isinstance(n, ast.Call)
+                   and _tail_name(n.func) in _ALLOC_NAMES
+                   for n in ast.walk(expr.body))
+    return False
+
+
+def _has_routing(fn):
+    """Does ``fn`` route allocations through a sharding-aware path?"""
+    args = fn.args
+    params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if any(_ROUTING_PARAM_RE.search(p) for p in params):
+        return True
+    return any(isinstance(n, (ast.Name, ast.Attribute))
+               and _tail_name(n) in _ROUTING_NAMES
+               for n in ast.walk(fn))
+
+
+@register
+class ReplicatedStateChecker(Checker):
+    rule = "replicated-state"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        if tree is None or "tree_map" not in text \
+                or not _MESH_AWARE_RE.search(text):
+            return []
+        out = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _INIT_NAME_RE.search(fn.name):
+                continue
+            if _has_routing(fn):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and _tail_name(node.func) == "tree_map" \
+                        and node.args and _is_alloc_fn(node.args[0]):
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, node.lineno,
+                        "state init %r allocates slots with "
+                        "tree_map(%s, ...) and no sharding routing — "
+                        "under a mesh these zeros materialize replicated "
+                        "and every chip pays full optimizer-state HBM "
+                        "(the ZeRO contract silently evaporates); "
+                        "allocate through parallel.optimizer."
+                        "sharded_zeros_like or accept a shardings tree "
+                        "(docs/faq/parallel.md)"
+                        % (fn.name, _tail_name(node.args[0])
+                           or "zeros_like"),
+                        symbol=fn.name))
+        return out

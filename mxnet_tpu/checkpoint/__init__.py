@@ -31,12 +31,13 @@ from __future__ import annotations
 from .async_ckpt import AsyncCheckpointer, write_checkpoint  # noqa: F401
 from .manager import (CheckpointManager, default_manager,  # noqa: F401
                       sigterm_flag_scope)
-from .state import (TrainState, capture_iter_state,  # noqa: F401
-                    restore_iter_state)
+from .state import (ParallelTrainerState, TrainState,  # noqa: F401
+                    capture_iter_state, restore_iter_state)
 from .store import (CheckpointError, CheckpointStore,  # noqa: F401
                     IntegrityError, RetentionPolicy)
 
 __all__ = ["AsyncCheckpointer", "CheckpointError", "CheckpointManager",
+           "ParallelTrainerState",
            "CheckpointStore", "IntegrityError", "RetentionPolicy",
            "TrainState", "capture_iter_state", "default_manager",
            "restore_iter_state", "sigterm_flag_scope", "write_checkpoint"]

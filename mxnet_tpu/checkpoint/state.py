@@ -38,7 +38,8 @@ import numpy as np
 
 from .. import random as _random
 
-__all__ = ["TrainState", "capture_iter_state", "restore_iter_state"]
+__all__ = ["TrainState", "ParallelTrainerState", "capture_iter_state",
+           "restore_iter_state"]
 
 _ARG_PREFIX = "arg/"
 _AUX_PREFIX = "aux/"
@@ -247,3 +248,120 @@ class TrainState:
                 % (self.epoch, self.nbatch, len(self.arg_params),
                    len(self.aux_params),
                    "yes" if self.optimizer_state is not None else "no"))
+
+
+# ---------------------------------------------------------------------------
+# ParallelTrainer snapshots — mesh-independent logical state
+# ---------------------------------------------------------------------------
+
+_P_PARAM_PREFIX = "param/"
+_P_SLOT_PREFIX = "slot/"
+_P_SCALAR_PREFIX = "scalar/"
+_P_RESID_PREFIX = "resid/"
+
+
+class ParallelTrainerState:
+    """One resumable :class:`~mxnet_tpu.parallel.ParallelTrainer`
+    snapshot in MESH-INDEPENDENT form.
+
+    ``ParallelTrainer.state_dict()`` already flattens its device state
+    to full logical host arrays with optimizer slots stored PER PARAM
+    (ZeRO shard buckets sliced back apart); this class maps that dict
+    onto the store's ``(arrays, blobs, meta)`` vocabulary so the PR 5
+    machinery — atomic directory commit, sha256 manifests, retention,
+    async writer — applies unchanged.  Because nothing in the payload
+    encodes a mesh, fsdp width, ZeRO stage or bucket plan, a restore
+    may land on a trainer with ANY of those changed and the values are
+    bit-identical (reshard-on-restore; seeds ROADMAP item 5)."""
+
+    kind = "parallel_trainer"
+
+    def __init__(self, params, slots, scalars, residuals, meta):
+        self.params = dict(params)       # name -> numpy array
+        self.slots = {s: dict(v) for s, v in slots.items()}
+        self.scalars = dict(scalars)     # slot scalar (e.g. Adam t)
+        self.residuals = dict(residuals)  # name -> numpy array
+        self.meta = dict(meta)
+
+    # -- capture -------------------------------------------------------------
+    @classmethod
+    def capture(cls, trainer):
+        """Host-stage ``trainer`` (one ``device_get`` per array — after
+        this returns, training may proceed while a writer serializes)."""
+        sd = trainer.state_dict()
+        meta = dict(sd["meta"])
+        meta["kind"] = cls.kind
+        return cls(sd["params"], sd["slots"], sd["scalars"],
+                   sd["residuals"], meta)
+
+    # -- store payload -------------------------------------------------------
+    def to_payload(self):
+        """``(arrays, blobs, meta)`` in the store's manifest vocabulary."""
+        arrays = {_P_PARAM_PREFIX + n: v for n, v in self.params.items()}
+        for slot, per_param in self.slots.items():
+            for n, v in per_param.items():
+                arrays["%s%s/%s" % (_P_SLOT_PREFIX, slot, n)] = v
+        for slot, v in self.scalars.items():
+            arrays[_P_SCALAR_PREFIX + slot] = np.asarray(v)
+        for n, v in self.residuals.items():
+            arrays[_P_RESID_PREFIX + n] = v
+        return arrays, {}, self.meta
+
+    @classmethod
+    def from_payload(cls, arrays, blobs, meta):
+        del blobs  # none in this payload kind
+        params, slots, scalars, residuals = {}, {}, {}, {}
+        for key, v in arrays.items():
+            if key.startswith(_P_PARAM_PREFIX):
+                params[key[len(_P_PARAM_PREFIX):]] = v
+            elif key.startswith(_P_SLOT_PREFIX):
+                slot, name = key[len(_P_SLOT_PREFIX):].split("/", 1)
+                slots.setdefault(slot, {})[name] = v
+            elif key.startswith(_P_SCALAR_PREFIX):
+                scalars[key[len(_P_SCALAR_PREFIX):]] = v
+            elif key.startswith(_P_RESID_PREFIX):
+                residuals[key[len(_P_RESID_PREFIX):]] = v
+        return cls(params, slots, scalars, residuals, meta)
+
+    # -- restore -------------------------------------------------------------
+    def as_state_dict(self):
+        return {"params": self.params, "slots": self.slots,
+                "scalars": self.scalars, "residuals": self.residuals,
+                "meta": self.meta}
+
+    def restore_into(self, trainer):
+        trainer.load_state_dict(self.as_state_dict())
+        return self
+
+    @classmethod
+    def restore_latest(cls, store, trainer, step=None):
+        """Restore the newest (or ``step``-specific) trainer snapshot in
+        ``store`` that verifies, walking backwards past bit-rot and
+        payloads of a different kind; returns the restored step id or
+        None.  The trainer's mesh/zero/bucket layout may differ from
+        the captured one — :meth:`restore_into` reshards."""
+        from .store import IntegrityError
+        steps = [step] if step is not None else \
+            list(reversed(store.steps()))
+        for s in steps:
+            try:
+                manifest, arrays, blobs = store.read(s, verify=True)
+            except (IntegrityError, OSError, ValueError) as exc:
+                logging.warning(
+                    "checkpoint: step %d unreadable (%s); trying older",
+                    s, exc)
+                continue
+            meta = manifest.get("meta", {})
+            if meta.get("kind") != cls.kind:
+                logging.warning(
+                    "checkpoint: step %d is %r, not a ParallelTrainer "
+                    "snapshot; skipping", s, meta.get("kind"))
+                continue
+            cls.from_payload(arrays, blobs, meta).restore_into(trainer)
+            logging.info("checkpoint: restored ParallelTrainer step %d", s)
+            return int(s)
+        return None
+
+    def __repr__(self):
+        return ("ParallelTrainerState(params=%d, slots=%s, residuals=%d)"
+                % (len(self.params), sorted(self.slots), len(self.residuals)))

@@ -195,6 +195,31 @@ register_env("MXNET_COMPILE_CACHE_MANIFEST", str, None,
              "its (model, bucket) executor key set there and a "
              "restarted replica replays it so warmup re-binds hit the "
              "persisted executables (docs/faq/compile_cache.md)")
+register_env("MXNET_PARALLEL_BUCKET_BYTES", int, 4194304,
+             "gradient-collective bucket size cap for ParallelTrainer: "
+             "replicated params are fused into flat buckets of at most "
+             "this many bytes so each bucket's reduce can overlap the "
+             "remaining backward (docs/faq/parallel.md); <= 0 puts "
+             "everything in one monolithic bucket")
+register_env("MXNET_PARALLEL_BUCKET_FIRST_BYTES", int, 1048576,
+             "size cap of the FIRST bucket (the output-side params whose "
+             "gradients finish earliest in backward); smaller than "
+             "MXNET_PARALLEL_BUCKET_BYTES so the first collective "
+             "launches as early as possible")
+register_env("MXNET_PARALLEL_ZERO", int, 0,
+             "default ZeRO stage for ParallelTrainer: 0 replicates "
+             "optimizer state (monolithic all-reduce), 1 shards "
+             "optimizer slots 1/mesh (full-gradient all-reduce), 2 also "
+             "reduce-scatters gradients into the shards "
+             "(docs/faq/parallel.md)")
+register_env("MXNET_PARALLEL_COMPRESSION", str, None,
+             "default gradient-compression codec for ParallelTrainer "
+             "bucket reductions: 2bit (reference kvstore quantizer), "
+             "bf16, or fp8 — all with error-feedback residuals carried "
+             "in trainer state; unset sends fp32")
+register_env("MXNET_PARALLEL_COMPRESSION_THRESHOLD", float, 0.5,
+             "quantization threshold of the 2bit codec (reference "
+             "gradient_compression.cc pos/neg threshold)")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

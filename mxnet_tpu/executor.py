@@ -156,11 +156,14 @@ class Executor:
         # (graph_executor.cc:1336) plus server-side update, compiled
         self._fused_update = None   # (one_fn, scalars_fn)
         self._fused_state = None    # list of state tuples per diff arg
+        self._fused_codec = None    # shared gradient-compression codec
+        self._fused_resids = None   # error-feedback residuals (codec on)
         self._jit_fbu = None
         self._updates_applied = False
 
     # -- fused optimizer step ------------------------------------------------
-    def install_fused_update(self, optimizer, param_names=None):
+    def install_fused_update(self, optimizer, param_names=None,
+                             compression_params=None):
         """Fold the optimizer into the compiled train step (kvstore=tpu).
 
         After installation, ``forward(is_train=True)`` on a loss graph
@@ -173,8 +176,18 @@ class Executor:
         Returns False (and installs nothing) for optimizers without a
         fused kernel, or when ``param_names`` is given and some
         differentiable arg is not a parameter (e.g. inputs_need_grad:
-        the optimizer must never be applied to data inputs)."""
+        the optimizer must never be applied to data inputs).
+
+        ``compression_params`` (the ``Module(compression_params=...)`` /
+        ``kvstore.set_gradient_compression`` dict) runs the SAME
+        gradient-compression codec the kvstore push path and
+        ParallelTrainer use inside the compiled step — each gradient is
+        encoded/decoded with an error-feedback residual carried in the
+        fused state, so the reference C-API contract (compression
+        follows the module wherever its update runs) holds on the
+        compiled path too instead of being silently dropped."""
         from . import optimizer as opt_mod
+        from .gradient_compression import make_codec
 
         kernel = opt_mod.fused_update_kernel(optimizer)
         if kernel is None or not self._diff_idx or not self._is_loss_graph:
@@ -194,7 +207,10 @@ class Executor:
         for nd, c in zip(nds, copies):
             nd._data = c
         self._fused_update = (optimizer, kernel[0], kernel[1])
+        self._fused_codec = make_codec(**dict(compression_params)) \
+            if compression_params else None
         self._fused_state = None
+        self._fused_resids = None
         self._jit_fbu = None
         self._updates_applied = False
         return True
@@ -209,8 +225,9 @@ class Executor:
         diff_idx = tuple(self._diff_idx)
         fn_train, _cast = self._fn_train, self._cast_fn
         one = self._fused_update[2]
+        codec = getattr(self, "_fused_codec", None)
 
-        def fbu(diff, rest, aux, key_data, seeds, states, lrs, wds):
+        def fbu(diff, rest, aux, key_data, seeds, states, resids, lrs, wds):
             # the key chain crosses the program boundary as RAW uint32
             # data: the tunnel backend mishandles extended-dtype (typed
             # PRNG key) arrays fed back as inputs
@@ -225,6 +242,19 @@ class Executor:
 
             outs, vjp_fn, new_aux = _jax.vjp(f, list(diff), has_aux=True)
             (grads,) = vjp_fn(tuple(seeds))
+            # gradient compression INSIDE the compiled step: the same
+            # codec roundtrip the kvstore push path applies, with the
+            # error-feedback residual carried across steps in the fused
+            # state — Module(compression_params=...) numerics are
+            # identical whether the update runs eagerly or compiled
+            new_resids = resids
+            if codec is not None:
+                decoded, new_resids = [], []
+                for g, r in zip(grads, resids):
+                    d, nr = codec.roundtrip(g.astype(jnp.float32), r)
+                    decoded.append(d.astype(g.dtype))
+                    new_resids.append(nr)
+                grads = decoded
             new_diff, new_states = [], []
             # lrs/wds are ONE packed (n,) array each — per-scalar host
             # transfers would dominate the step on a tunneled device
@@ -240,12 +270,13 @@ class Executor:
             # step i emitted (device-closed chain — the tunnel backend
             # rejects new host transfers while a program is in flight).
             new_key = _jax.random.fold_in(key, 1)
-            return (list(outs), new_diff, new_states, new_aux,
+            return (list(outs), new_diff, new_states, new_resids, new_aux,
                     _jax.random.key_data(new_key))
 
-        # donate weights + optimizer state (exclusively owned: the arg
-        # NDArrays are rebound to the outputs right after the call)
-        return _jax.jit(fbu, donate_argnums=(0, 5))
+        # donate weights + optimizer state + compression residuals
+        # (exclusively owned: the arg NDArrays are rebound to the
+        # outputs right after the call)
+        return _jax.jit(fbu, donate_argnums=(0, 5, 6))
 
     def _forward_fused(self, args, aux, key):
         from . import optimizer as opt_mod
@@ -259,6 +290,12 @@ class Executor:
         rest = [None if i in diff_set else a for i, a in enumerate(args)]
         if self._fused_state is None:
             self._fused_state = [init_state(d) for d in diff]
+        if self._fused_resids is None:
+            # error-feedback residuals, one per weight when a codec is
+            # installed (empty pytree otherwise: ONE program shape)
+            self._fused_resids = [
+                jnp.zeros(d.shape, jnp.float32) for d in diff] \
+                if getattr(self, "_fused_codec", None) is not None else []
         lrs, wds = [], []
         for i in self._diff_idx:
             lr, wd = opt_mod.fused_lr_wd(optimizer, self.arg_names[i])
@@ -284,12 +321,14 @@ class Executor:
         if self._jit_fbu is None:
             self._jit_fbu = self._build_fbu()
         self._replay_key_data = key_dev  # for backward(out_grads) replay
-        outs, new_diff, new_states, new_aux, new_key = \
+        outs, new_diff, new_states, new_resids, new_aux, new_key = \
             self._dispatch_compiled(
                 "fbu", self._jit_fbu, diff, diff, rest, aux, key_dev,
-                seeds, self._fused_state, lrs_dev, wds_dev)
+                seeds, self._fused_state, self._fused_resids,
+                lrs_dev, wds_dev)
         self._fused_key = new_key
         self._fused_state = new_states
+        self._fused_resids = new_resids
         for j, i in enumerate(self._diff_idx):
             self.arg_dict[self.arg_names[i]]._data = new_diff[j]
         self._cached_grads = None

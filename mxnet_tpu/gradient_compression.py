@@ -1,4 +1,4 @@
-"""2-bit gradient compression with error feedback.
+"""Gradient compression with error feedback — the shared codec layer.
 
 Reference: ``src/kvstore/gradient_compression.cc:52`` — each gradient
 element plus its residual is quantized to {-threshold, 0, +threshold}
@@ -6,12 +6,29 @@ encoded in 2 bits (16 values per uint32 word), and the quantization
 error feeds back into the next step's residual, so the compressed
 stream is unbiased over time.
 
-TPU-native: quantize/dequantize are jitted XLA programs; the packed
-uint32 payload is what a bandwidth-limited collective would move (the
-kvstore path compresses, exchanges, and decompresses — numerics match
-the reference's worker-side compression exactly; on ICI the XLA
-collective itself still moves fp32 unless a custom all-reduce is built
-over the packed words).
+TPU-native: every codec here is a pair of PURE jax functions, so the
+same kernels serve three call sites — the eager kvstore push path
+(:class:`GradientCompression`, reference worker-side compression), the
+executor's fused train step (``install_fused_update(compression_params=
+...)``), and ``ParallelTrainer``'s bucketed collective path — one
+numeric contract everywhere (the reference routes Module/kvstore/dist
+through one ``GradientCompression`` object for the same reason).
+
+Codecs:
+
+- ``2bit``   — the reference quantizer: {-t, 0, +t} packed 16/uint32
+  word.  The packed payload is what a bandwidth-limited collective
+  would move (16x fp32); inside a compiled step the reduce itself still
+  moves the decoded values unless the collective is built over the
+  packed words, so byte accounting for this codec is the *modeled*
+  wire cost (docs/faq/parallel.md).
+- ``bf16`` / ``fp8`` — cast codecs (2x / 4x).  Their payload is a real
+  jax array of the wire dtype, so a sharding constraint placed on the
+  payload makes the actual XLA collective ride the narrow type.
+
+All codecs carry an error-feedback residual: ``decode(encode(g + r))``
+plus ``r' = g + r - decoded`` — quantization error is re-injected next
+step, which is what makes 2bit/fp8 training converge.
 """
 from __future__ import annotations
 
@@ -19,7 +36,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["GradientCompression"]
+from .base import MXNetError
+
+__all__ = ["GradientCompression", "make_codec", "TwoBitCodec", "CastCodec"]
 
 
 def _quantize_2bit(grad, residual, threshold):
@@ -48,36 +67,148 @@ def _dequantize_2bit(packed, shape, threshold):
                          jnp.float32)
 
 
-class GradientCompression:
-    """Per-key 2-bit compressor with residual state (reference:
-    GradientCompression::Quantize/Dequantize, gradient_compression.cc)."""
+class TwoBitCodec:
+    """The reference 2-bit quantizer as a pure codec (16x fp32)."""
 
-    def __init__(self, type="2bit", threshold=0.5):
-        if type != "2bit":
-            raise ValueError("supported compression type: 2bit, got %r"
-                             % type)
-        self.type = type
+    name = "2bit"
+
+    def __init__(self, threshold=0.5):
         self.threshold = float(threshold)
-        self._residual = {}
-        self._q = jax.jit(_quantize_2bit, static_argnums=())
-        self._dq = jax.jit(_dequantize_2bit, static_argnums=(1,))
+
+    def encode(self, grad, residual):
+        """``(grad, residual) -> (payload, decoded, new_residual)`` —
+        all pure, traceable inside a compiled step."""
+        packed, new_residual = _quantize_2bit(
+            grad.astype(jnp.float32), residual,
+            jnp.float32(self.threshold))
+        decoded = _dequantize_2bit(packed, grad.shape,
+                                   jnp.float32(self.threshold))
+        return packed, decoded, new_residual
+
+    def decode(self, payload, shape):
+        return _dequantize_2bit(payload, tuple(shape),
+                                jnp.float32(self.threshold))
+
+    def roundtrip(self, grad, residual):
+        """``(decoded, new_residual)`` — the end-to-end transform a
+        gradient undergoes on a compressed exchange."""
+        _, decoded, new_residual = self.encode(grad, residual)
+        return decoded, new_residual
+
+    def wire_bytes(self, n_elems):
+        """Modeled on-wire payload bytes for ``n_elems`` gradients."""
+        return 4 * ((int(n_elems) + 15) // 16)
 
     def get_params(self):
-        return {"type": self.type, "threshold": self.threshold}
+        return {"type": self.name, "threshold": self.threshold}
 
-    def compress(self, key, grad):
-        """grad (jax array) -> packed uint32 words; residual updates."""
+
+def _fp8_dtype():
+    dt = getattr(jnp, "float8_e4m3fn", None)
+    if dt is None:
+        raise MXNetError(
+            "fp8 gradient compression needs jnp.float8_e4m3fn "
+            "(jax/ml_dtypes too old); use bf16 or 2bit")
+    return dt
+
+
+class CastCodec:
+    """bf16/fp8 cast codec with error feedback.
+
+    Unlike 2bit, the payload is an ordinary jax array of the wire
+    dtype: a sharding constraint on the payload makes the compiled
+    collective itself move the narrow type."""
+
+    def __init__(self, name, dtype):
+        self.name = name
+        self.dtype = dtype
+
+    def encode(self, grad, residual):
+        g = grad.astype(jnp.float32) + residual
+        payload = g.astype(self.dtype)
+        decoded = payload.astype(jnp.float32)
+        return payload, decoded, g - decoded
+
+    def decode(self, payload, shape):
+        return payload.astype(jnp.float32).reshape(tuple(shape))
+
+    def roundtrip(self, grad, residual):
+        _, decoded, new_residual = self.encode(grad, residual)
+        return decoded, new_residual
+
+    def wire_bytes(self, n_elems):
+        return int(n_elems) * jnp.dtype(self.dtype).itemsize
+
+    def get_params(self):
+        return {"type": self.name}
+
+
+def make_codec(type="2bit", threshold=0.5):
+    """Codec by name — the ONE registry every compression call site
+    (kvstore push, fused executor step, ParallelTrainer buckets) shares."""
+    if type in (None, "", "none"):
+        return None
+    if type == "2bit":
+        return TwoBitCodec(threshold=threshold)
+    if type in ("bf16", "bfloat16"):
+        return CastCodec("bf16", jnp.bfloat16)
+    if type == "fp8":
+        return CastCodec("fp8", _fp8_dtype())
+    raise MXNetError("unknown gradient compression type %r "
+                     "(supported: 2bit, bf16, fp8)" % (type,))
+
+
+class GradientCompression:
+    """Per-key stateful compressor over the shared codecs (reference:
+    GradientCompression::Quantize/Dequantize, gradient_compression.cc).
+
+    The eager front the kvstore push path uses: residuals are keyed by
+    parameter name and carried across pushes."""
+
+    def __init__(self, type="2bit", threshold=0.5):
+        self._codec = make_codec(type, threshold=threshold)
+        if self._codec is None:
+            raise ValueError("GradientCompression needs a codec type, "
+                             "got %r" % (type,))
+        self.type = self._codec.name
+        self.threshold = float(threshold)
+        self._residual = {}
+        self._rt = jax.jit(self._codec.roundtrip)
+
+        def _enc(grad, res):
+            payload, _, new_res = self._codec.encode(grad, res)
+            return payload, new_res
+
+        # payload-only compile: the unused decode half of encode() is
+        # dead code under jit, so the push path pays quantize alone
+        self._enc = jax.jit(_enc)
+
+    @property
+    def codec(self):
+        return self._codec
+
+    def get_params(self):
+        return self._codec.get_params()
+
+    def _res(self, key, grad):
         res = self._residual.get(key)
         if res is None or res.shape != grad.shape:
             res = jnp.zeros(grad.shape, jnp.float32)
-        packed, new_res = self._q(grad.astype(jnp.float32), res,
-                                  jnp.float32(self.threshold))
+        return res
+
+    def compress(self, key, grad):
+        """grad (jax array) -> wire payload; residual updates."""
+        payload, new_res = self._enc(grad.astype(jnp.float32),
+                                     self._res(key, grad))
         self._residual[key] = new_res
-        return packed
+        return payload
 
     def decompress(self, packed, shape):
-        return self._dq(packed, tuple(shape), jnp.float32(self.threshold))
+        return self._codec.decode(packed, shape)
 
     def compress_decompress(self, key, grad):
         """The end-to-end transform a worker's gradient undergoes."""
-        return self.decompress(self.compress(key, grad), grad.shape)
+        decoded, new_res = self._rt(grad.astype(jnp.float32),
+                                    self._res(key, grad))
+        self._residual[key] = new_res
+        return decoded

@@ -15,7 +15,24 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 
-__all__ = ["PureSGD", "PureAdam", "make_optimizer"]
+__all__ = ["PureSGD", "PureAdam", "make_optimizer", "sharded_zeros_like"]
+
+
+def sharded_zeros_like(params, shardings):
+    """ZeRO-aware slot allocation: each slot is created and immediately
+    placed by its entry in the ``shardings`` tree (``None`` entries and
+    a ``None`` tree fall back to the param's own layout).  Optimizer
+    ``init`` paths route through here so a slot for a mesh-sharded (or
+    ZeRO-flattened) parameter never materializes replicated — the
+    regression class graftlint's ``replicated-state`` checker flags."""
+    if shardings is None:
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def _zeros(p, s):
+        z = jnp.zeros(p.shape, p.dtype)
+        return z if s is None else jax.device_put(z, s)
+
+    return jax.tree_util.tree_map(_zeros, params, shardings)
 
 
 class PureSGD:
@@ -29,10 +46,14 @@ class PureSGD:
         self.rescale_grad = rescale_grad
         self.clip_gradient = clip_gradient
 
-    def init(self, params):
+    def init(self, params, shardings=None):
+        """Slot state for ``params``; with ``shardings`` (a matching
+        tree of ``NamedSharding``) each slot is allocated pre-sharded —
+        the ZeRO-1/2 memory contract (1/mesh per chip), not a
+        replicated tree that GSPMD later reshards."""
         if self.momentum == 0.0:
             return {}
-        return {"mom": jax.tree_util.tree_map(jnp.zeros_like, params)}
+        return {"mom": sharded_zeros_like(params, shardings)}
 
     def apply(self, params, grads, state, lr=None):
         lr = self.lr if lr is None else lr
@@ -70,10 +91,11 @@ class PureAdam:
         self.rescale_grad = rescale_grad
         self.clip_gradient = clip_gradient
 
-    def init(self, params):
-        z = jax.tree_util.tree_map(jnp.zeros_like, params)
-        return {"mean": z,
-                "var": jax.tree_util.tree_map(jnp.zeros_like, params),
+    def init(self, params, shardings=None):
+        """See :meth:`PureSGD.init` — slots pre-sharded when a
+        ``shardings`` tree is given (ZeRO state placement)."""
+        return {"mean": sharded_zeros_like(params, shardings),
+                "var": sharded_zeros_like(params, shardings),
                 "t": jnp.zeros((), jnp.int32)}
 
     def apply(self, params, grads, state, lr=None):

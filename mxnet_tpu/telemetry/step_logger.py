@@ -32,6 +32,8 @@ _DELTA_METRICS = (
     "mxnet_kvstore_ops_total",
     "mxnet_kvstore_bytes_total",
     "mxnet_io_batches_total",
+    "mxnet_collective_ops_total",
+    "mxnet_collective_bytes_total",
 )
 
 

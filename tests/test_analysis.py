@@ -363,6 +363,72 @@ def test_missing_donation_conditional_donate_passes(tmp_path):
     assert findings == []
 
 
+# -- replicated-state --------------------------------------------------------
+
+def test_replicated_state_flags_unrouted_init(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        class Opt:
+            def init(self, params):
+                return {"mom": jax.tree_util.tree_map(jnp.zeros_like,
+                                                      params)}
+
+        def make_state(params):
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p), params)
+    """, "replicated-state")
+    assert sorted(f.symbol for f in findings) == ["init", "make_state"]
+    assert all("sharded_zeros_like" in f.message for f in findings)
+
+
+def test_replicated_state_good_patterns_stay_silent(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        def sharded_zeros_like(params, shardings):
+            return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        class Opt:
+            # routed: takes a shardings tree
+            def init(self, params, shardings=None):
+                return {"mom": jax.tree_util.tree_map(jnp.zeros_like,
+                                                      params)}
+
+        class Opt2:
+            # routed: allocates through the sharding-aware helper
+            def init(self, params):
+                return {"mom": sharded_zeros_like(params, None)}
+
+        def apply_update(params, grads):
+            # not init-shaped: updates may build scratch zeros freely
+            return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        class Opt3:
+            # suppressed variant: the inline comment wins
+            def init(self, params):
+                return jax.tree_util.tree_map(jnp.zeros_like, params)  # graftlint: disable=replicated-state
+    """, "replicated-state")
+    assert findings == []
+
+
+def test_replicated_state_ignores_eager_modules(tmp_path):
+    # no NamedSharding/pjit/make_mesh in the file: single-device
+    # optimizers allocate however they like
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+        import jax.numpy as jnp
+
+        def init(params):
+            return jax.tree_util.tree_map(jnp.zeros_like, params)
+    """, "replicated-state")
+    assert findings == []
+
+
 # -- c-api-contract ----------------------------------------------------------
 
 _CPP_BAD = """

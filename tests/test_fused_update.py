@@ -148,6 +148,83 @@ def test_parallel_trainer_bf16():
     assert all(v.dtype == np.float32 for v in tr.params.values())
 
 
+def test_module_compression_reaches_fused_step():
+    """Module(compression_params=...) must run the codec INSIDE the
+    compiled fused step (the reference C-API contract: compression
+    follows the module wherever its update runs), matching the eager
+    kvstore push path's numerics — the same shared kernels."""
+    sym = _toy_symbol()
+    x, y = _toy_data()
+
+    def train(kv, comp):
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(sym, context=mx.cpu(),
+                            compression_params=comp)
+        mod.fit(it, num_epoch=2, kvstore=kv,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                initializer=mx.init.Xavier(), force_init=True,
+                force_rebind=True)
+        exe = mod._exec_group.execs[0]
+        args, _ = mod.get_params()
+        return ({k: v.asnumpy() for k, v in args.items()},
+                getattr(exe, "_fused_codec", None))
+
+    comp = {"type": "bf16"}
+    w_fused, codec = train("tpu", comp)
+    assert codec is not None and codec.name == "bf16", \
+        "compression_params did not reach the compiled step"
+    # bf16 here rather than 2bit: compiled vs eager gradient noise
+    # (~1e-7) near a 2bit threshold would flip a whole +-t decision;
+    # a bf16 cast moves at most one ulp (2^-8 relative), which bounds
+    # the tolerance below
+    w_eager, _ = train("local", comp)
+    for k in w_fused:
+        np.testing.assert_allclose(w_fused[k], w_eager[k], rtol=2e-3,
+                                   atol=5e-5, err_msg=k)
+    # and the codec measurably changes training vs uncompressed
+    w_plain, none_codec = train("tpu", None)
+    assert none_codec is None
+    assert any(np.abs(w_fused[k] - w_plain[k]).max() > 0
+               for k in w_fused), "codec installed but inert"
+
+
+def test_module_2bit_compression_trains():
+    """The reference 2bit quantizer inside the fused step: error
+    feedback converges on the same well-conditioned regression the
+    trainer-level test proves (a multi-class toy with sub-threshold
+    gradients can collapse under +-t steps — that is the quantizer's
+    nature, not a routing bug)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    Y = (X @ w_true).astype(np.float32)
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=1, no_bias=True,
+                               name="fc")
+    sym = mx.sym.LinearRegressionOutput(fc, mx.sym.var("lro_label"),
+                                        name="lro")
+    it = mx.io.NDArrayIter(X, Y, batch_size=64, shuffle=False,
+                           label_name="lro_label")
+    # the codec sees the PRE-rescale (batch-summed) gradient — the
+    # reference kvstore compresses pushes before the optimizer's
+    # rescale_grad — so the threshold scales with batch size:
+    # 0.5 * 64 here is the trainer-level test's threshold=0.5 dynamics
+    mod = mx.mod.Module(sym, context=mx.cpu(), label_names=("lro_label",),
+                        compression_params={"type": "2bit",
+                                            "threshold": 32.0})
+    mod.fit(it, num_epoch=250, kvstore="tpu",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Zero(), eval_metric="mse")
+    exe = mod._exec_group.execs[0]
+    assert getattr(exe, "_fused_codec", None) is not None
+    assert exe._fused_resids, "error-feedback residuals not carried"
+    got = mod.get_params()[0]["fc_weight"].asnumpy().T
+    assert np.abs(got - w_true).max() < 0.05, got
+
+
 def test_accuracy_device_accumulation():
     """Accuracy over NDArrays accumulates lazily on device; get() syncs
     and returns the right value."""
