@@ -25,9 +25,11 @@ from __future__ import annotations
 from .baseline import default_path, filter_new, load, save
 from .core import (Checker, Finding, checkers, iter_source_files,
                    register, repo_root, rule_ids, run)
-from .reporters import human_report, json_report
+from .project import ProjectIndex, summarize
+from .reporters import human_report, json_report, sarif_report
 
-__all__ = ["Checker", "Finding", "checkers", "default_path",
-           "filter_new", "human_report", "iter_source_files",
-           "json_report", "load", "register", "repo_root", "rule_ids",
-           "run", "save"]
+__all__ = ["Checker", "Finding", "ProjectIndex", "checkers",
+           "default_path", "filter_new", "human_report",
+           "iter_source_files", "json_report", "load", "register",
+           "repo_root", "rule_ids", "run", "sarif_report", "save",
+           "summarize"]

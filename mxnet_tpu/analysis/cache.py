@@ -1,0 +1,159 @@
+"""Incremental analysis cache — re-lint only what changed.
+
+The expensive part of a graftlint run is per-file: parsing, the
+checker AST walks, and the project summarization.  All of it is a pure
+function of (file content, analysis code, registry/doc surface), so
+the cache stores, per file and keyed by content hash:
+
+- the project summary (``project.summarize`` output — what the
+  ``ProjectIndex`` links);
+- the per-file checker findings (as ``Finding.to_dict()`` entries);
+- the suppression tables.
+
+A warm no-change run therefore only hashes bytes, loads one JSON file,
+and re-runs the (cheap, summary-driven) interprocedural passes — the
+``tools/lint.py --changed`` mode and the tier-1 lint gate ride this.
+
+Invalidation is deliberately blunt and therefore sound:
+
+- ``engine``: a digest of the analysis package's own sources — ANY
+  change to a checker or the summarizer drops the whole cache;
+- ``root_state``: a digest of ``config.py`` + ``docs/faq/env_var.md``
+  (the external surfaces env-knob-drift reads) — editing either drops
+  the whole cache;
+- per entry, the file's sha256 — editing a file drops that entry.
+
+The file format is versioned (``CACHE_VERSION``) and the file itself
+lives untracked at ``<root>/.graftlint-cache.json`` (gitignored);
+deleting it is always safe.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .project import SUMMARY_VERSION
+
+__all__ = ["CACHE_NAME", "CACHE_VERSION", "AnalysisCache", "default_path",
+           "engine_digest", "root_state_digest"]
+
+CACHE_NAME = ".graftlint-cache.json"
+CACHE_VERSION = 1
+
+_ENGINE_DIGEST = None
+
+
+def default_path(root):
+    return os.path.join(root, CACHE_NAME)
+
+
+def engine_digest():
+    """Digest of the analysis package's own source files — any edit to
+    the engine or a checker invalidates every cached result."""
+    global _ENGINE_DIGEST
+    if _ENGINE_DIGEST is not None:
+        return _ENGINE_DIGEST
+    h = hashlib.sha256()
+    h.update(("v%d/s%d" % (CACHE_VERSION, SUMMARY_VERSION)).encode())
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                h.update(name.encode())
+                with open(os.path.join(dirpath, name), "rb") as f:
+                    h.update(f.read())
+    _ENGINE_DIGEST = h.hexdigest()[:16]
+    return _ENGINE_DIGEST
+
+
+def root_state_digest(root):
+    """Digest of the cross-file surfaces per-file findings depend on
+    (the env-knob registry and its doc table)."""
+    h = hashlib.sha256()
+    for rel in (os.path.join("mxnet_tpu", "config.py"),
+                os.path.join("docs", "faq", "env_var.md")):
+        p = os.path.join(root, rel)
+        h.update(rel.encode())
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()[:16]
+
+
+class AnalysisCache:
+    """One run's view of the on-disk cache.  ``lookup`` / ``store`` by
+    repo-relative path + content sha; ``save`` writes atomically."""
+
+    def __init__(self, path, root):
+        self.path = path
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        self._entries = {}
+        self._project = {}
+        stamp = {"engine": engine_digest(),
+                 "root_state": root_state_digest(root)}
+        self._stamp = stamp
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if (isinstance(data, dict)
+                    and data.get("version") == CACHE_VERSION
+                    and data.get("engine") == stamp["engine"]
+                    and data.get("root_state") == stamp["root_state"]):
+                self._entries = data.get("entries", {})
+                self._project = data.get("project", {})
+        except (OSError, ValueError):
+            pass
+
+    def lookup(self, relpath, digest):
+        e = self._entries.get(relpath)
+        if e is not None and e.get("sha") == digest:
+            self.hits += 1
+            return e
+        self.misses += 1
+        return None
+
+    def project_findings(self, tree_digest):
+        """The whole-program pass output for an UNCHANGED tree — the
+        interprocedural findings are a pure function of the summaries,
+        so a no-change run can skip linking entirely."""
+        if self._project.get("tree") == tree_digest:
+            return self._project.get("findings")
+        return None
+
+    def store_project(self, tree_digest, findings):
+        self._project = {"tree": tree_digest, "findings": findings}
+        self._dirty = True
+
+    def store(self, relpath, digest, summary, findings, suppressions):
+        self._entries[relpath] = {
+            "sha": digest,
+            "summary": summary,
+            "findings": findings,
+            "suppressions": suppressions,
+        }
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        tmp = self.path + ".tmp.%d" % os.getpid()
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": CACHE_VERSION,
+                           "engine": self._stamp["engine"],
+                           "root_state": self._stamp["root_state"],
+                           "entries": self._entries,
+                           "project": self._project},
+                          f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

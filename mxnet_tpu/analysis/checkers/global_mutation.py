@@ -1,0 +1,87 @@
+"""unguarded-global-mutation — module-level mutable state written
+without a lock from thread-reachable code.
+
+``lock-discipline`` (PR 4) enforces the ``# guarded-by:`` annotation
+where one exists; this checker finds the state that never got one.  A
+module-level ``list``/``dict``/``set``/``deque`` mutated from code the
+engine proves reachable by a worker thread — a ``threading.Thread``
+target, or anything called inside an ``engine.worker_scope`` block
+(the serving batcher, the async checkpointer, prefetch producers) — is
+a data race against the main thread unless some lock is held at the
+mutation site.  These are exactly the PR 3 ``Counter`` races *before*
+anyone thought to annotate them.
+
+Held-lock detection is deliberately loose (any ``with`` over a name
+matching lock/cv/cond/mutex/sem, or a ``*_locked`` function): the goal
+is the missing-lock class, not lock-identity proofs — that precision
+belongs to ``lock-discipline`` once the annotation exists, which is
+what the finding message asks for.
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["GlobalMutationChecker"]
+
+
+@register
+class GlobalMutationChecker(Checker):
+    rule = "unguarded-global-mutation"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []   # whole-program rule: see check_project
+
+    def _decl_for(self, index, fq, parts):
+        """(module, name, decl) for a mutation target resolving to a
+        module-level mutable, else (None, None, None)."""
+        if parts[0] == "self":
+            return None, None, None     # lock-discipline's domain
+        mod = index.fn_mod[fq]
+        if len(parts) == 1:
+            decl = index.mods[mod]["globals_mut"].get(parts[0])
+            return mod, parts[0], decl
+        target = index.mods[mod]["imports"].get(parts[0])
+        if target in index.mods and len(parts) == 2:
+            decl = index.mods[target]["globals_mut"].get(parts[1])
+            return target, parts[1], decl
+        return None, None, None
+
+    def check_project(self, index, ctx):
+        from ..project import _LOCKISH_RE
+        out = []
+        for fq in sorted(index.fns):
+            rec = index.fns[fq]
+            if not rec["gmuts"]:
+                continue
+            threaded_via = index.threaded.get(fq)
+            symbol = fq.split(":", 1)[1]
+            if symbol.rsplit(".", 1)[-1].endswith("_locked"):
+                continue
+            for site in rec["gmuts"]:
+                # reachable as thread code, or lexically inside a
+                # worker_scope block
+                if threaded_via is None and not site["ws"]:
+                    continue
+                if any(_LOCKISH_RE.search(l) for l in site["locks"]):
+                    continue
+                mod, name, decl = self._decl_for(index, fq,
+                                                 site["parts"])
+                if decl is None or decl["guarded"]:
+                    continue    # unknown target, or lock-discipline's
+                spawn = ("worker_scope block" if site["ws"]
+                         and threaded_via is None
+                         else "thread spawned via %s"
+                         % threaded_via.split(":", 1)[1])
+                out.append(Finding(
+                    self.rule, self.severity, index.fn_file[fq],
+                    site["line"],
+                    "%s of module-level mutable %r without a lock, on "
+                    "a thread-reachable path (%s) — worker threads "
+                    "race the main thread here; take a lock and "
+                    "declare it with '# guarded-by: <lock>' "
+                    "(docs/faq/static_analysis.md)"
+                    % (site["what"], name, spawn),
+                    symbol=symbol))
+        return out

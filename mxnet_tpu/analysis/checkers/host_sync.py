@@ -1,20 +1,34 @@
-"""host-sync — device-to-host syncs in per-step hot paths.
+"""host-sync — device-to-host syncs on the per-step hot path.
 
 On TPU the silent step-time killer is a device->host transfer inside
 the training or serving loop: each ``.asnumpy()`` / ``.asscalar()`` /
-``.item()`` blocks on the XLA stream and round-trips HBM->host (the
-runtime counts them after the fact as ``mxnet_transfer_d2h_total`` —
-``docs/faq/telemetry.md``; this checker is the compile-time
-counterpart).  Two triggers:
+``.item()`` / ``.wait_to_read()`` blocks on the XLA stream and
+round-trips HBM->host (the runtime counts them after the fact as
+``mxnet_transfer_d2h_total`` — ``docs/faq/telemetry.md``; this checker
+is the compile-time counterpart).
 
-- inside a designated HOT function (the module fit loop, the serving
-  batch path, optimizer ``update``) any sync call is flagged;
-- anywhere else in a designated hot MODULE, a sync call inside a
-  ``for``/``while`` loop is flagged (one sync per iteration).
+Hot-ness is *derived*, not declared: the whole-program engine
+(``analysis/project.py``) finds every loop that transitively
+dispatches a jit-compiled program (the step loop in ``fit``, the
+serving batcher's ``while True``, a benchmark's batch sweep) and marks
+the functions those loops call — to any call depth — as the per-step
+hot path.  The old PR 4 name lists (``fit``/``_execute``/``update``)
+are gone: a sync three frames below the compiled step is a finding at
+the offending line, with the witness call chain in the message.
 
-``np.asarray(x)`` on a bare name is flagged only in HOT functions: on
-an NDArray it funnels through ``__array__`` -> ``asnumpy`` — the same
-sync wearing numpy clothing.
+Three site classes:
+
+- inside a **hot function** (transitively called from a dispatching
+  loop): every sync call is per-step cost — flagged;
+- inside the **dispatching loop itself** (the step driver): sync calls
+  within the loop are flagged (outside the loop is setup/teardown);
+- inside the **jit-traced region**: a sync there concretizes the
+  tracer — flagged with the region noted.
+
+``np.asarray(x)`` on a bare name is ambiguous (h2d on host data, d2h
+on NDArrays) and is therefore only FLAGGED inside a loop of a hot
+function — one-shot staging converts host data once (trusted as h2d),
+a per-iteration conversion is the d2h-suspicious pattern.
 
 Deliberate syncs (the batcher's result delivery, warmup's
 compile-forcing fetch) are suppressed inline or carried in the
@@ -23,49 +37,9 @@ committed baseline — both are documented in
 """
 from __future__ import annotations
 
-import ast
-
 from ..core import Checker, Finding, register
 
-__all__ = ["HostSyncChecker", "HOT_FUNCTIONS", "HOT_MODULES"]
-
-# (path suffix, function name): any sync inside is per-step cost
-HOT_FUNCTIONS = (
-    ("module/base_module.py", "fit"),
-    ("module/base_module.py", "forward_backward"),
-    ("module/base_module.py", "score"),
-    ("serving/server.py", "_execute"),
-    ("serving/server.py", "_worker"),
-    ("serving/server.py", "_collect_batch"),
-    ("optimizer.py", "update"),
-    ("optimizer.py", "update_multi_precision"),
-)
-
-# path suffixes where a sync inside any loop is flagged
-HOT_MODULES = (
-    "module/base_module.py",
-    "module/module.py",
-    "module/executor_group.py",
-    "serving/server.py",
-    "optimizer.py",
-)
-
-_SYNC_ATTRS = frozenset(("asnumpy", "asscalar", "item", "wait_to_read"))
-
-
-def _sync_call(node):
-    """(kind, spelled) when ``node`` is a sync call, else None."""
-    if not isinstance(node, ast.Call):
-        return None
-    func = node.func
-    if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
-        return func.attr, ".%s()" % func.attr
-    if (isinstance(func, ast.Attribute) and func.attr == "asarray"
-            and isinstance(func.value, ast.Name)
-            and func.value.id in ("np", "numpy", "_np", "onp", "_onp")
-            and node.args and isinstance(node.args[0], ast.Name)):
-        return "asarray", "np.asarray(%s)" % node.args[0].id
-    return None
+__all__ = ["HostSyncChecker"]
 
 
 @register
@@ -75,63 +49,45 @@ class HostSyncChecker(Checker):
     suffixes = (".py",)
 
     def check(self, path, relpath, text, tree, ctx):
-        rel = relpath.replace("\\", "/")
-        hot_funcs = {fn for suffix, fn in HOT_FUNCTIONS
-                     if rel.endswith(suffix)}
-        hot_module = any(rel.endswith(s) for s in HOT_MODULES)
-        if tree is None or (not hot_funcs and not hot_module):
-            return []
+        return []   # whole-program rule: see check_project
 
+    def check_project(self, index, ctx):
         out = []
-
-        def scan(func, in_hot_func):
-            loop_depth = [0]
-
-            def visit(node):
-                # nested defs get their own scan pass (hot_defs below)
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    return
-                is_loop = isinstance(node, (ast.For, ast.While))
-                if is_loop:
-                    loop_depth[0] += 1
-                sync = _sync_call(node)
-                if sync is not None:
-                    kind, spelled = sync
-                    # np.asarray is ambiguous (h2d on host data, d2h on
-                    # NDArrays) — only trust it in designated hot funcs
-                    flag = in_hot_func or (loop_depth[0] > 0
-                                           and kind != "asarray")
-                    if flag:
-                        where = ("hot path" if in_hot_func
-                                 else "loop in hot module")
-                        out.append(Finding(
-                            self.rule, self.severity, relpath, node.lineno,
-                            "%s forces a device->host sync in a %s — "
-                            "each call blocks the XLA stream and "
-                            "round-trips HBM (runtime counterpart: "
-                            "mxnet_transfer_d2h_total)"
-                            % (spelled, where),
-                            symbol=func.name))
-                for child in ast.iter_child_nodes(node):
-                    visit(child)
-                if is_loop:
-                    loop_depth[0] -= 1
-
-            for stmt in func.body:
-                visit(stmt)
-
-        # hot-ness is inherited by enclosure: a closure defined inside a
-        # hot function still runs per step
-        hot_defs = set()
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name in hot_funcs:
-                for sub in ast.walk(node):
-                    if isinstance(sub, (ast.FunctionDef,
-                                        ast.AsyncFunctionDef)):
-                        hot_defs.add(id(sub))
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scan(node, id(node) in hot_defs)
+        for fq in sorted(index.fns):
+            rec = index.fns[fq]
+            if not rec["sync"]:
+                continue
+            hot = index.hot.get(fq)
+            driver_line = index.drivers.get(fq)
+            if hot is None and driver_line is None:
+                continue
+            symbol = fq.split(":", 1)[1]
+            for site in rec["sync"]:
+                if hot is not None:
+                    if site["kind"] == "asarray" and site["loop"] == 0:
+                        continue    # one-shot staging, not per-element
+                    if hot[1] == "jit-region":
+                        where = ("inside the jit-compiled region"
+                                 if fq in index.roots else
+                                 "inside the jit-compiled region "
+                                 "(traced via %s)" % index.hot_chain(fq))
+                    else:
+                        chain = index.hot_chain(fq)
+                        where = ("on the per-step hot path (reached "
+                                 "from %s)" % chain if chain
+                                 else "on the per-step hot path")
+                elif site["loop"] > 0 and site["kind"] != "asarray":
+                    where = ("inside the dispatching loop of %r — the "
+                             "loop drives a compiled program"
+                             % symbol)
+                else:
+                    continue
+                out.append(Finding(
+                    self.rule, self.severity,
+                    index.fn_file[fq], site["line"],
+                    "%s forces a device->host sync %s — each call "
+                    "blocks the XLA stream and round-trips HBM "
+                    "(runtime counterpart: mxnet_transfer_d2h_total)"
+                    % (site["spelled"], where),
+                    symbol=symbol))
         return out

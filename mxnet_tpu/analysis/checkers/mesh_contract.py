@@ -1,0 +1,77 @@
+"""mesh-contract — axis names must be drawn from the project mesh.
+
+The mesh (``parallel/mesh.py``) declares the axis vocabulary —
+``AXES = ("dp", "fsdp", "tp", "pp", "sp", "ep")`` — and every
+collective, ``PartitionSpec``, and ``mesh.shape[...]`` lookup in the
+tree speaks it.  The drift class this checker kills: a function that
+*takes* a mesh/sharding argument but hard-codes an axis name the mesh
+does not have — a typo (``P("fsd")``), a stale rename (``"data"`` from
+a copied example), or an axis from a different topology.  Nothing
+fails at review time; at run time GSPMD either errors deep inside a
+pjit lower or — worse, for specs — silently treats the unknown name as
+unsharded, and PR 7's reshard-on-restore then reloads checkpoints onto
+the wrong layout.
+
+Whole-program by construction: the vocabulary lives in the mesh
+module, the violations live everywhere else.  The engine collects
+module-level all-string tuple assignments whose name matches
+``AXES``/``AXIS`` from modules that define ``make_mesh`` (or are named
+``mesh``), and audits every axis-name string literal used in
+collectives / ``P(...)`` specs / ``mesh.shape`` lookups inside
+mesh-taking functions.  No vocabulary declared -> the checker is
+silent (single-device trees have no contract to enforce).
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["MeshContractChecker", "axis_vocabulary"]
+
+
+def axis_vocabulary(index):
+    """The project's declared mesh axis names (empty = no contract)."""
+    from ..project import _AXIS_VOCAB_NAME_RE
+    vocab = set()
+    for modname, s in index.mods.items():
+        if "make_mesh" not in s["defines"] \
+                and modname.rsplit(".", 1)[-1] != "mesh":
+            continue
+        for name, strs in s["str_tuples"].items():
+            if _AXIS_VOCAB_NAME_RE.search(name):
+                vocab.update(strs)
+    return vocab
+
+
+@register
+class MeshContractChecker(Checker):
+    rule = "mesh-contract"
+    severity = "error"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []   # whole-program rule: see check_project
+
+    def check_project(self, index, ctx):
+        vocab = axis_vocabulary(index)
+        if not vocab:
+            return []
+        out = []
+        shown = ", ".join(sorted(vocab))
+        for fq in sorted(index.fns):
+            rec = index.fns[fq]
+            for lit in rec.get("axis_lits", ()):
+                if lit["axis"] in vocab:
+                    continue
+                symbol = fq.split(":", 1)[1]
+                out.append(Finding(
+                    self.rule, self.severity, index.fn_file[fq],
+                    lit["line"],
+                    "axis name %r (via %s) in mesh-taking %r is not an "
+                    "axis of the project mesh (declared: %s) — GSPMD "
+                    "errors at lower time or silently leaves the dim "
+                    "unsharded, and reshard-on-restore lands on the "
+                    "wrong layout; draw axis names from the mesh "
+                    "argument (docs/faq/parallel.md)"
+                    % (lit["axis"], lit["via"], symbol, shown),
+                    symbol=symbol))
+        return out

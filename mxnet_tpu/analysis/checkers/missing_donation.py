@@ -92,3 +92,36 @@ class MissingDonationChecker(Checker):
                 "considered and rejected (aliased buffers)"
                 % (name, stateful), symbol=name))
         return out
+
+    def check_project(self, index, ctx):
+        """Cross-module binds: ``jax.jit(imported_step)`` — the per-file
+        pass cannot see the target's signature, the engine can.  Each
+        bind site is judged on its OWN donation kwargs: a donated bind
+        in module B does not excuse an undonated bind in module C."""
+        out = []
+        for fq in sorted(index.roots):
+            binds = index.roots[fq].get("jit_binds", ())
+            if not binds:
+                continue
+            name = fq.split(":", 1)[1]
+            short = name.rsplit(".", 1)[-1]
+            if not _STEP_NAME_RE.search(short):
+                continue
+            stateful = _state_params(index.fns[fq]["params"])
+            if not stateful:
+                continue
+            for bind in binds:
+                if bind["donate"]:
+                    continue
+                relpath = index.mods[bind["mod"]]["relpath"]
+                out.append(Finding(
+                    self.rule, self.severity, relpath, bind["line"],
+                    "jitted step/update %r (defined in %s) takes "
+                    "param/state args %s but this jit call passes no "
+                    "donate_argnums — without donation XLA keeps both "
+                    "buffer generations live (double HBM) and copies "
+                    "where it could alias; donate, or write "
+                    "donate_argnums=() to record the considered-and-"
+                    "rejected decision"
+                    % (name, index.fn_mod[fq], stateful), symbol=short))
+        return out

@@ -25,6 +25,14 @@ Jit-compiled functions are found three ways: decorated with
 as the first argument of a ``jit(...)`` call anywhere in the module
 (the ``self._jit_fb = jax.jit(fb)`` idiom executor.py uses); or a
 lambda passed inline to ``jit(...)``.
+
+The per-file ``check`` covers functions whose jit bind is visible in
+their own module.  ``check_project`` extends the same hazards through
+the whole-program engine: a function jit-bound from *another* module,
+or a helper called (to any depth) from inside a traced region with a
+traced argument, is analyzed with exactly the per-parameter
+traced-ness the dataflow derived — the finding message carries the
+call chain from the jit boundary.
 """
 from __future__ import annotations
 
@@ -251,6 +259,59 @@ class RecompileHazardChecker(Checker):
         uniq = []
         for f in out:
             key = (f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        return uniq
+
+    _HAZARD_MSG = {
+        "branch": "branch on the VALUE of traced arg %r in %r%s — "
+                  "concretizes at trace time (one compile per distinct "
+                  "value, or ConcretizationTypeError); branch on "
+                  ".shape/.ndim or hoist out of the compiled region",
+        "fstring": "f-string formats the VALUE of traced arg %r in "
+                   "%r%s — trace-time concretization (format .shape, "
+                   "or log outside jit / via jax.debug.print)",
+    }
+
+    def check_project(self, index, ctx):
+        """Interprocedural hazards: traced-ness that arrives from
+        another module or ≥1 call hop below the jit boundary."""
+        out = []
+        for fq in sorted(index.traced):
+            if fq in index.local_rooted:
+                continue        # the per-file pass owns these
+            traced = index.traced.get(fq, set())
+            rec = index.fns[fq]
+            if not traced or not rec["hazards"]:
+                continue
+            symbol = fq.split(":", 1)[1]
+            for site in rec["hazards"]:
+                names = [n for n in site["names"] if n in traced]
+                for name in names:
+                    root = index.roots.get(fq)
+                    if root is not None:
+                        via = (" (jit-bound from %s)"
+                               % root["bind_mod"] if root.get("bind_mod")
+                               else "")
+                    else:
+                        chain = index.traced_chain(fq, name)
+                        via = (", traced via %s" % chain) if chain else \
+                            " (called under trace)"
+                    msg_t = self._HAZARD_MSG.get(site["kind"])
+                    if msg_t is not None:
+                        msg = msg_t % (name, symbol, via)
+                    else:
+                        msg = ("%s() over traced arg %r in %r%s — "
+                               "trace-time concretization"
+                               % (site["kind"], name, symbol, via))
+                    out.append(Finding(
+                        self.rule, self.severity, index.fn_file[fq],
+                        site["line"], msg, symbol=symbol))
+        # one finding per (path, line, message)
+        seen, uniq = set(), []
+        for f in out:
+            key = (f.path, f.line, f.message)
             if key not in seen:
                 seen.add(key)
                 uniq.append(f)

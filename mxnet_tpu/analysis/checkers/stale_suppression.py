@@ -1,0 +1,32 @@
+"""stale-suppression — disable comments that suppress nothing.
+
+The mirror image of PR 4's dead-baseline-entry hygiene test: a
+committed ``# graftlint: disable=<rule>`` whose finding has since been
+fixed (or whose rule id was typoed) is worse than noise — it
+pre-silences the next REAL instance of the bug class on that line.
+
+Detection lives in the run loop (``core.run``): every suppression
+comment that matched no finding on a full-rule run is reported here,
+at the comment's line.  Restricted ``--rule`` runs skip the pass — a
+comment for an unchecked rule is not stale, just out of scope.  The
+CLI's ``--stale`` flag prints the removal worklist
+(``path:line: remove '# graftlint: disable=...'``).
+
+This class exists to register the rule id (for ``--list-rules``,
+``--rule`` filtering, and the docs catalog); it emits nothing itself.
+"""
+from __future__ import annotations
+
+from ..core import Checker, register
+
+__all__ = ["StaleSuppressionChecker"]
+
+
+@register
+class StaleSuppressionChecker(Checker):
+    rule = "stale-suppression"
+    severity = "warning"
+    suffixes = (".py", ".cpp")
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []   # emitted by core.run's suppression accounting

@@ -1,0 +1,67 @@
+"""tracer-escape — traced values stored into state that outlives the
+trace.
+
+The classic leaked-tracer crash: inside a jit-compiled region, a
+traced value is written into ``self.`` state, a ``global``, or a
+``nonlocal`` cell (``self._last_loss = loss``).  The store happens at
+TRACE time — once, with a Tracer object, not per step with the value —
+so the program either dies later with jax's ``UnexpectedTracerError``
+when the escaped tracer is used, or silently freezes the first trace's
+abstract value into what the author believed was live state (the
+checkpoint subsystem would then happily persist a stale constant).
+
+This is inherently whole-program: the store is usually in a helper the
+step function calls, not in the jitted function itself.  The engine's
+traced-parameter dataflow (``analysis/project.py``) says exactly which
+names are tracer-backed at any call depth below the boundary, so the
+checker is one intersection: a store site whose value reads a traced
+name, in a function inside the traced set.
+
+The fix is structural, so the message says it: return the value and
+let the *caller* (outside jit) store it, or compute it from the step's
+outputs on the host side.
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["TracerEscapeChecker"]
+
+
+@register
+class TracerEscapeChecker(Checker):
+    rule = "tracer-escape"
+    severity = "error"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []   # whole-program rule: see check_project
+
+    def check_project(self, index, ctx):
+        out = []
+        for fq in sorted(index.traced):
+            traced = index.traced.get(fq, set())
+            rec = index.fns[fq]
+            if not traced or not rec["stores"]:
+                continue
+            symbol = fq.split(":", 1)[1]
+            for site in rec["stores"]:
+                names = [n for n in site["names"] if n in traced]
+                if not names:
+                    continue
+                if fq in index.roots:
+                    via = ""
+                else:
+                    chain = index.traced_chain(fq, names[0])
+                    via = (" (traced via %s)" % chain) if chain else ""
+                out.append(Finding(
+                    self.rule, self.severity, index.fn_file[fq],
+                    site["line"],
+                    "store of traced value %r into %s inside the "
+                    "jit-compiled region%s — the tracer outlives the "
+                    "trace (UnexpectedTracerError, or a stale "
+                    "trace-time constant masquerading as live state); "
+                    "return the value and store it outside jit"
+                    % (names[0], site["target"], via),
+                    symbol=symbol))
+        return out

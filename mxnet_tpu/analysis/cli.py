@@ -6,18 +6,59 @@ findings exist, 2 on usage errors.  ``--update-baseline`` rewrites the
 committed baseline from the current run and exits 0 — the triage
 workflow is: run, fix the true positives, suppress or baseline the
 deliberate remainder, ``--update-baseline``, commit.
+
+Incremental runs: the CLI keeps a content-hash cache at
+``.graftlint-cache.json`` (``--no-cache`` to disable, ``--cache`` to
+relocate), so a warm re-lint only re-analyzes edited files.
+``--changed`` derives the path set from git (worktree changes by
+default, ``--changed REF`` to diff against a ref) — the pre-push
+habit: ``tools/lint.py --changed``.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from . import baseline as baseline_mod
-from .core import repo_root, rule_ids, run
-from .reporters import human_report, json_report
+from .core import C_API_BASENAMES, repo_root, rule_ids, run
+from .reporters import human_report, json_report, sarif_report
 
 __all__ = ["main"]
+
+
+def _changed_paths(root, ref):
+    """Lintable files git reports as changed: worktree+index vs HEAD
+    (plus untracked) when ``ref`` is None, else ``git diff REF``."""
+    def git(*args):
+        out = subprocess.run(["git", "-C", root] + list(args),
+                             capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip()
+                               or "git %s failed" % (args,))
+        return [l for l in out.stdout.splitlines() if l.strip()]
+
+    if ref is None:
+        names = set(git("diff", "--name-only", "HEAD", "--"))
+        names.update(git("ls-files", "--others", "--exclude-standard"))
+    else:
+        names = set(git("diff", "--name-only", ref, "--"))
+    picked = []
+    for rel in sorted(names):
+        if not (rel.endswith(".py")
+                or os.path.basename(rel) in C_API_BASENAMES):
+            continue
+        # graftlint's scope is the package: its checkers (and the
+        # suppression scanner, which reads raw text) are calibrated
+        # for mxnet_tpu sources, not for test files full of fixture
+        # snippets embedded in strings
+        if not rel.replace(os.sep, "/").startswith("mxnet_tpu/"):
+            continue
+        full = os.path.join(root, rel)
+        if os.path.exists(full):        # deletions need no lint
+            picked.append(full)
+    return picked
 
 
 def main(argv=None):
@@ -32,6 +73,25 @@ def main(argv=None):
     parser.add_argument(
         "--json", action="store_true",
         help="emit a machine-readable JSON report instead of text")
+    parser.add_argument(
+        "--sarif", action="store_true",
+        help="emit a SARIF 2.1.0 report (CI diff annotation)")
+    parser.add_argument(
+        "--changed", nargs="?", const="WORKTREE", default=None,
+        metavar="REF",
+        help="lint only files git reports changed (worktree vs HEAD, "
+             "or vs REF when given)")
+    parser.add_argument(
+        "--cache", metavar="PATH",
+        help="incremental cache file (default: <repo>/.graftlint-"
+             "cache.json)")
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="analyze every file from scratch")
+    parser.add_argument(
+        "--stale", action="store_true",
+        help="list stale suppression comments as a removal worklist "
+             "and exit (1 when any exist)")
     parser.add_argument(
         "--rule", action="append", dest="rules", metavar="RULE",
         help="restrict to RULE (repeatable); see --list-rules")
@@ -59,31 +119,61 @@ def main(argv=None):
         return 0
 
     root = repo_root()
-    paths = args.paths or [os.path.join(root, "mxnet_tpu")]
+    if args.changed is not None:
+        if args.paths:
+            print("graftlint: --changed derives the path set from git; "
+                  "drop the explicit paths", file=sys.stderr)
+            return 2
+        try:
+            paths = _changed_paths(
+                root, None if args.changed == "WORKTREE" else args.changed)
+        except RuntimeError as exc:
+            print("graftlint: %s" % exc, file=sys.stderr)
+            return 2
+        if not paths:
+            print("graftlint: no changed lintable files")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(root, "mxnet_tpu")]
     for p in paths:
         if not os.path.exists(p):
             print("graftlint: no such path: %s" % p, file=sys.stderr)
             return 2
+    cache = None
+    if not args.no_cache:
+        from . import cache as cache_mod
+        cache = args.cache or cache_mod.default_path(root)
     try:
-        findings = run(paths, rules=args.rules)
+        findings = run(paths, rules=args.rules, cache=cache)
     except ValueError as exc:       # unknown --rule
         print("graftlint: %s" % exc, file=sys.stderr)
         return 2
 
+    if args.stale:
+        stale = [f for f in findings if f.rule == "stale-suppression"]
+        for f in stale:
+            print("%s:%d: remove the suppression comment (%s)"
+                  % (f.path, f.line, f.message.split(" — ")[0]))
+        print("graftlint: %d stale suppression%s"
+              % (len(stale), "s" if len(stale) != 1 else ""))
+        return 1 if stale else 0
+
     baseline_path = args.baseline or baseline_mod.default_path(root)
     if args.update_baseline:
-        # a restricted run (--rule / explicit paths) only re-derives the
-        # findings in its scope: out-of-scope baseline entries are
-        # preserved, not silently dropped (a --rule update must not
-        # un-baseline every other rule's deliberate findings)
+        # a restricted run (--rule / explicit paths / --changed) only
+        # re-derives the findings in its scope: out-of-scope baseline
+        # entries are preserved, not silently dropped (a --rule update
+        # must not un-baseline every other rule's deliberate findings,
+        # and `--changed --update-baseline` must not un-baseline every
+        # UNCHANGED file's)
         entries = {f.fingerprint: f.to_dict() for f in findings}
         restricted_rules = set(args.rules) if args.rules else None
         restricted_paths = None
-        if args.paths:
+        if args.paths or args.changed is not None:
             restricted_paths = [
                 os.path.relpath(os.path.abspath(p), root).replace(
                     os.sep, "/")
-                for p in args.paths]
+                for p in paths]
         kept = 0
         if restricted_rules or restricted_paths:
             for fp, e in baseline_mod.load(baseline_path).items():
@@ -107,7 +197,9 @@ def main(argv=None):
 
     known = {} if args.no_baseline else baseline_mod.load(baseline_path)
     new, old = baseline_mod.filter_new(findings, known)
-    if args.json:
+    if args.sarif:
+        print(sarif_report(new, old))
+    elif args.json:
         print(json_report(new, old))
     else:
         print(human_report(new, old, show_baselined=args.show_baselined))

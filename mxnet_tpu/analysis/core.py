@@ -170,78 +170,259 @@ def iter_source_files(paths):
 
 class RunContext:
     """Per-run shared state checkers may consult (repo root for
-    config/docs lookups, memo cache for parsed registries)."""
+    config/docs lookups, memo cache for parsed registries, and — once
+    phase 1 is done — the linked :class:`~.project.ProjectIndex` as
+    ``ctx.project``)."""
 
     def __init__(self, root):
         self.root = root
         self.memo = {}
+        self.project = None
 
 
 def _suppressions(text):
-    """(file_level_rules, {line: rules}) from suppression comments."""
+    """(file_entries, {comment_line: rules}) from suppression comments;
+    ``file_entries`` is ``[(line, rules)]`` for ``disable-file`` within
+    the first 40 lines."""
     per_line = {}
-    file_level = set()
+    file_entries = []
     for i, line in enumerate(text.splitlines()[:40], 1):
         m = _SUPPRESS_FILE_RE.search(line)
         if m:
-            file_level.update(
-                r.strip() for r in m.group(1).split(",") if r.strip())
+            file_entries.append((i, {r.strip() for r in
+                                     m.group(1).split(",") if r.strip()}))
     for i, line in enumerate(text.splitlines(), 1):
         m = _SUPPRESS_RE.search(line)
         if m:
             per_line[i] = {r.strip() for r in m.group(1).split(",")
                            if r.strip()}
-    return file_level, per_line
+    return file_entries, per_line
 
 
-def _suppressed(finding, file_level, per_line):
-    for rules in (file_level,
-                  per_line.get(finding.line, ()),
-                  per_line.get(finding.line - 1, ())):
+def _match_suppressions(finding, file_entries, per_line):
+    """Comment lines (``("file", L)`` / ``("line", L)``) that suppress
+    ``finding`` — empty when it survives.  A line comment covers its
+    own line and the line directly below."""
+    matched = []
+    for lineno, rules in file_entries:
         if finding.rule in rules or "all" in rules:
-            return True
-    return False
+            matched.append(("file", lineno))
+    for c in (finding.line, finding.line - 1):
+        rules = per_line.get(c)
+        if rules and (finding.rule in rules or "all" in rules):
+            matched.append(("line", c))
+    return matched
 
 
-def run(paths, rules=None, root=None):
+def _project_scope(root, requested):
+    """Every file the whole-program passes must see: the package under
+    ``root`` (or the root tree itself for fixture roots) plus whatever
+    was explicitly requested."""
+    pkg = os.path.join(root, "mxnet_tpu")
+    scan = [pkg] if os.path.isdir(pkg) else [root]
+    out, seen = [], set()
+    for p in requested + list(iter_source_files(scan)):
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def _phase1(path, relpath, text, all_checkers, ctx):
+    """Parse + summarize + per-file checkers for ONE file — the pure,
+    cacheable unit.  Returns a cache-shaped record."""
+    from .project import summarize
+    tree = None
+    findings = []
+    summary = None
+    if path.endswith(".py"):
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as exc:
+            findings.append(Finding(
+                "parse-error", "error", relpath,
+                exc.lineno or 1,
+                "file does not parse: %s" % exc.msg).to_dict())
+            tree = None
+        summary = summarize(relpath, text, tree)
+    for checker in all_checkers:
+        if not checker.interested(path):
+            continue
+        for f in checker.check(path, relpath, text, tree, ctx):
+            findings.append(f.to_dict())
+    file_entries, per_line = _suppressions(text)
+    return {
+        "summary": summary,
+        "findings": findings,
+        "suppressions": {
+            "file": [[lineno, sorted(rules)]
+                     for lineno, rules in file_entries],
+            "lines": {str(k): sorted(v) for k, v in per_line.items()},
+        },
+    }
+
+
+def _stale_findings(relpath, sup, used, universe):
+    """stale-suppression findings for one file's unused comments."""
+    out = []
+    for lineno, rules in sup_file_entries(sup):
+        if ("file", lineno) in used:
+            continue
+        out.append(Finding(
+            "stale-suppression", "warning", relpath, lineno,
+            "file-level suppression of %s suppresses nothing — remove "
+            "the 'graftlint: disable-file' comment"
+            % ", ".join(sorted(rules)), symbol=""))
+    for lineno, rules in sup_line_entries(sup):
+        if ("line", lineno) in used:
+            continue
+        unknown = sorted(r for r in rules
+                         if r != "all" and r not in universe)
+        if unknown:
+            detail = (" (no such rule%s: %s)"
+                      % ("s" if len(unknown) != 1 else "",
+                         ", ".join(unknown)))
+        else:
+            detail = ""
+        out.append(Finding(
+            "stale-suppression", "warning", relpath, lineno,
+            "inline suppression of %s suppresses nothing%s — the "
+            "finding it silenced is gone; remove the comment "
+            "(tools/lint.py --stale lists these)"
+            % (", ".join(sorted(rules)), detail), symbol=""))
+    return out
+
+
+def sup_file_entries(sup):
+    return [(int(lineno), set(rules)) for lineno, rules in sup["file"]]
+
+
+def sup_line_entries(sup):
+    return [(int(lineno), set(rules))
+            for lineno, rules in sup["lines"].items()]
+
+
+def run(paths, rules=None, root=None, cache=None):
     """Lint ``paths`` and return the surviving findings, sorted.
 
     ``rules`` restricts to a subset of rule ids; ``root`` overrides the
     repo root (fixture trees in tests carry their own ``config.py`` /
-    ``docs/faq/env_var.md``)."""
+    ``docs/faq/env_var.md``); ``cache`` names an incremental-cache file
+    (``analysis/cache.py``) so unchanged files are not re-analyzed.
+
+    Two phases: per-file (parse, summarize, file-scoped checkers —
+    cacheable) then whole-program (link the summaries into a
+    ProjectIndex, run the project-scoped checker passes).  The
+    project scope is always the full package under ``root`` even when
+    ``paths`` is a subset — interprocedural facts need every file —
+    but findings are only *reported* for the requested paths.
+    stale-suppression hygiene runs on full-rule runs only (a
+    ``--rule``-restricted run cannot tell a stale comment from one
+    whose rule simply was not checked)."""
+    from .project import ProjectIndex
     root = os.path.abspath(root) if root else repo_root()
     if rules is not None:
         rules = set(rules)
         unknown = rules.difference(rule_ids())
         if unknown:
             raise ValueError("unknown rule ids: %s" % sorted(unknown))
-    active = [cls() for cls in checkers()
-              if rules is None or cls.rule in rules]
+    all_checkers = [cls() for cls in checkers()]
     ctx = RunContext(root)
-    findings = []
-    for path in iter_source_files(paths):
+    requested = list(iter_source_files(paths))
+    req_rel = {os.path.relpath(p, root).replace(os.sep, "/")
+               for p in requested}
+
+    cache_obj = None
+    if cache:
+        from .cache import AnalysisCache
+        cache_obj = AnalysisCache(cache, root)
+
+    records = {}
+    digests = []
+    for path in _project_scope(root, requested):
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
                 text = f.read()
         except OSError:
             continue
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
-        tree = None
-        if path.endswith(".py"):
-            try:
-                tree = ast.parse(text)
-            except SyntaxError as exc:
-                findings.append(Finding(
-                    "parse-error", "error", relpath,
-                    exc.lineno or 1, "file does not parse: %s" % exc.msg))
-                tree = None
-        file_level, per_line = _suppressions(text)
-        for checker in active:
-            if not checker.interested(path):
+        rec = None
+        digest = None
+        if cache_obj is not None:
+            digest = hashlib.sha256(text.encode("utf-8")).hexdigest()
+            digests.append(relpath + ":" + digest)
+        if cache_obj is not None:
+            rec = cache_obj.lookup(relpath, digest)
+        if rec is None:
+            rec = _phase1(path, relpath, text, all_checkers, ctx)
+            if cache_obj is not None:
+                cache_obj.store(relpath, digest, rec["summary"],
+                                rec["findings"], rec["suppressions"])
+        records[relpath] = rec
+
+    findings = []
+    for rec in records.values():
+        for d in rec["findings"]:
+            f = Finding(d["rule"], d["severity"], d["path"], d["line"],
+                        d["message"], d.get("symbol", ""))
+            findings.append(f)
+
+    # whole-program phase — skipped entirely on a no-change warm run:
+    # the interprocedural findings are a pure function of the summaries,
+    # so an unchanged tree digest replays them from the cache
+    tree_digest = (hashlib.sha256(
+        "\n".join(sorted(digests)).encode()).hexdigest()
+        if cache_obj is not None else None)
+    cached_project = (cache_obj.project_findings(tree_digest)
+                      if cache_obj is not None else None)
+    if cached_project is not None:
+        for d in cached_project:
+            findings.append(Finding(
+                d["rule"], d["severity"], d["path"], d["line"],
+                d["message"], d.get("symbol", "")))
+    else:
+        index = ProjectIndex([r["summary"] for r in records.values()
+                              if r["summary"] is not None])
+        ctx.project = index
+        project_findings = []
+        for checker in all_checkers:
+            check_project = getattr(checker, "check_project", None)
+            if check_project is not None:
+                project_findings.extend(check_project(index, ctx))
+        if cache_obj is not None:
+            cache_obj.store_project(
+                tree_digest, [f.to_dict() for f in project_findings])
+        findings.extend(project_findings)
+
+    if rules is not None:
+        findings = [f for f in findings
+                    if f.rule in rules or f.rule == "parse-error"]
+
+    # suppression, tracking which comments earned their keep
+    used = {}           # relpath -> set of ("file"|"line", comment line)
+    kept = []
+    empty = {"file": [], "lines": {}}
+    for f in findings:
+        sup = records.get(f.path, {"suppressions": empty})["suppressions"]
+        matched = _match_suppressions(
+            f, sup_file_entries(sup), {l: r for l, r
+                                       in sup_line_entries(sup)})
+        if matched:
+            used.setdefault(f.path, set()).update(matched)
+        else:
+            kept.append(f)
+
+    if rules is None:
+        universe = set(rule_ids())
+        for relpath in sorted(req_rel):
+            rec = records.get(relpath)
+            if rec is None:
                 continue
-            for finding in checker.check(path, relpath, text, tree, ctx):
-                if not _suppressed(finding, file_level, per_line):
-                    findings.append(finding)
+            kept.extend(_stale_findings(
+                relpath, rec["suppressions"],
+                used.get(relpath, set()), universe))
+
+    findings = [f for f in kept if f.path in req_rel]
     findings.sort(key=Finding.sort_key)
     # disambiguate identical (rule, path, symbol, message) fingerprints
     counts = {}
@@ -249,4 +430,6 @@ def run(paths, rules=None, root=None):
         key = (f.rule, f.path, f.symbol, f.message)
         f._dup = counts.get(key, 0)
         counts[key] = f._dup + 1
+    if cache_obj is not None:
+        cache_obj.save()
     return findings

@@ -1,0 +1,1437 @@
+"""Whole-program model — import graph, call graph, jit-boundary dataflow.
+
+The per-file checkers see one AST at a time; this module sees the
+*project*.  The reference precedent is the whole-graph property passes
+TVM/MPK run before execution (PAPERS.md): in a tensor-program stack the
+defects that matter are defined by what runs *inside the compiled
+region* versus on the host, and that boundary is a whole-program fact —
+a ``.asnumpy()`` three call hops below the serving batcher is exactly
+as hot as one written inline, and a Python value-branch in a helper the
+jitted step calls concretizes just the same.
+
+Two layers:
+
+- :func:`summarize` — ONE pass over a file's AST producing a
+  JSON-serializable summary (functions, their call sites with arg
+  dataflow, jit bind sites, sync/hazard/store/mutation sites, mesh
+  axis literals, thread spawn points).  Summaries are pure functions of
+  file content, which is what makes the incremental cache
+  (``analysis/cache.py``) sound: unchanged files are never re-parsed.
+- :class:`ProjectIndex` — links the summaries: module-qualified name
+  resolution across the package, method resolution through ``self.``
+  (constructor-typed attributes, factory return types, single-hierarchy
+  fallback for dynamic dispatch), then the dataflow passes:
+
+  * **jit roots** — functions compiled via ``jax.jit`` / ``pjit`` /
+    ``shard_map`` / ``custom_vjp`` (decorator, ``jit(fn, ...)`` call —
+    including a call whose target is *imported*, ``defvjp`` rules);
+  * **traced set** — roots plus every function reachable from one
+    through resolved calls, with per-parameter traced-ness propagated
+    through call-site arguments (the interprocedural half of
+    ``recompile-hazard`` and all of ``tracer-escape``);
+  * **hot set** — the per-step host path: a function whose loop
+    (transitively) dispatches a jit-compiled program is a *step
+    driver*, and everything its loop calls is hot (the engine-derived
+    replacement for ``host-sync``'s old name lists);
+  * **thread set** — functions reachable from ``threading.Thread``
+    targets or ``engine.worker_scope`` bodies
+    (``unguarded-global-mutation``).
+
+Findings carry the witness call chain in the message, so a report like
+``reached from ModelServer._worker -> _execute`` is actionable without
+re-deriving the graph by hand.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+__all__ = ["SUMMARY_VERSION", "module_name", "summarize", "ProjectIndex"]
+
+# bump when the summary shape or any dataflow pass changes meaning —
+# the incremental cache keys on it
+SUMMARY_VERSION = 1
+
+_JIT_TAILS = frozenset(("jit", "pjit"))
+_TRACE_TAILS = frozenset(("grad", "value_and_grad", "vmap", "remat",
+                          "checkpoint"))
+_SYNC_ATTRS = frozenset(("asnumpy", "asscalar", "item", "wait_to_read"))
+_NP_NAMES = frozenset(("np", "numpy", "_np", "onp", "_onp"))
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size", "aval",
+                           "weak_type", "sharding"))
+_STATIC_WRAPPERS = frozenset(("len", "isinstance", "type", "getattr",
+                              "hasattr"))
+_FORMATTERS = frozenset(("str", "repr", "format", "bool", "int", "float"))
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "pop", "popitem", "remove", "discard",
+    "add", "clear", "update", "setdefault", "move_to_end", "appendleft",
+    "popleft", "sort", "reverse"))
+_COLLECTIVES = frozenset((
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "axis_index", "pbroadcast"))
+_SPEC_CTORS = frozenset(("P", "PartitionSpec"))
+_MESH_PARAM_RE = re.compile(r"^(mesh|.*_mesh|device_mesh|shardings?)$")
+_MESH_ATTR_RE = re.compile(r"^_?mesh$")
+_AXIS_VOCAB_NAME_RE = re.compile(r"AXES|AXIS")
+_GUARDED_DECL_RE = re.compile(
+    r"^(?P<glob>[A-Za-z_]\w*)\s*(?:\[[^\]]*\])?\s*=(?!=).*#\s*guarded-by:")
+_LOCKISH_RE = re.compile(r"lock|cv|cond|mutex|sem", re.IGNORECASE)
+# common-noise method names never resolved by the hierarchy fallback
+# (they appear on dicts/lists/unrelated classes far too often)
+_FALLBACK_STOPLIST = frozenset((
+    "get", "items", "keys", "values", "copy", "join", "start", "put",
+    "close", "read", "write", "result", "set", "wait", "release",
+    "acquire", "notify", "notify_all", "format"))
+
+
+def module_name(relpath):
+    """Dotted module name for a repo-relative ``.py`` path."""
+    p = relpath.replace(os.sep, "/")
+    if p.endswith("/__init__.py"):
+        p = p[:-len("/__init__.py")]
+    elif p.endswith(".py"):
+        p = p[:-3]
+    return p.replace("/", ".")
+
+
+def _parts_of(expr):
+    """``a.b.c`` / ``self.x.f`` as ``["a","b","c"]`` — None when the
+    expression is not a plain name/attribute chain (subscripts, calls
+    in the chain, literals)."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return list(reversed(parts))
+    return None
+
+
+def _descriptor(expr):
+    """Abstract-value descriptor for an assigned/passed expression:
+    ``("call", parts)`` for ``f(...)``, ``("ref", parts)`` for a bare
+    name/attribute chain, else None (opaque)."""
+    if isinstance(expr, ast.Call):
+        parts = _parts_of(expr.func)
+        return ("call", parts) if parts else None
+    parts = _parts_of(expr)
+    return ("ref", parts) if parts else None
+
+
+def _names_read(expr):
+    """Every plain Name read inside ``expr`` (sorted, deduped)."""
+    return sorted({n.id for n in ast.walk(expr) if isinstance(n, ast.Name)})
+
+
+def _const_strings(expr):
+    """All string constants anywhere under ``expr``."""
+    return [n.value for n in ast.walk(expr)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def _static_names(call, params):
+    """Parameter names a ``jit(...)`` call's static_argnames/nums pin."""
+    static = set()
+    for kw in call.keywords:
+        vals = []
+        if isinstance(kw.value, ast.Constant):
+            vals = [kw.value.value]
+        elif isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = [e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)]
+        if kw.arg == "static_argnames":
+            static.update(v for v in vals if isinstance(v, str))
+        elif kw.arg == "static_argnums":
+            for n in vals:
+                if isinstance(n, int) and 0 <= n < len(params):
+                    static.add(params[n])
+    return static
+
+
+def _donation_declared(call):
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+def _fn_params(fn):
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _value_uses(expr, candidates):
+    """Names from ``candidates`` used by VALUE in ``expr`` — uses under
+    static attribute access / static wrappers / ``is None`` comparisons
+    are excluded (mirrors the per-file recompile-hazard logic)."""
+    bad = []
+
+    def visit(node, static_ctx):
+        if isinstance(node, ast.Name):
+            if node.id in candidates and not static_ctx:
+                bad.append(node.id)
+            return
+        if isinstance(node, ast.Attribute):
+            visit(node.value, static_ctx or node.attr in _STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            child_static = static_ctx or fname in _STATIC_WRAPPERS
+            visit(node.func, static_ctx)
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                visit(a, child_static)
+            return
+        if isinstance(node, ast.Compare):
+            none_cmp = all(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators)
+            visit(node.left, static_ctx or none_cmp)
+            for c in node.comparators:
+                visit(c, static_ctx or none_cmp)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, static_ctx)
+
+    visit(expr, False)
+    return sorted(set(bad))
+
+
+# ---------------------------------------------------------------------------
+# per-file summarizer
+# ---------------------------------------------------------------------------
+
+class _FnScope:
+    """Mutable collection state for one function under summarization."""
+
+    def __init__(self, qual, node, cls, parent):
+        self.qual = qual
+        self.node = node
+        self.cls = cls
+        self.parent = parent
+        self.rec = {
+            "line": node.lineno,
+            "params": _fn_params(node),
+            "class": cls,
+            "parent": parent,
+            "calls": [],
+            "assigns": {},
+            "returns": [],
+            "sync": [],
+            "hazards": [],
+            "stores": [],
+            "gmuts": [],
+            "axis_lits": [],
+            "mesh_user": bool(
+                any(_MESH_PARAM_RE.match(p) for p in _fn_params(node))),
+            "globals": sorted(
+                {n for st in ast.walk(node) if isinstance(st, ast.Global)
+                 for n in st.names}),
+            "nonlocals": sorted(
+                {n for st in ast.walk(node) if isinstance(st, ast.Nonlocal)
+                 for n in st.names}),
+        }
+
+
+def summarize(relpath, text, tree):
+    """One file's project summary (see module docstring for the shape).
+
+    Pure in (relpath, text): the incremental cache stores the result
+    keyed by content hash and replays it without re-parsing."""
+    mod = module_name(relpath)
+    lines = text.splitlines()
+    guarded_globals = set()
+    for line in lines:
+        m = _GUARDED_DECL_RE.match(line)
+        if m:
+            guarded_globals.add(m.group("glob"))
+
+    summary = {
+        "version": SUMMARY_VERSION,
+        "module": mod,
+        "relpath": relpath,
+        "imports": {},
+        "classes": {},
+        "functions": {},
+        "jit_binds": [],
+        "jit_names": {},
+        "globals_mut": {},
+        "str_tuples": {},
+        "defines": [],
+    }
+    if tree is None:
+        return summary
+
+    pkg_parts = mod.split(".")
+
+    def resolve_relative(level, target):
+        base = pkg_parts[:-1]
+        if level > 1:
+            base = base[:-(level - 1)]
+        return ".".join(base + ([target] if target else []))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                summary["imports"][alias.asname or
+                                   alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            base = (resolve_relative(node.level, node.module)
+                    if node.level else (node.module or ""))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                summary["imports"][alias.asname or alias.name] = (
+                    base + "." + alias.name if base else alias.name)
+
+    # -- module-level bindings ----------------------------------------------
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)):
+                summary["globals_mut"][name] = {
+                    "line": node.lineno,
+                    "guarded": name in guarded_globals}
+            elif isinstance(v, ast.Call):
+                parts = _parts_of(v.func)
+                tail = parts[-1] if parts else ""
+                if tail in ("deque", "OrderedDict", "defaultdict", "dict",
+                            "list", "set"):
+                    summary["globals_mut"][name] = {
+                        "line": node.lineno,
+                        "guarded": name in guarded_globals}
+            if isinstance(v, (ast.Tuple, ast.List)) and v.elts and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in v.elts):
+                summary["str_tuples"][name] = [e.value for e in v.elts]
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            summary["defines"].append(node.name)
+
+    # -- jit bind sites ------------------------------------------------------
+    def record_bind(call, kind, target_expr, owner=None):
+        parts = _parts_of(target_expr)
+        if parts is None:
+            return
+        bind = {
+            "parts": parts, "kind": kind, "line": call.lineno,
+            "donate": _donation_declared(call),
+            "owner": owner,
+            "call_static_raw": _raw_static(call),
+        }
+        if kind == "defvjp":
+            # ``primal.defvjp(fwd, bwd)`` — the receiver's
+            # nondiff_argnums transfer to the rules (by name)
+            recv = _parts_of(call.func)
+            if recv and len(recv) > 1:
+                bind["primal"] = recv[:-1]
+        summary["jit_binds"].append(bind)
+
+    def _raw_static(call):
+        """static_argnums indices + static_argnames, resolved against
+        the target's params only at link time (the target may live in
+        another module)."""
+        names, nums = [], []
+        for kw in call.keywords:
+            vals = []
+            if isinstance(kw.value, ast.Constant):
+                vals = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                vals = [e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)]
+            if kw.arg == "static_argnames":
+                names += [v for v in vals if isinstance(v, str)]
+            elif kw.arg in ("static_argnums", "nondiff_argnums"):
+                nums += [v for v in vals if isinstance(v, int)]
+        return {"names": names, "nums": nums}
+
+    def scan_binds(tree):
+        """jit/shard_map/custom_vjp/defvjp calls anywhere in the file,
+        each tagged with the qualified name of the enclosing function
+        (binds inside a method resolve against that method's locals)."""
+        stack = []
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                stack.append(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+                stack.pop()
+                return
+            if isinstance(node, ast.Call):
+                owner = ".".join(
+                    s.name for s in stack
+                    if isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef))) or None
+                scan_one(node, owner)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(tree)
+
+    def scan_one(n, owner):
+        parts = _parts_of(n.func)
+        tail = parts[-1] if parts else ""
+        if tail in _JIT_TAILS or tail == "shard_map":
+            if n.args and not isinstance(n.args[0], ast.Lambda):
+                record_bind(n, "jit" if tail in _JIT_TAILS
+                            else "shard_map", n.args[0], owner)
+        elif tail == "partial" and n.args:
+            inner = _parts_of(n.args[0])
+            if inner and inner[-1] in _JIT_TAILS and len(n.args) > 1:
+                record_bind(n, "jit", n.args[1], owner)
+        elif tail == "custom_vjp" and n.args:
+            record_bind(n, "custom_vjp", n.args[0], owner)
+        elif tail == "defvjp":
+            for arg in n.args:
+                if _parts_of(arg):
+                    record_bind(n, "defvjp", arg, owner)
+        elif tail in _TRACE_TAILS and n.args:
+            if _parts_of(n.args[0]):
+                record_bind(n, "trace", n.args[0], owner)
+        elif tail in ("scan", "while_loop", "fori_loop", "cond"):
+            for arg in n.args:
+                p = _parts_of(arg)
+                if p and p != ["None"]:
+                    record_bind(n, "trace", arg, owner)
+
+    scan_binds(tree)
+
+    # module-level names bound to jit values: ``fast = jax.jit(step)``
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            parts = _parts_of(node.value.func)
+            tail = parts[-1] if parts else ""
+            if tail in _JIT_TAILS or tail == "shard_map":
+                summary["jit_names"][node.targets[0].id] = node.lineno
+            elif tail == "partial" and node.value.args:
+                inner = _parts_of(node.value.args[0])
+                if inner and inner[-1] in _JIT_TAILS:
+                    summary["jit_names"][node.targets[0].id] = node.lineno
+
+    # -- function / class walk ----------------------------------------------
+    def jit_decorated(fn):
+        """(kind, static, donate) when a decorator compiles ``fn``."""
+        for dec in fn.decorator_list:
+            call = dec if isinstance(dec, ast.Call) else None
+            target = call.func if call else dec
+            parts = _parts_of(target)
+            tail = parts[-1] if parts else ""
+            if tail in _JIT_TAILS:
+                static = (_static_names(call, _fn_params(fn))
+                          if call else set())
+                return ("jit", sorted(static),
+                        _donation_declared(call) if call else False)
+            if tail == "custom_vjp":
+                return ("custom_vjp", [], True)
+            if tail == "partial" and call and call.args:
+                inner = _parts_of(call.args[0])
+                if inner and inner[-1] in _JIT_TAILS:
+                    return ("jit", sorted(_static_names(call,
+                                                        _fn_params(fn))),
+                            _donation_declared(call))
+                if inner and inner[-1] == "custom_vjp":
+                    # @partial(jax.custom_vjp, nondiff_argnums=(2,))
+                    raw = _raw_static(call)
+                    params = _fn_params(fn)
+                    static = set(raw["names"]) | {
+                        params[i] for i in raw["nums"]
+                        if 0 <= i < len(params)}
+                    return ("custom_vjp", sorted(static), True)
+        return None
+
+    def walk_fn(fn, qual, cls, parent):
+        scope = _FnScope(qual, fn, cls, parent)
+        rec = scope.rec
+        dec = jit_decorated(fn)
+        if dec is not None:
+            rec["jit_root"] = {"kind": dec[0], "static": dec[1],
+                               "donate": dec[2], "line": fn.lineno}
+        local_names = set(rec["params"])
+
+        def with_locks(stack):
+            names = []
+            for item in stack:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                p = _parts_of(expr)
+                if p:
+                    names.append(p[-1])
+            return names
+
+        def in_worker_scope(stack):
+            for item in stack:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                p = _parts_of(expr)
+                if p and p[-1] == "worker_scope":
+                    return True
+            return False
+
+        def visit(node, loop, withs):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk_fn(node, qual + "." + node.name, cls, qual)
+                return
+            if isinstance(node, ast.Lambda):
+                return
+            is_loop = isinstance(node, (ast.For, ast.While, ast.comprehension))
+            new_loop = loop + (1 if is_loop else 0)
+            new_withs = withs
+            if isinstance(node, ast.With):
+                new_withs = withs + list(node.items)
+
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_names.add(t.id)
+                        d = _descriptor(node.value)
+                        if d is not None:
+                            rec["assigns"].setdefault(t.id, [])
+                            if d not in rec["assigns"][t.id] \
+                                    and len(rec["assigns"][t.id]) < 4:
+                                rec["assigns"][t.id].append(list(d))
+                _scan_store(node, rec, cls, local_names)
+                _scan_gmut_assign(node, rec, summary, local_names,
+                                  with_locks(new_withs),
+                                  in_worker_scope(new_withs), loop)
+            elif isinstance(node, ast.AugAssign):
+                _scan_store(node, rec, cls, local_names, aug=True)
+                _scan_gmut_assign(node, rec, summary, local_names,
+                                  with_locks(new_withs),
+                                  in_worker_scope(new_withs), loop, aug=True)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                d = _descriptor(node.value)
+                if d is not None and list(d) not in rec["returns"] \
+                        and len(rec["returns"]) < 6:
+                    rec["returns"].append(list(d))
+            elif isinstance(node, ast.Call):
+                _scan_call(node, rec, local_names, new_loop,
+                           with_locks(new_withs),
+                           in_worker_scope(new_withs), summary)
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                uses = _value_uses(node.test, set(rec["params"]))
+                if uses:
+                    rec["hazards"].append({
+                        "line": node.test.lineno, "kind": "branch",
+                        "names": uses})
+            elif isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.FormattedValue):
+                        uses = _value_uses(part.value, set(rec["params"]))
+                        if uses:
+                            rec["hazards"].append({
+                                "line": part.value.lineno, "kind": "fstring",
+                                "names": uses})
+
+            for child in ast.iter_child_nodes(node):
+                visit(child, new_loop, new_withs)
+
+        for stmt in fn.body:
+            visit(stmt, 0, [])
+        scan_binds_local(fn, rec)
+        if not rec["mesh_user"]:
+            rec["mesh_user"] = _reads_mesh(fn, local_names)
+        if rec["mesh_user"]:
+            rec["axis_lits"] = _axis_literals(fn, rec["params"])
+        summary["functions"][qual] = rec
+
+    def scan_binds_local(fn, rec):
+        """``self._jit_x = jax.jit(...)`` / local ``f = jit(g)`` inside
+        a function body — record the attr as jit-valued on the class."""
+        for n in ast.walk(fn):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.value, ast.Call)):
+                continue
+            parts = _parts_of(n.value.func)
+            tail = parts[-1] if parts else ""
+            jit_valued = tail in _JIT_TAILS or tail == "shard_map"
+            if not jit_valued and tail == "partial" and n.value.args:
+                inner = _parts_of(n.value.args[0])
+                jit_valued = bool(inner and inner[-1] in _JIT_TAILS)
+            if not jit_valued:
+                continue
+            t = n.targets[0]
+            tp = _parts_of(t)
+            if tp and len(tp) == 2 and tp[0] == "self" and rec["class"]:
+                cls_rec = summary["classes"].setdefault(
+                    rec["class"], {"bases": [], "line": 0, "attrs": {}})
+                cls_rec["attrs"][tp[1]] = ["jit"]
+
+    def _reads_mesh(fn, local_names):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) and _MESH_ATTR_RE.match(n.attr):
+                return True
+            if isinstance(n, ast.Name) and n.id == "mesh" \
+                    and n.id not in local_names:
+                return True
+        return False
+
+    def _axis_literals(fn, params):
+        lits = []
+        mesh_params = {p for p in params if _MESH_PARAM_RE.match(p)}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call):
+                parts = _parts_of(n.func)
+                tail = parts[-1] if parts else ""
+                if tail in _SPEC_CTORS or tail in _COLLECTIVES:
+                    for arg in list(n.args) + [kw.value for kw in n.keywords
+                                               if kw.arg in (None,
+                                                             "axis_name",
+                                                             "axis",
+                                                             "axes")]:
+                        for s in _const_strings(arg):
+                            lits.append({"line": n.lineno, "axis": s,
+                                         "via": tail})
+                elif tail == "get" and parts and len(parts) >= 3 \
+                        and parts[-2] == "shape" \
+                        and (parts[0] in mesh_params
+                             or parts[0] == "self"):
+                    for arg in n.args[:1]:
+                        for s in _const_strings(arg):
+                            lits.append({"line": n.lineno, "axis": s,
+                                         "via": "mesh.shape.get"})
+            elif isinstance(n, ast.Subscript):
+                parts = _parts_of(n.value)
+                if parts and len(parts) >= 2 and parts[-1] == "shape" \
+                        and (parts[0] in mesh_params or parts[0] == "self"):
+                    for s in _const_strings(n.slice):
+                        lits.append({"line": n.lineno, "axis": s,
+                                     "via": "mesh.shape[]"})
+        return lits
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_fn(node, node.name, None, None)
+        elif isinstance(node, ast.ClassDef):
+            bases = []
+            for b in node.bases:
+                p = _parts_of(b)
+                if p:
+                    bases.append(".".join(p))
+            cls_rec = summary["classes"].setdefault(
+                node.name, {"bases": [], "line": node.lineno, "attrs": {}})
+            cls_rec["bases"] = bases
+            cls_rec["line"] = node.lineno
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    walk_fn(item, node.name + "." + item.name, node.name,
+                            None)
+
+    return summary
+
+
+def _scan_store(node, rec, cls, local_names, aug=False):
+    """Tracer-escape candidates: assignment of a value reading local
+    names into ``self.<attr>`` / a ``global`` name / a ``nonlocal``
+    name.  Also records ``self.attr = Descriptor(...)`` for the class
+    attr-type table (picked up at link time)."""
+    targets = [node.target] if aug else list(node.targets)
+    value = node.value
+    names = _names_read(value) if value is not None else []
+    for t in targets:
+        tp = _parts_of(t)
+        if tp and len(tp) == 2 and tp[0] == "self":
+            rec["stores"].append({
+                "line": node.lineno, "target": "self." + tp[1],
+                "attr": tp[1], "names": names})
+            if not aug and value is not None:
+                d = _descriptor(value)
+                if d is not None:
+                    rec.setdefault("attr_descs", {}).setdefault(
+                        tp[1], [])
+                    if list(d) not in rec["attr_descs"][tp[1]] \
+                            and len(rec["attr_descs"][tp[1]]) < 4:
+                        rec["attr_descs"][tp[1]].append(list(d))
+        elif isinstance(t, ast.Name):
+            if t.id in rec["globals"]:
+                rec["stores"].append({
+                    "line": node.lineno, "target": "global " + t.id,
+                    "attr": None, "names": names})
+            elif t.id in rec["nonlocals"]:
+                rec["stores"].append({
+                    "line": node.lineno, "target": "nonlocal " + t.id,
+                    "attr": None, "names": names})
+
+
+def _scan_gmut_assign(node, rec, summary, local_names, locks, ws, loop,
+                      aug=False):
+    """Module-level-mutable writes for unguarded-global-mutation:
+    ``NAME[i] = v`` / ``NAME[0] += 1`` / ``del NAME[:]`` where NAME is
+    a module-level mutable (or dotted ``mod.NAME``)."""
+    targets = [node.target] if aug else list(node.targets)
+    for t in targets:
+        base = t
+        seen_sub = False
+        while isinstance(base, ast.Subscript):
+            base = base.value
+            seen_sub = True
+        parts = _parts_of(base)
+        if not parts:
+            continue
+        # a `global`-declared name is module state even though the
+        # Assign visitor just added it to local_names
+        declared_global = parts[0] in rec["globals"]
+        if parts[0] in local_names and len(parts) == 1 \
+                and not declared_global:
+            continue        # a local, however mutated
+        if aug:
+            what = "read-modify-write"
+        elif seen_sub:
+            what = "subscript write"
+        else:
+            if not declared_global:
+                continue    # plain non-global assignment
+            # `global X; X = X + [v]` is the RMW race in rebind
+            # clothing; a wholesale rebind is atomic under the GIL
+            if node.value is None \
+                    or parts[0] not in _names_read(node.value):
+                continue
+            what = "read-modify-write"
+        rec["gmuts"].append({
+            "line": node.lineno, "parts": parts, "what": what,
+            "locks": locks, "ws": ws})
+
+
+def _scan_call(node, rec, local_names, loop, locks, ws, summary):
+    """One Call node: sync-site detection, mutator-call global
+    mutation, and the call-graph record with arg dataflow."""
+    func = node.func
+    # sync sites
+    if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+        rec["sync"].append({"line": node.lineno, "kind": func.attr,
+                            "spelled": ".%s()" % func.attr, "loop": loop})
+    elif (isinstance(func, ast.Attribute) and func.attr == "asarray"
+          and isinstance(func.value, ast.Name)
+          and func.value.id in _NP_NAMES
+          and node.args and isinstance(node.args[0], ast.Name)):
+        rec["sync"].append({"line": node.lineno, "kind": "asarray",
+                            "spelled": "np.asarray(%s)" % node.args[0].id,
+                            "loop": loop})
+    parts = _parts_of(func)
+    if parts is None:
+        return
+    tail = parts[-1]
+    # format-call hazards (str()/int()/float() over a param's value)
+    if len(parts) == 1 and tail in _FORMATTERS and node.args:
+        uses = []
+        for a in node.args:
+            uses += _value_uses(a, set(rec["params"]))
+        if uses:
+            rec["hazards"].append({"line": node.lineno, "kind": tail,
+                                   "names": sorted(set(uses))})
+    # mutator calls on module-level mutables / guarded containers
+    if tail in _MUTATORS and len(parts) >= 2:
+        base = parts[:-1]
+        if not (base[0] in local_names and len(base) == 1):
+            rec["gmuts"].append({
+                "line": node.lineno, "parts": base,
+                "what": "mutating call .%s()" % tail,
+                "locks": locks, "ws": ws})
+    # threading.Thread(target=...) — recorded on the enclosing function
+    # so ``self._worker`` resolves against its class at link time
+    if tail == "Thread":
+        for kw in node.keywords:
+            if kw.arg == "target":
+                tp = _parts_of(kw.value)
+                if tp:
+                    rec.setdefault("threads", []).append(tp)
+    # the call-graph record
+    # arg dataflow records the caller params each argument reads BY
+    # VALUE: ``helper(x)`` propagates x's traced-ness, ``helper(x.shape)``
+    # does not (shape access is static under trace)
+    params = set(rec["params"])
+    avals, argnames = [], []
+    for a in node.args:
+        if isinstance(a, ast.Starred):
+            avals.append(None)
+            argnames.append([])
+            continue
+        avals.append(_descriptor(a))
+        argnames.append(_value_uses(a, params))
+    kwvals, kwnames = {}, {}
+    for kw in node.keywords:
+        if kw.arg is None:
+            continue
+        kwvals[kw.arg] = _descriptor(kw.value)
+        kwnames[kw.arg] = _value_uses(kw.value, params)
+    rec["calls"].append({
+        "parts": parts, "line": node.lineno, "loop": loop, "ws": ws,
+        "avals": [list(d) if d else None for d in avals],
+        "args": argnames,
+        "kwvals": {k: (list(d) if d else None) for k, d in kwvals.items()},
+        "kw": kwnames,
+    })
+
+
+# ---------------------------------------------------------------------------
+# the project index: linking + dataflow
+# ---------------------------------------------------------------------------
+
+_STEP_NAME_RE = re.compile(
+    r"(^|_)(step|steps|update|updates|apply_grads?|apply_gradients?|"
+    r"sgd|adam|fbu)($|_)", re.IGNORECASE)
+_STATE_PARAM_RE = re.compile(
+    r"param|weight|state|slot|momentum|velocity|grad", re.IGNORECASE)
+_STATE_PARAM_EXACT = frozenset(("w", "ws"))
+
+_MAX_TAGS = 8          # join cap: beyond this a value is "unknown"
+_MAX_PASSES = 10       # env/return fixpoint bound
+_CHAIN_CAP = 5         # witness-chain frames in messages
+
+
+def _norm_recv(name):
+    return name.lstrip("_").replace("_", "").lower()
+
+
+class ProjectIndex:
+    """Cross-file linking of per-file summaries plus the dataflow
+    passes (see module docstring).  Construction is pure computation
+    over the summary dicts — no filesystem access — so a warm run
+    rebuilds it from cached summaries without touching an AST."""
+
+    def __init__(self, summaries):
+        # summaries: iterable of summary dicts (one per .py file)
+        self.mods = {}
+        self.fns = {}          # "mod:qual" -> function record
+        self.fn_mod = {}       # fq -> module name
+        self.fn_file = {}      # fq -> relpath
+        self.classes = {}      # "mod:Class" -> class info
+        self.method_index = {}
+        for s in summaries:
+            self.mods[s["module"]] = s
+            for qual, rec in s["functions"].items():
+                fq = s["module"] + ":" + qual
+                self.fns[fq] = rec
+                self.fn_mod[fq] = s["module"]
+                self.fn_file[fq] = s["relpath"]
+        for modname, s in self.mods.items():
+            for cname, crec in s["classes"].items():
+                cq = modname + ":" + cname
+                methods = {}
+                for qual in s["functions"]:
+                    if qual.startswith(cname + ".") \
+                            and "." not in qual[len(cname) + 1:]:
+                        methods[qual[len(cname) + 1:]] = modname + ":" + qual
+                self.classes[cq] = {
+                    "bases": crec.get("bases", []),
+                    "methods": methods,
+                    "attr_tags": {a: {"jit"} if v == ["jit"] else set()
+                                  for a, v in crec.get("attrs", {}).items()},
+                }
+                for m in methods:
+                    self.method_index.setdefault(m, []).append(cq)
+        # nested defs: parent fq -> [child fq] (closures inline under
+        # trace and run per step when their parent does)
+        self.children = {}
+        for fq, rec in self.fns.items():
+            if rec.get("parent"):
+                pfq = self.fn_mod[fq] + ":" + rec["parent"]
+                self.children.setdefault(pfq, []).append(fq)
+        self._mro_memo = {}
+        self._hier_memo = {}
+        self._mt_memo = {}
+        self._resolve_bases()
+        # MROs touched while bases were still being resolved are stale
+        self._mro_memo.clear()
+        self._hier_memo.clear()
+        self._mt_memo.clear()
+        self._memo = {}
+        self.envs = {fq: {} for fq in self.fns}
+        self.returns = {fq: set() for fq in self.fns}
+        self.edges = {fq: [] for fq in self.fns}   # [(line, loop, ws, tgt)]
+        self.dispatch = set()      # fns containing a jit dispatch call
+        self.dispatch_lines = {}   # fq -> first dispatch line
+        self._link()
+        self._compute_traced()
+        self._compute_hot()
+        self._compute_threaded()
+
+    # -- class machinery -----------------------------------------------------
+    def _resolve_bases(self):
+        for cq, info in self.classes.items():
+            mod = cq.split(":", 1)[0]
+            resolved = []
+            for b in info["bases"]:
+                tags = self._module_scope_lookup(mod, b.split("."))
+                for t in tags:
+                    if t.startswith("class:"):
+                        resolved.append(t[len("class:"):])
+            info["base_cqs"] = resolved
+        self.subclasses = {}
+        for cq, info in self.classes.items():
+            for b in info.get("base_cqs", ()):
+                self.subclasses.setdefault(b, []).append(cq)
+
+    def _mro(self, cq):
+        # class tables are frozen after _resolve_bases: memo everything
+        hit = self._mro_memo.get(cq)
+        if hit is not None:
+            return hit
+        out, queue, seen = [], [cq], set()
+        while queue:
+            c = queue.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            out.append(c)
+            queue.extend(self.classes[c].get("base_cqs", ()))
+        self._mro_memo[cq] = out
+        return out
+
+    def _hierarchy(self, cq):
+        """cq, its ancestors, and every descendant (dynamic dispatch)."""
+        hit = self._hier_memo.get(cq)
+        if hit is not None:
+            return hit
+        roots = self._mro(cq)
+        out, queue, seen = [], list(roots), set()
+        while queue:
+            c = queue.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            out.append(c)
+            queue.extend(self.subclasses.get(c, ()))
+        self._hier_memo[cq] = out
+        return out
+
+    def _method_targets(self, cq, name):
+        """Defs of ``name`` visible on an instance of ``cq``: the MRO
+        definition plus subclass overrides (dynamic dispatch)."""
+        hit = self._mt_memo.get((cq, name))
+        if hit is not None:
+            return hit
+        out = []
+        for c in self._mro(cq):
+            m = self.classes[c]["methods"].get(name)
+            if m:
+                out.append(m)
+                break
+        for c in self.subclasses.get(cq, ()):
+            for cc in self._hierarchy(c):
+                m = self.classes.get(cc, {}).get("methods", {}).get(name)
+                if m and m not in out:
+                    out.append(m)
+        self._mt_memo[(cq, name)] = out
+        return out
+
+    def _attr_tags(self, cq, attr):
+        tags = set()
+        for c in self._mro(cq):
+            tags |= self.classes[c]["attr_tags"].get(attr, set())
+        return tags
+
+    # -- name resolution -----------------------------------------------------
+    def _module_scope_lookup(self, mod, parts, _active=None):
+        """Tags for a dotted reference evaluated at module scope.
+        ``_active`` guards re-export cycles (``pkg/__init__`` importing
+        from a submodule that imports back) — an in-progress lookup
+        resolves to nothing rather than recursing forever."""
+        s = self.mods.get(mod)
+        if s is None or not parts:
+            return set()
+        key = (mod, tuple(parts))
+        if _active is None:
+            _active = set()
+        if key in _active or len(_active) > 24:
+            return set()
+        _active = _active | {key}
+        head, rest = parts[0], parts[1:]
+        if head in s["functions"] and s["functions"][head]["class"] is None:
+            return self._chain({"fn:%s:%s" % (mod, head)}, rest)
+        if head in s["classes"]:
+            return self._chain({"class:%s:%s" % (mod, head)}, rest)
+        if head in s["jit_names"]:
+            return {"jit"} if not rest else set()
+        target = s["imports"].get(head)
+        if target is None:
+            return set()
+        # longest module prefix match: ``import mxnet_tpu`` +
+        # ``mxnet_tpu.engine.record_exception``
+        full = target.split(".") + rest
+        for cut in range(len(full), 0, -1):
+            cand = ".".join(full[:cut])
+            if cand in self.mods:
+                if cut == len(full):
+                    return {"module:" + cand}
+                return self._chain(self._module_scope_lookup(
+                    cand, full[cut:cut + 1], _active), full[cut + 1:])
+        return set()
+
+    def _chain(self, tags, rest):
+        """Resolve attribute access ``rest`` against value ``tags``."""
+        for part in rest:
+            nxt = set()
+            for t in tags:
+                if t.startswith("module:"):
+                    nxt |= self._module_scope_lookup(
+                        t[len("module:"):], [part])
+                elif t.startswith("cls:"):
+                    cq = t[len("cls:"):]
+                    for m in self._method_targets(cq, part):
+                        nxt.add("fn:" + m)
+                    nxt |= self._attr_tags(cq, part)
+                elif t.startswith("class:"):
+                    cq = t[len("class:"):]
+                    for m in self._method_targets(cq, part):
+                        nxt.add("fn:" + m)
+            tags = nxt
+            # value tags join-cap at _MAX_TAGS; fn targets may fan out
+            # wider — dynamic dispatch over a hierarchy (every
+            # Optimizer.update override) is a legitimate edge set
+            if not tags or len(tags) > 32 \
+                    or sum(1 for t in tags
+                           if not t.startswith("fn:")) > _MAX_TAGS:
+                return set()
+        return tags
+
+    def _eval_descriptor(self, fq, d, depth=0):
+        """Tags for a ``("call"|"ref", parts)`` descriptor inside fq."""
+        if d is None or depth > 6:
+            return set()
+        kind, parts = d[0], list(d[1])
+        tags = self._resolve_value(fq, parts, depth + 1)
+        if kind == "ref":
+            return tags
+        # a call: the result of invoking the resolved value
+        tail = parts[-1] if parts else ""
+        if tail in _JIT_TAILS or tail == "shard_map":
+            return {"jit"}
+        if tail == "__new__":
+            # ``cls.__new__(cls)`` — the from_parts/reshape rebind idiom
+            ctor = self._resolve_value(fq, parts[:-1], depth + 1)
+            return {"cls:" + t[len("class:"):] for t in ctor
+                    if t.startswith("class:")}
+        if not tags and len(parts) >= 2 \
+                and parts[-1] not in _FALLBACK_STOPLIST:
+            tags = {"fn:" + t for t in self._fallback_targets(parts)}
+        out = set()
+        for t in tags:
+            if t.startswith("class:"):
+                out.add("cls:" + t[len("class:"):])
+            elif t.startswith("fn:"):
+                out |= self.returns.get(t[len("fn:"):], set())
+            elif t.startswith("cls:"):
+                # calling an instance: __call__'s return type
+                cq = t[len("cls:"):]
+                for m in self._method_targets(cq, "__call__"):
+                    out |= self.returns.get(m, set())
+        return out if len(out) <= _MAX_TAGS else set()
+
+    def _resolve_value(self, fq, parts, depth=0):
+        """Tags for a dotted reference in function ``fq``'s scope."""
+        if not parts or depth > 8:
+            return set()
+        rec = self.fns.get(fq)
+        if rec is None:
+            return self._module_scope_lookup(fq.split(":", 1)[0], parts)
+        mod = self.fn_mod[fq]
+        head, rest = parts[0], parts[1:]
+        if head == "self" and rec["class"]:
+            return self._chain({"cls:%s:%s" % (mod, rec["class"])}, rest)
+        if head == "cls" and rec["class"]:
+            return self._chain({"class:%s:%s" % (mod, rec["class"])}, rest)
+        env = self.envs.get(fq, {})
+        if head in env:
+            return self._chain(env[head], rest)
+        # nested defs visible by name
+        child = fq + "." + head
+        if child in self.fns:
+            return self._chain({"fn:" + child}, rest)
+        # enclosing-function locals for nested defs (closures)
+        parent = rec.get("parent")
+        while parent:
+            pfq = mod + ":" + parent
+            penv = self.envs.get(pfq, {})
+            if head in penv:
+                return self._chain(penv[head], rest)
+            sib = pfq + "." + head
+            if sib in self.fns:
+                return self._chain({"fn:" + sib}, rest)
+            parent = self.fns.get(pfq, {}).get("parent")
+        return self._module_scope_lookup(mod, parts)
+
+    def _call_targets(self, fq, call):
+        """(fn targets, is_dispatch) for one summarized call site."""
+        parts = call["parts"]
+        tags = self._resolve_value(fq, parts)
+        targets, dispatch = [], False
+        for t in tags:
+            if t == "jit":
+                dispatch = True
+            elif t.startswith("fn:"):
+                tgt = t[len("fn:"):]
+                targets.append(tgt)
+                root = self.fns[tgt].get("jit_root")
+                if root and root["kind"] in ("jit",):
+                    dispatch = True    # decorated: the name IS compiled
+            elif t.startswith("class:"):
+                cq = t[len("class:"):]
+                m = None
+                for c in self._mro(cq):
+                    m = self.classes[c]["methods"].get("__init__")
+                    if m:
+                        break
+                if m:
+                    targets.append(m)
+            elif t.startswith("cls:"):
+                for m in self._method_targets(t[len("cls:"):], "__call__"):
+                    targets.append(m)
+        if not targets and not dispatch and len(parts) >= 2 \
+                and parts[-1] not in _FALLBACK_STOPLIST:
+            targets = self._fallback_targets(parts)
+        return targets, dispatch
+
+    def _fallback_targets(self, parts):
+        """Conservative dynamic-dispatch fallback: ``recv.meth(...)``
+        with an unresolvable receiver links to a project hierarchy
+        whose class name matches the receiver's name (``optimizer.
+        update`` -> the Optimizer hierarchy's update defs)."""
+        meth = parts[-1]
+        recv = parts[-2] if parts[-2] != "self" else (
+            parts[-3] if len(parts) >= 3 else "")
+        classes = self.method_index.get(meth, ())
+        if not classes or not recv:
+            return []
+        nrecv = _norm_recv(recv)
+        if len(nrecv) < 3:
+            return []
+        matched = []
+        for cq in classes:
+            cname = cq.split(":", 1)[1].lower()
+            if nrecv == cname or nrecv.endswith(cname) \
+                    or cname.endswith(nrecv):
+                matched.append(cq)
+        if not matched:
+            return []
+        roots = {self._mro(c)[-1] for c in matched}
+        if len(roots) != 1:
+            return []
+        root = roots.pop()
+        out = []
+        for cq in self._hierarchy(root):
+            m = self.classes.get(cq, {}).get("methods", {}).get(meth)
+            if m and m not in out:
+                out.append(m)
+        return out if len(out) <= 24 else []
+
+    # -- fixpoint: envs, returns, edges --------------------------------------
+    def _link(self):
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for fq, rec in self.fns.items():
+                env = self.envs[fq]
+                for name, descs in rec["assigns"].items():
+                    tags = set()
+                    for d in descs:
+                        tags |= self._eval_descriptor(fq, d)
+                    if tags and len(tags) <= _MAX_TAGS \
+                            and tags - env.get(name, set()):
+                        env.setdefault(name, set())
+                        env[name] |= tags
+                        changed = True
+                # constructor-typed self attributes
+                if rec["class"]:
+                    cq = self.fn_mod[fq] + ":" + rec["class"]
+                    for attr, descs in rec.get("attr_descs", {}).items():
+                        tags = set()
+                        for d in descs:
+                            tags |= self._eval_descriptor(fq, d)
+                        cur = self.classes[cq]["attr_tags"].setdefault(
+                            attr, set())
+                        if tags and tags - cur:
+                            cur |= tags
+                            changed = True
+                ret = set()
+                for d in rec["returns"]:
+                    ret |= self._eval_descriptor(fq, d)
+                if ret and len(ret) <= _MAX_TAGS \
+                        and ret - self.returns[fq]:
+                    self.returns[fq] |= ret
+                    changed = True
+            # call edges + param-value propagation
+            for fq, rec in self.fns.items():
+                edges = []
+                for call in rec["calls"]:
+                    targets, dispatch = self._call_targets(fq, call)
+                    if dispatch and fq not in self.dispatch:
+                        self.dispatch.add(fq)
+                        self.dispatch_lines[fq] = call["line"]
+                        changed = True
+                    for tgt in targets:
+                        edges.append((call["line"], call["loop"],
+                                      call["ws"], tgt))
+                        tparams = self.fns[tgt]["params"]
+                        tenv = self.envs[tgt]
+                        for i, d in enumerate(call["avals"]):
+                            if d is None or i >= len(tparams):
+                                continue
+                            tags = self._eval_descriptor(fq, d)
+                            if tags and len(tags) <= _MAX_TAGS and \
+                                    tags - tenv.get(tparams[i], set()):
+                                tenv.setdefault(tparams[i], set())
+                                tenv[tparams[i]] |= tags
+                                changed = True
+                        for k, d in call["kwvals"].items():
+                            if d is None or k not in tparams:
+                                continue
+                            tags = self._eval_descriptor(fq, d)
+                            if tags and len(tags) <= _MAX_TAGS and \
+                                    tags - tenv.get(k, set()):
+                                tenv.setdefault(k, set())
+                                tenv[k] |= tags
+                                changed = True
+                if edges != self.edges[fq]:
+                    self.edges[fq] = edges
+                    changed = True
+            if not changed:
+                break
+
+    # -- jit roots + traced-parameter propagation ----------------------------
+    def _bind_targets(self, summary, bind):
+        scope = (summary["module"] + ":" + bind["owner"]
+                 if bind["owner"] else None)
+        if scope and scope in self.fns:
+            tags = self._resolve_value(scope, bind["parts"])
+        else:
+            tags = self._module_scope_lookup(summary["module"],
+                                             bind["parts"])
+        return [t[len("fn:"):] for t in tags if t.startswith("fn:")]
+
+    def _compute_traced(self):
+        """roots + per-param traced-ness through resolved call sites."""
+        self.roots = {}          # fq -> {"kind", "line", "donate", ...}
+        self.local_rooted = set()   # roots the per-file checker covers
+        self.traced = {}         # fq -> set(traced param names)
+        self.traced_via = {}     # (fq, param) -> (caller fq, line) | None
+        work = []
+
+        def seed(fq, kind, static_names, static_nums, line, donate,
+                 same_module, bind_mod=None):
+            rec = self.fns[fq]
+            params = rec["params"]
+            static = set(static_names)
+            static |= {params[i] for i in static_nums
+                       if 0 <= i < len(params)}
+            info = self.roots.setdefault(
+                fq, {"kind": kind, "line": line, "donate": donate,
+                     "static": set(), "bind_mod": bind_mod})
+            info["static"] |= static
+            info["donate"] = info["donate"] or donate
+            # only jit binds are visible to the per-file recompile pass;
+            # every other root kind is reported by the project pass
+            if same_module and kind == "jit":
+                self.local_rooted.add(fq)
+            for p in params:
+                if p not in static:
+                    self._mark_traced(fq, p, None, work)
+
+        defvjp_binds = []
+        for modname, s in self.mods.items():
+            for qual, rec in s["functions"].items():
+                root = rec.get("jit_root")
+                if root:
+                    fq = modname + ":" + qual
+                    seed(fq, root["kind"], root["static"], (),
+                         root["line"], root["donate"], True)
+            for bind in s["jit_binds"]:
+                if bind["kind"] == "defvjp":
+                    defvjp_binds.append((s, bind))
+                    continue
+                raw = bind.get("call_static_raw", {})
+                for fq in self._bind_targets(s, bind):
+                    seed(fq, bind["kind"], raw.get("names", ()),
+                         raw.get("nums", ()), bind["line"], bind["donate"],
+                         self.fn_mod[fq] == modname, bind_mod=modname)
+                    if bind["kind"] == "jit" \
+                            and self.fn_mod[fq] != modname:
+                        # every cross-module jit bind keeps its OWN
+                        # donation decision: a donated bind in one
+                        # module must not launder an undonated bind of
+                        # the same step elsewhere
+                        self.roots[fq].setdefault("jit_binds", []).append(
+                            {"mod": modname, "line": bind["line"],
+                             "donate": bind["donate"]})
+        # defvjp rules second: the primal's nondiff/static params (now
+        # seeded above) transfer to the rules BY NAME — the fwd rule
+        # shares the primal's signature, the bwd rule's (res, ct) names
+        # never collide with them
+        for s, bind in defvjp_binds:
+            primal_static = set(bind.get("call_static_raw",
+                                         {}).get("names", ()))
+            primal = bind.get("primal")
+            if primal:
+                scope = (s["module"] + ":" + bind["owner"]
+                         if bind["owner"] else None)
+                tags = (self._resolve_value(scope, primal)
+                        if scope and scope in self.fns
+                        else self._module_scope_lookup(s["module"], primal))
+                for t in tags:
+                    if t.startswith("fn:") and t[3:] in self.roots:
+                        primal_static |= self.roots[t[3:]]["static"]
+            for fq in self._bind_targets(s, bind):
+                seed(fq, "defvjp", sorted(primal_static), (),
+                     bind["line"], bind["donate"],
+                     self.fn_mod[fq] == s["module"], bind_mod=s["module"])
+        while work:
+            fq = work.pop()
+            rec = self.fns[fq]
+            tr = self.traced.get(fq, set())
+            if not tr:
+                continue
+            for call in rec["calls"]:
+                targets, _dispatch = self._call_targets(fq, call)
+                for tgt in targets:
+                    tparams = self.fns[tgt]["params"]
+                    for i, names in enumerate(call["args"]):
+                        if i < len(tparams) and tr.intersection(names):
+                            self._mark_traced(tgt, tparams[i],
+                                              (fq, call["line"]), work)
+                    for k, names in call["kw"].items():
+                        if k in tparams and tr.intersection(names):
+                            self._mark_traced(tgt, k,
+                                              (fq, call["line"]), work)
+            # nested defs trace with the parent (closures inline)
+            for child_fq in self.children.get(fq, ()):
+                if child_fq not in self.traced:
+                    self.traced[child_fq] = set()
+                    work.append(child_fq)
+
+    def _mark_traced(self, fq, param, via, work):
+        cur = self.traced.setdefault(fq, set())
+        if param in cur:
+            return
+        cur.add(param)
+        self.traced_via.setdefault((fq, param), via)
+        work.append(fq)
+
+    # -- the per-step host path ----------------------------------------------
+    def _compute_hot(self):
+        """reaches-dispatch closure -> step drivers -> hot set."""
+        reaches = set(self.dispatch)
+        callers = {}
+        for fq, edges in self.edges.items():
+            for _line, _loop, _ws, tgt in edges:
+                callers.setdefault(tgt, set()).add(fq)
+        work = list(reaches)
+        while work:
+            fq = work.pop()
+            for c in callers.get(fq, ()):
+                if c not in reaches:
+                    reaches.add(c)
+                    work.append(c)
+        self.reaches_dispatch = reaches
+
+        self.drivers = {}      # fq -> line of the dispatching loop call
+        for fq, rec in self.fns.items():
+            for call in rec["calls"]:
+                if not call["loop"]:
+                    continue
+                targets, dispatch = self._call_targets(fq, call)
+                if dispatch or any(t in reaches for t in targets):
+                    self.drivers.setdefault(fq, call["line"])
+            # a loop whose body dispatches directly (sync sites aside)
+            if fq in self.dispatch and fq not in self.drivers:
+                for call in rec["calls"]:
+                    if call["loop"]:
+                        _t, dispatch = self._call_targets(fq, call)
+                        if dispatch:
+                            self.drivers.setdefault(fq, call["line"])
+
+        # hot = closure of callees from driver loops + traced functions
+        self.hot = {}          # fq -> (via fq | None, kind)
+        work = []
+        for fq in sorted(self.traced):
+            if fq not in self.hot:
+                self.hot[fq] = (None, "jit-region")
+                work.append(fq)
+        for fq in sorted(self.drivers):
+            rec = self.fns[fq]
+            for call in rec["calls"]:
+                if not call["loop"]:
+                    continue
+                targets, _d = self._call_targets(fq, call)
+                for tgt in sorted(targets):
+                    # a recursive driver must not become its own via —
+                    # the chain would walk the self-edge forever
+                    if tgt not in self.hot and tgt != fq:
+                        self.hot[tgt] = (fq, "step-loop")
+                        work.append(tgt)
+        while work:
+            fq = work.pop(0)
+            for _line, _loop, _ws, tgt in self.edges.get(fq, ()):
+                if tgt not in self.hot and tgt != fq:
+                    self.hot[tgt] = (fq, self.hot[fq][1])
+                    work.append(tgt)
+            for child_fq in self.children.get(fq, ()):
+                if child_fq not in self.hot:
+                    self.hot[child_fq] = (fq, self.hot[fq][1])
+                    work.append(child_fq)
+
+    def _compute_threaded(self):
+        """functions reachable from Thread targets / worker_scope."""
+        seeds = {}
+        for fq, rec in self.fns.items():
+            for tp in rec.get("threads", ()):
+                tags = self._resolve_value(fq, tp)
+                for t in tags:
+                    if t.startswith("fn:"):
+                        seeds.setdefault(t[len("fn:"):], fq)
+            for call in rec["calls"]:
+                if call["ws"]:
+                    targets, _d = self._call_targets(fq, call)
+                    for tgt in targets:
+                        seeds.setdefault(tgt, fq)
+        self.threaded = dict(seeds)     # fq -> spawning fq
+        work = list(seeds)
+        while work:
+            fq = work.pop()
+            for _line, _loop, _ws, tgt in self.edges.get(fq, ()):
+                if tgt not in self.threaded:
+                    self.threaded[tgt] = fq
+                    work.append(tgt)
+
+    # -- witness chains ------------------------------------------------------
+    def _short(self, fq):
+        return fq.split(":", 1)[1]
+
+    def hot_chain(self, fq):
+        names, cur, seen = [], fq, {fq}
+        while len(names) < _CHAIN_CAP:
+            via, _kind = self.hot.get(cur, (None, None))
+            if via is None or via in seen:   # root, or mutual recursion
+                break
+            seen.add(via)
+            names.append(self._short(via))
+            cur = via
+        return " -> ".join(reversed(names))
+
+    def traced_chain(self, fq, param):
+        frames, cur, seen = [], (fq, param), {fq}
+        while len(frames) < _CHAIN_CAP:
+            via = self.traced_via.get(cur)
+            if not via:
+                break
+            caller, _line = via
+            if caller in seen:               # recursion in the witness
+                break
+            seen.add(caller)
+            frames.append(self._short(caller))
+            nxt = None
+            for p in self.traced.get(caller, ()):
+                if self.traced_via.get((caller, p)) is not None:
+                    nxt = (caller, p)
+                    break
+            if nxt is None or caller in self.roots:
+                break
+            cur = nxt
+        return " -> ".join(reversed(frames))

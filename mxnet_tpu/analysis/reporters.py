@@ -1,9 +1,20 @@
-"""Finding reporters — human-readable text and machine JSON."""
+"""Finding reporters — human text, machine JSON, and SARIF 2.1.0.
+
+The SARIF form exists for CI surfaces: GitHub's code-scanning upload
+(and most PR-annotation bots) consume SARIF 2.1.0, so
+``tools/lint.py --sarif`` lets the lint gate annotate the diff instead
+of failing with a log to dig through.  Baselined findings are emitted
+with a ``suppressions`` entry (kind ``external``) rather than dropped —
+SARIF viewers then show them greyed out, which matches the baseline's
+"visible accepted debt" contract."""
 from __future__ import annotations
 
 import json
 
-__all__ = ["human_report", "json_report"]
+__all__ = ["human_report", "json_report", "sarif_report"]
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                 "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def human_report(new, baselined=(), show_baselined=False):
@@ -36,6 +47,54 @@ def human_report(new, baselined=(), show_baselined=False):
                     warnings, "s" if warnings != 1 else "",
                     len(baselined)))
     return "\n".join(lines)
+
+
+def sarif_report(new, baselined=()):
+    """Minimal-schema SARIF 2.1.0: one run, one driver, one result per
+    finding, line-free fingerprints carried as partialFingerprints so
+    annotation dedup survives unrelated edits."""
+    rules = {}
+    results = []
+    for findings, suppressed in ((new, False), (baselined, True)):
+        for f in findings:
+            rules.setdefault(f.rule, {
+                "id": f.rule,
+                "defaultConfiguration": {
+                    "level": "error" if f.severity == "error"
+                    else "warning"},
+            })
+            result = {
+                "ruleId": f.rule,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(1, f.line)},
+                    },
+                }],
+                "partialFingerprints": {
+                    "graftlintFingerprint/v1": f.fingerprint},
+            }
+            if f.symbol:
+                result["locations"][0]["logicalLocations"] = [
+                    {"fullyQualifiedName": f.symbol}]
+            if suppressed:
+                result["suppressions"] = [{"kind": "external"}]
+            results.append(result)
+    return json.dumps({
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/faq/static_analysis.md",
+                "rules": [rules[k] for k in sorted(rules)],
+            }},
+            "results": results,
+        }],
+    }, indent=1)
 
 
 def json_report(new, baselined=()):

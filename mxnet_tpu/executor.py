@@ -491,7 +491,9 @@ class Executor:
             if isinstance(v, NDArray):
                 tgt._data = v._data.astype(tgt.dtype) if v.dtype != tgt.dtype else v._data
             else:
-                tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)
+                # h2d staging of a host-provided feed (numpy/list), not
+                # a device round-trip — np.asarray on host data is free
+                tgt._data = jnp.asarray(np.asarray(v), dtype=tgt.dtype)  # graftlint: disable=host-sync
         args, aux = self._args(), self._aux()
         if is_train and self._fused_update is not None:
             # steady-state fused steps consume the device-resident key

@@ -22,7 +22,9 @@ __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
 
 
 def _as_np(img):
-    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+    # deliberate sync: vision transforms are host-side input-pipeline
+    # ops by design (they run in the loader, upstream of the device)
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)  # graftlint: disable=host-sync
 
 
 class Compose(Sequential):

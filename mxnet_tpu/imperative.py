@@ -36,7 +36,10 @@ def _engine_naive():
         return True
     if not _NAIVE_CACHE:
         from . import config as _config
-        _NAIVE_CACHE.append(
+        # benign memo race: the append is atomic under the GIL and the
+        # cached value is the same env read on every thread — worst
+        # case is a duplicate one-element append, same answer
+        _NAIVE_CACHE.append(  # graftlint: disable=unguarded-global-mutation
             _config.get("MXNET_ENGINE_TYPE") == "NaiveEngine")
     return _NAIVE_CACHE[0]
 

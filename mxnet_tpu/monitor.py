@@ -27,8 +27,10 @@ def _mean_abs(arr):
 def _render_stat(value):
     """Stringify one collected statistic (NDArray, list, or scalar)."""
     items = value if isinstance(value, list) else [value]
+    # deliberate sync: Monitor IS a debugging probe — stringifying the
+    # watched arrays is its entire job, and it only runs when installed
     return ",".join(
-        str(v.asnumpy()) if isinstance(v, NDArray) else str(v)
+        str(v.asnumpy()) if isinstance(v, NDArray) else str(v)  # graftlint: disable=host-sync
         for v in items)
 
 
@@ -115,7 +117,10 @@ class Monitor:
         """Block until installed executors' argument arrays are readable."""
         for exe in self._executors:
             for arr in exe.arg_arrays:
-                arr.wait_to_read()
+                # deliberate sync: the monitor's pre-step barrier —
+                # stats must read settled values, and it only runs
+                # when a Monitor is installed
+                arr.wait_to_read()  # graftlint: disable=host-sync
 
     def _snapshot_args(self):
         """Record weight/input statistics alongside the node outputs."""

@@ -157,7 +157,11 @@ _rs_jit_cache = {}
 def _rs_jit(fn):
     import jax
     if fn.__name__ not in _rs_jit_cache:
-        _rs_jit_cache[fn.__name__] = jax.jit(fn, donate_argnums=())
+        # benign memo race: dict item writes are atomic under the GIL
+        # and entries are idempotent (same fn -> equivalent jit
+        # wrapper) — worst case two threads compile once each and the
+        # last write wins; a lock here would serialize trace time
+        _rs_jit_cache[fn.__name__] = jax.jit(fn, donate_argnums=())  # graftlint: disable=unguarded-global-mutation
     return _rs_jit_cache[fn.__name__]
 
 

@@ -93,9 +93,14 @@ def build_bucket_plan(names, shapes, bucket_bytes, first_bucket_bytes=None,
 def flatten_bucket(values, bucket):
     """Fuse one bucket's per-param arrays into its padded 1-D fp32
     buffer (traceable: used inside the compiled step)."""
-    flat = jnp.concatenate([v.reshape(-1).astype(jnp.float32)
-                            for v in values]) if values else \
-        jnp.zeros((0,), jnp.float32)
+    # `values` is a Python LIST of arrays — its truthiness is its
+    # length, static at trace time (an empty bucket never reads an
+    # array's value)
+    if values:  # graftlint: disable=recompile-hazard
+        flat = jnp.concatenate([v.reshape(-1).astype(jnp.float32)
+                                for v in values])
+    else:
+        flat = jnp.zeros((0,), jnp.float32)
     if bucket.padded_n != bucket.n:
         flat = jnp.concatenate(
             [flat, jnp.zeros((bucket.padded_n - bucket.n,), jnp.float32)])

@@ -1,16 +1,22 @@
 """graftlint (mxnet_tpu/analysis): fixture-backed checker tests, the
-suppression and baseline machinery, the CLI surface, and the tier-1
-gate that runs the full analyzer over the real tree against the
-committed baseline.
+whole-program engine (call graph, jit-boundary dataflow, incremental
+cache), the suppression and baseline machinery, the CLI surface, and
+the tier-1 gate that runs the full analyzer over the real tree against
+the committed baseline.
 
 Each rule gets a known-bad snippet (must detect), a known-good snippet
 (must stay silent), and a suppressed variant (inline comment wins).
+Interprocedural rules get multi-file fixture *packages* exercising
+cross-module call resolution, method resolution through ``self.``, and
+import-cycle tolerance.
 """
+import functools
 import json
 import os
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -28,7 +34,47 @@ def _lint(tmp_path, name, source, rule, root=None):
                         root=str(root or tmp_path))
 
 
-# -- recompile-hazard --------------------------------------------------------
+def _pkg(tmp_path, files, rule=None, sub="pkg"):
+    """Write a fixture package (relpath -> source) and lint the tree."""
+    for rel, src in files.items():
+        p = tmp_path / sub / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return analysis.run([str(tmp_path)],
+                        rules=[rule] if rule else None,
+                        root=str(tmp_path))
+
+
+@functools.lru_cache(maxsize=1)
+def _tree_findings():
+    """ONE full-tree analyzer run shared by the tier-1 gate tests (the
+    whole-program phase is the expensive part; the gates assert
+    different properties of the same run)."""
+    return tuple(analysis.run([os.path.join(ROOT, "mxnet_tpu")]))
+
+
+# a self-contained hot path: a compiled program dispatched from a loop,
+# with the sync one call below the loop — the engine must derive
+# hot-ness, there are no name lists to hit
+_HOT_SRC = """
+    import jax
+
+    @jax.jit
+    def prog(x):
+        return x * 2
+
+    class S:
+        def _worker(self):
+            while True:
+                self._execute([1])
+
+        def _execute(self, reqs):
+            out = prog(reqs)
+            return [r.out.asnumpy() for r in reqs]
+"""
+
+
+# -- recompile-hazard (per-file) ---------------------------------------------
 
 def test_recompile_hazard_value_branch_detected(tmp_path):
     findings = _lint(tmp_path, "m.py", """
@@ -104,47 +150,568 @@ def test_recompile_hazard_static_argnames_excluded(tmp_path):
     assert findings == []
 
 
+# -- the whole-program engine ------------------------------------------------
+
+def test_interprocedural_hazard_two_hops_with_chain(tmp_path):
+    """THE tentpole acceptance case: a value branch two call hops below
+    the jit boundary, across modules, reported at the offending line
+    with the witness chain in the message."""
+    findings = _pkg(tmp_path, {
+        "helper.py": """
+            def inner(v):
+                if v > 0:          # 2 hops below the jit boundary
+                    return v
+                return -v
+
+            def middle(g):
+                return inner(g)
+        """,
+        "step.py": """
+            import jax
+            from .helper import middle
+
+            def step_fn(w, g):
+                return w - middle(g)
+
+            fast = jax.jit(step_fn)
+        """,
+    }, rule="recompile-hazard")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("helper.py")
+    assert f.symbol == "inner"
+    assert "traced via" in f.message
+    assert "step_fn" in f.message and "middle" in f.message
+
+
+def test_interprocedural_static_args_do_not_propagate(tmp_path):
+    """x.shape passed to a helper is static — the helper's param must
+    NOT be marked traced (the gradient_compression FP class)."""
+    findings = _pkg(tmp_path, {
+        "m.py": """
+            import jax
+
+            def helper(shape):
+                if shape[0] > 1:
+                    return shape
+                return shape
+
+            def step_fn(g):
+                return helper(g.shape)
+
+            fast = jax.jit(step_fn)
+        """,
+    }, rule="recompile-hazard")
+    assert findings == []
+
+
+def test_custom_vjp_nondiff_argnums_are_static(tmp_path):
+    """nondiff_argnums params are plain Python under the rules — the
+    ops/loss.py false-positive class."""
+    files = {
+        "m.py": """
+            import jax
+            from functools import partial
+
+            def helper(x, flag):
+                if flag:
+                    return x
+                return -x
+
+            @partial(jax.custom_vjp, nondiff_argnums=(1,))
+            def core(x, flag):
+                return helper(x, flag)
+
+            def core_fwd(x, flag):
+                return core(x, flag), None
+
+            def core_bwd(flag, res, ct):
+                return (ct,)
+
+            core.defvjp(core_fwd, core_bwd)
+        """,
+    }
+    assert _pkg(tmp_path, files, rule="recompile-hazard") == []
+    # positive control: drop the nondiff declaration -> the same branch
+    # is a finding (flag is traced through the custom_vjp boundary)
+    bad = {"m.py": files["m.py"].replace(
+        "@partial(jax.custom_vjp, nondiff_argnums=(1,))",
+        "@jax.custom_vjp")}
+    findings = _pkg(tmp_path / "b", bad, rule="recompile-hazard")
+    assert any(f.symbol == "helper" for f in findings)
+
+
+def test_import_cycle_tolerated(tmp_path):
+    """Mutually-importing modules must link without recursion blowups,
+    and findings on the cycle still surface."""
+    findings = _pkg(tmp_path, {
+        "a.py": """
+            import jax
+            from . import b
+
+            def step_fn(g):
+                return b.helper(g)
+
+            fast = jax.jit(step_fn)
+        """,
+        "b.py": """
+            from . import a
+
+            def helper(v):
+                if v > 0:
+                    return v
+                return -v
+        """,
+    }, rule="recompile-hazard")
+    assert len(findings) == 1
+    assert findings[0].path.endswith("b.py")
+
+
+def test_method_resolution_through_typed_attributes(tmp_path):
+    """The serving-chain shape: a sync three frames below the batcher
+    loop, resolved through a constructor-typed attribute, a classmethod
+    returning cls, and an instance method — no name lists anywhere."""
+    findings = _pkg(tmp_path, {
+        "predictor.py": """
+            import jax
+
+            @jax.jit
+            def _prog(x):
+                return x
+
+            class Predictor:
+                @classmethod
+                def from_parts(cls):
+                    p = cls.__new__(cls)
+                    return p
+
+                def forward(self, x):
+                    return _prog(x)
+        """,
+        "cache.py": """
+            from .predictor import Predictor
+
+            class Cache:
+                def lookup(self):
+                    pred = Predictor.from_parts()
+                    return pred
+        """,
+        "server.py": """
+            from .cache import Cache
+
+            class Server:
+                def __init__(self):
+                    self.cache = Cache()
+
+                def _worker(self):
+                    while True:
+                        self._step()
+
+                def _step(self):
+                    pred = self.cache.lookup()
+                    out = pred.forward(1)
+                    return out.asnumpy()
+        """,
+    }, rule="host-sync")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("server.py")
+    assert f.symbol == "Server._step"
+    assert "Server._worker" in f.message
+
+
 # -- host-sync ---------------------------------------------------------------
 
-def test_host_sync_detected_in_hot_path(tmp_path):
-    findings = _lint(tmp_path, "serving/server.py", """
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """, "host-sync")
+def test_host_sync_detected_on_derived_hot_path(tmp_path):
+    findings = _lint(tmp_path, "serving/server.py", _HOT_SRC, "host-sync")
     assert len(findings) == 1
     assert "device->host sync" in findings[0].message
+    assert "reached from" in findings[0].message
     assert findings[0].severity == "warning"
+    assert findings[0].symbol == "S._execute"
 
 
-def test_host_sync_loop_rule_and_cold_module(tmp_path):
-    # loop in a hot module, outside the designated hot functions
-    findings = _lint(tmp_path, "optimizer.py", """
+def test_host_sync_dispatching_loop_vs_cold_code(tmp_path):
+    # a loop that drives a compiled program: the sync inside is per-step
+    findings = _lint(tmp_path, "sweep.py", """
+        import jax
+
+        @jax.jit
+        def prog(x):
+            return x
+
         def sweep(arrs):
             out = 0.0
             for a in arrs:
-                out += a.asscalar()
+                out += prog(a).asscalar()
             return out
     """, "host-sync")
     assert len(findings) == 1
-    # identical code in a cold module: silent
-    assert _lint(tmp_path, "image/image.py", """
+    assert "dispatching loop" in findings[0].message
+    # identical loop with no compiled program anywhere: cold, silent
+    assert _lint(tmp_path, "cold.py", """
+        def prog(x):
+            return x
+
         def sweep(arrs):
             out = 0.0
             for a in arrs:
-                out += a.asscalar()
+                out += prog(a).asscalar()
             return out
     """, "host-sync") == []
 
 
 def test_host_sync_suppression_comment(tmp_path):
-    findings = _lint(tmp_path, "serving/server.py", """
-        class S:
-            def _execute(self, reqs):
-                # deliberate: result delivery
-                return [r.out.asnumpy() for r in reqs]  # graftlint: disable=host-sync
-    """, "host-sync")
+    findings = _lint(tmp_path, "serving/server.py", _HOT_SRC.replace(
+        "return [r.out.asnumpy() for r in reqs]",
+        "return [r.out.asnumpy() for r in reqs]  # graftlint: disable=host-sync"),
+        "host-sync")
     assert findings == []
+
+
+def test_host_sync_closure_inherits_hotness(tmp_path):
+    """A closure defined inside a hot function runs per step — hot-ness
+    is inherited by enclosure, not derived from the closure's name."""
+    findings = _lint(tmp_path, "serving/server.py", """
+        import jax
+
+        @jax.jit
+        def prog(x):
+            return x * 2
+
+        class S:
+            def _worker(self):
+                while True:
+                    self._execute([1])
+
+            def _execute(self, reqs):
+                out = prog(reqs)
+
+                def deliver(r):
+                    return r.out.asnumpy()
+                return [deliver(r) for r in reqs]
+    """, "host-sync")
+    assert len(findings) == 1
+    assert findings[0].symbol == "S._execute.deliver"
+
+
+# -- tracer-escape -----------------------------------------------------------
+
+_ESCAPE_SRC = """
+    import jax
+
+    class T:
+        def step_fn(self, w, g):
+            self._last_grad = g        # leaked tracer
+            return w - g
+
+        def build(self):
+            self._jit = jax.jit(self.step_fn)
+"""
+
+
+def test_tracer_escape_detected(tmp_path):
+    findings = _lint(tmp_path, "m.py", _ESCAPE_SRC, "tracer-escape")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.severity == "error"
+    assert "self._last_grad" in f.message
+    assert "outlives the trace" in f.message
+    assert f.symbol == "T.step_fn"
+
+
+def test_tracer_escape_good_and_suppressed(tmp_path):
+    # storing OUTSIDE the traced region, or storing non-traced values,
+    # is fine; the suppressed variant wins
+    assert _lint(tmp_path, "m.py", """
+        import jax
+
+        class T:
+            def step_fn(self, w, g):
+                return w - g
+
+            def build(self):
+                self._jit = jax.jit(self.step_fn)
+
+            def drive(self, w, g):
+                out = self._jit(w, g)
+                self._last = out       # host side: after dispatch, fine
+                return out
+
+            def config(self, opts):
+                self._opts = opts      # not traced anywhere
+    """, "tracer-escape") == []
+    assert _lint(tmp_path, "s.py", _ESCAPE_SRC.replace(
+        "self._last_grad = g        # leaked tracer",
+        "self._last_grad = g  # graftlint: disable=tracer-escape"),
+        "tracer-escape") == []
+
+
+def test_tracer_escape_deep_store_via_global(tmp_path):
+    findings = _pkg(tmp_path, {
+        "state.py": """
+            LAST = None
+
+            def remember(v):
+                global LAST
+                LAST = v
+        """,
+        "step.py": """
+            import jax
+            from .state import remember
+
+            def step_fn(g):
+                remember(g)
+                return g * 2
+
+            fast = jax.jit(step_fn)
+        """,
+    }, rule="tracer-escape")
+    assert len(findings) == 1
+    assert findings[0].path.endswith("state.py")
+    assert "global LAST" in findings[0].message
+
+
+# -- mesh-contract -----------------------------------------------------------
+
+_MESH_FIXTURE = {
+    "mesh.py": """
+        AXES = ("dp", "tp", "fsdp")
+
+        def make_mesh():
+            return None
+    """,
+}
+
+
+def test_mesh_contract_flags_unknown_axis(tmp_path):
+    files = dict(_MESH_FIXTURE)
+    files["shard.py"] = """
+        from jax.sharding import PartitionSpec as P
+
+        def reshard(x, mesh):
+            return P("dp", "fsd")      # typo: not a mesh axis
+    """
+    findings = _pkg(tmp_path, files, rule="mesh-contract")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "'fsd'" in f.message and "dp" in f.message
+    assert f.severity == "error"
+    assert f.symbol == "reshard"
+
+
+def test_mesh_contract_good_axes_and_collectives(tmp_path):
+    files = dict(_MESH_FIXTURE)
+    files["shard.py"] = """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def reshard(x, mesh):
+            if mesh.shape.get("tp", 1) > 1:
+                return P("dp", "tp")
+            return P(("dp", "fsdp"))
+
+        def reduce(x, mesh):
+            return jax.lax.psum(x, axis_name="dp")
+    """
+    assert _pkg(tmp_path, files, rule="mesh-contract") == []
+
+
+def test_mesh_contract_silent_without_vocabulary(tmp_path):
+    # no AXES declaration anywhere: nothing to enforce
+    findings = _pkg(tmp_path, {
+        "shard.py": """
+            from jax.sharding import PartitionSpec as P
+
+            def reshard(x, mesh):
+                return P("anything")
+        """,
+    }, rule="mesh-contract")
+    assert findings == []
+
+
+def test_mesh_contract_ignores_meshless_functions(tmp_path):
+    files = dict(_MESH_FIXTURE)
+    files["other.py"] = """
+        from jax.sharding import PartitionSpec as P
+
+        def label(x):
+            return P("not_an_axis_but_no_mesh_arg_either")
+    """
+    # funcs that neither take a mesh nor read self._mesh are out of
+    # contract scope (P misuse there is a different bug class)
+    assert _pkg(tmp_path, files, rule="mesh-contract") == []
+
+
+# -- unguarded-global-mutation -----------------------------------------------
+
+def test_global_mutation_thread_target(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import threading
+
+        _QUEUE = []
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._worker)
+                self._t.start()
+
+            def _worker(self):
+                _QUEUE.append(1)
+    """, "unguarded-global-mutation")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "_QUEUE" in f.message and "thread" in f.message
+    assert f.symbol == "W._worker"
+
+
+def test_global_mutation_worker_scope_body(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        from mxnet_tpu import engine
+
+        _ERRS = []
+
+        def drain(job):
+            with engine.worker_scope():
+                _ERRS.append(job())
+    """, "unguarded-global-mutation")
+    assert len(findings) == 1
+    assert "worker_scope" in findings[0].message
+
+
+def test_global_mutation_good_patterns_stay_silent(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import threading
+
+        _LOCK = threading.Lock()
+        _QUEUE = []
+        _ANNOTATED = []   # guarded-by: _LOCK
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                with _LOCK:
+                    _QUEUE.append(1)       # lock held: fine
+
+            def _drain_locked(self):
+                _QUEUE.append(2)           # *_locked convention
+
+            def _annotated(self):
+                _ANNOTATED.append(3)       # lock-discipline's domain
+
+        def cold_path():
+            _QUEUE.append(4)               # not thread-reachable
+    """, "unguarded-global-mutation")
+    assert findings == []
+
+
+# -- missing-donation (incl. cross-module) -----------------------------------
+
+def test_missing_donation_flags_undonated_step(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+
+        fast = jax.jit(train_step)
+
+        @jax.jit
+        def sgd_update(weights, grads, lr):
+            return weights
+
+        def apply_gradients(params, grads):
+            return params
+
+        also = jax.jit(apply_gradients, static_argnums=())
+    """, "missing-donation")
+    assert sorted(f.symbol for f in findings) == [
+        "apply_gradients", "sgd_update", "train_step"]
+    assert all("donate_argnums" in f.message for f in findings)
+
+
+def test_missing_donation_good_patterns_stay_silent(tmp_path):
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def train_step(params, opt_state, batch):
+            return params, opt_state
+
+        # donation declared: fine
+        fast = jax.jit(train_step, donate_argnums=(0, 1))
+
+        def fused_update(ws, gs, states):
+            return ws, states
+
+        # explicit EMPTY donation records the considered-and-rejected
+        # decision (aliased buffers) — the kvstore idiom; passes
+        audited = jax.jit(fused_update, donate_argnums=())
+
+        def evaluate(params, x):
+            return x          # not step/update-shaped by name
+
+        ev = jax.jit(evaluate)
+
+        def step(x, y):
+            return x + y      # step-named but no param/state args
+
+        st = jax.jit(step)
+
+        def helper_step(params):
+            return params
+
+        # suppressed variant: the inline comment wins
+        hs = jax.jit(helper_step)  # graftlint: disable=missing-donation
+    """, "missing-donation")
+    assert findings == []
+
+
+def test_missing_donation_conditional_donate_passes(tmp_path):
+    # the trainer idiom: donate_argnums=(0, 1) if self._donate else ()
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        def step(params, state, x):
+            return params, state
+
+        fast = jax.jit(step,
+                       donate_argnums=(0, 1) if True else ())
+    """, "missing-donation")
+    assert findings == []
+
+
+def test_missing_donation_cross_module_bind(tmp_path):
+    findings = _pkg(tmp_path, {
+        "steps.py": """
+            def train_step(params, grads):
+                return params
+        """,
+        "bind.py": """
+            import jax
+            from .steps import train_step
+
+            fast = jax.jit(train_step)
+        """,
+    }, rule="missing-donation")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path.endswith("bind.py")       # reported at the bind site
+    assert "defined in" in f.message
+    # donation declared at the bind: silent
+    assert _pkg(tmp_path / "ok", {
+        "steps.py": """
+            def train_step(params, grads):
+                return params
+        """,
+        "bind.py": """
+            import jax
+            from .steps import train_step
+
+            fast = jax.jit(train_step, donate_argnums=(0,))
+        """,
+    }, rule="missing-donation") == []
 
 
 # -- lock-discipline ---------------------------------------------------------
@@ -285,81 +852,6 @@ def test_env_knob_drift_skips_docstrings(tmp_path):
             the wildcard family MXNET_WHATEVER_* without reading them."""
             return None
     ''', "env-knob-drift", root=tmp_path)
-    assert findings == []
-
-
-# -- missing-donation --------------------------------------------------------
-
-def test_missing_donation_flags_undonated_step(tmp_path):
-    findings = _lint(tmp_path, "m.py", """
-        import jax
-
-        def train_step(params, opt_state, batch):
-            return params, opt_state
-
-        fast = jax.jit(train_step)
-
-        @jax.jit
-        def sgd_update(weights, grads, lr):
-            return weights
-
-        def apply_gradients(params, grads):
-            return params
-
-        also = jax.jit(apply_gradients, static_argnums=())
-    """, "missing-donation")
-    assert sorted(f.symbol for f in findings) == [
-        "apply_gradients", "sgd_update", "train_step"]
-    assert all("donate_argnums" in f.message for f in findings)
-
-
-def test_missing_donation_good_patterns_stay_silent(tmp_path):
-    findings = _lint(tmp_path, "m.py", """
-        import jax
-
-        def train_step(params, opt_state, batch):
-            return params, opt_state
-
-        # donation declared: fine
-        fast = jax.jit(train_step, donate_argnums=(0, 1))
-
-        def fused_update(ws, gs, states):
-            return ws, states
-
-        # explicit EMPTY donation records the considered-and-rejected
-        # decision (aliased buffers) — the kvstore idiom; passes
-        audited = jax.jit(fused_update, donate_argnums=())
-
-        def evaluate(params, x):
-            return x          # not step/update-shaped by name
-
-        ev = jax.jit(evaluate)
-
-        def step(x, y):
-            return x + y      # step-named but no param/state args
-
-        st = jax.jit(step)
-
-        def helper_step(params):
-            return params
-
-        # suppressed variant: the inline comment wins
-        hs = jax.jit(helper_step)  # graftlint: disable=missing-donation
-    """, "missing-donation")
-    assert findings == []
-
-
-def test_missing_donation_conditional_donate_passes(tmp_path):
-    # the trainer idiom: donate_argnums=(0, 1) if self._donate else ()
-    findings = _lint(tmp_path, "m.py", """
-        import jax
-
-        def step(params, state, x):
-            return params, state
-
-        fast = jax.jit(step,
-                       donate_argnums=(0, 1) if True else ())
-    """, "missing-donation")
     assert findings == []
 
 
@@ -512,28 +1004,59 @@ def test_c_api_contract_ignores_other_cpp(tmp_path):
                  "c-api-contract") == []
 
 
+# -- stale-suppression -------------------------------------------------------
+
+def test_stale_suppression_flagged_on_full_run(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        def cold(arrs):
+            return [a.asnumpy() for a in arrs]  # graftlint: disable=host-sync
+    """))
+    findings = analysis.run([str(tmp_path)], root=str(tmp_path))
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert len(stale) == 1
+    assert "host-sync" in stale[0].message
+    assert stale[0].severity == "warning"
+    # restricted runs cannot tell stale from out-of-scope: no findings
+    assert analysis.run([str(tmp_path)], rules=["stale-suppression"],
+                        root=str(tmp_path)) == []
+
+
+def test_stale_suppression_used_comment_not_flagged(tmp_path):
+    (tmp_path / "hot.py").write_text(textwrap.dedent(_HOT_SRC).replace(
+        "return [r.out.asnumpy() for r in reqs]",
+        "return [r.out.asnumpy() for r in reqs]  # graftlint: disable=host-sync"))
+    findings = analysis.run([str(tmp_path)], root=str(tmp_path))
+    assert [f for f in findings if f.rule == "stale-suppression"] == []
+
+
+def test_stale_suppression_unknown_rule_and_file_level(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        # graftlint: disable-file=host-sync
+
+        def f(x):
+            return x  # graftlint: disable=not-a-rule
+    """))
+    findings = analysis.run([str(tmp_path)], root=str(tmp_path))
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert len(stale) == 2
+    msgs = "\n".join(f.message for f in stale)
+    assert "no such rule" in msgs
+    assert "disable-file" in msgs
+
+
 # -- suppression / baseline / reporters --------------------------------------
 
 def test_file_level_suppression(tmp_path):
-    findings = _lint(tmp_path, "optimizer.py", """
-        # graftlint: disable-file=host-sync
-
-        def sweep(arrs):
-            for a in arrs:
-                a.asnumpy()
-    """, "host-sync")
+    findings = _lint(tmp_path, "m.py",
+                     "# graftlint: disable-file=host-sync\n"
+                     + textwrap.dedent(_HOT_SRC), "host-sync")
     assert findings == []
 
 
 def test_fingerprints_stable_across_line_shifts(tmp_path):
-    src = """
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """
-    f1 = _lint(tmp_path, "serving/server.py", src, "host-sync")
+    f1 = _lint(tmp_path, "serving/server.py", _HOT_SRC, "host-sync")
     shifted = "\n\n\n# a comment pushing everything down\n" + \
-        textwrap.dedent(src)
+        textwrap.dedent(_HOT_SRC)
     (tmp_path / "serving" / "server.py").write_text(shifted)
     f2 = analysis.run([str(tmp_path / "serving" / "server.py")],
                       rules=["host-sync"], root=str(tmp_path))
@@ -542,23 +1065,16 @@ def test_fingerprints_stable_across_line_shifts(tmp_path):
 
 
 def test_baseline_roundtrip_filters_known_findings(tmp_path):
-    src = """
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """
-    findings = _lint(tmp_path, "serving/server.py", src, "host-sync")
+    findings = _lint(tmp_path, "serving/server.py", _HOT_SRC, "host-sync")
     bl_path = tmp_path / "bl.json"
     baseline_mod.save(findings, str(bl_path))
     known = baseline_mod.load(str(bl_path))
     new, old = baseline_mod.filter_new(findings, known)
     assert new == [] and len(old) == 1
-    # a NEW finding in the same file still gates
-    worse = textwrap.dedent(src) + textwrap.dedent("""
-        class T:
-            def _execute(self, reqs):
-                reqs[0].wait_to_read()
-    """)
+    # a NEW finding in the same hot function still gates
+    worse = textwrap.dedent(_HOT_SRC).replace(
+        "out = prog(reqs)",
+        "out = prog(reqs)\n        reqs[0].wait_to_read()")
     (tmp_path / "serving" / "server.py").write_text(worse)
     findings = analysis.run([str(tmp_path / "serving" / "server.py")],
                             rules=["host-sync"], root=str(tmp_path))
@@ -568,11 +1084,7 @@ def test_baseline_roundtrip_filters_known_findings(tmp_path):
 
 
 def test_reporters(tmp_path):
-    findings = _lint(tmp_path, "serving/server.py", """
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """, "host-sync")
+    findings = _lint(tmp_path, "serving/server.py", _HOT_SRC, "host-sync")
     text = analysis.human_report(findings)
     assert "serving/server.py" in text and "[host-sync]" in text
     assert "1 new finding" in text
@@ -582,30 +1094,138 @@ def test_reporters(tmp_path):
     assert data["new"][0]["rule"] == "host-sync"
 
 
+def test_sarif_report_minimal_schema(tmp_path):
+    new = _lint(tmp_path, "serving/server.py", _HOT_SRC, "host-sync")
+    old = _lint(tmp_path / "b", "m.py", _LOCK_SRC, "lock-discipline")
+    doc = json.loads(analysis.sarif_report(new, old))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    run0 = doc["runs"][0]
+    driver = run0["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert {r["id"] for r in driver["rules"]} == {"host-sync",
+                                                  "lock-discipline"}
+    assert len(run0["results"]) == 3
+    for res in run0["results"]:
+        assert res["ruleId"] in ("host-sync", "lock-discipline")
+        assert res["level"] in ("warning", "error")
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"]
+        assert loc["region"]["startLine"] >= 1
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+    # baselined findings arrive suppressed, not dropped
+    suppressed = [r for r in run0["results"] if "suppressions" in r]
+    assert len(suppressed) == 2
+
+
 def test_unknown_rule_rejected(tmp_path):
     with pytest.raises(ValueError, match="unknown rule"):
         analysis.run([str(tmp_path)], rules=["no-such-rule"])
 
 
+# -- incremental cache -------------------------------------------------------
+
+def test_cache_reuses_and_invalidates(tmp_path):
+    src_dir = tmp_path / "t"
+    (src_dir).mkdir()
+    (src_dir / "hot.py").write_text(textwrap.dedent(_HOT_SRC))
+    cache = str(tmp_path / "cache.json")
+    f1 = analysis.run([str(src_dir)], root=str(src_dir), cache=cache)
+    assert os.path.exists(cache)
+    # warm, unchanged: identical findings
+    f2 = analysis.run([str(src_dir)], root=str(src_dir), cache=cache)
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f2]
+    # edit: a second sync appears — the cache must not mask it
+    (src_dir / "hot.py").write_text(textwrap.dedent(_HOT_SRC).replace(
+        "out = prog(reqs)",
+        "out = prog(reqs)\n        reqs[0].wait_to_read()"))
+    f3 = analysis.run([str(src_dir)], root=str(src_dir), cache=cache)
+    assert len([f for f in f3 if f.rule == "host-sync"]) == \
+        len([f for f in f1 if f.rule == "host-sync"]) + 1
+    # revert: original result replays (tree-digest project cache)
+    (src_dir / "hot.py").write_text(textwrap.dedent(_HOT_SRC))
+    f4 = analysis.run([str(src_dir)], root=str(src_dir), cache=cache)
+    assert [f.fingerprint for f in f1] == [f.fingerprint for f in f4]
+
+
+def test_warm_relint_at_least_5x_faster_than_cold(tmp_path):
+    """The incremental-cache bar from the tier-1 gate's point of view:
+    a warm no-change re-lint of the real tree must be >=5x faster than
+    the cold run that populated the cache."""
+    cache = str(tmp_path / "cache.json")
+    tree = os.path.join(ROOT, "mxnet_tpu")
+    t0 = time.perf_counter()
+    cold_findings = analysis.run([tree], cache=cache)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm_findings = analysis.run([tree], cache=cache)
+    warm = time.perf_counter() - t0
+    assert [f.fingerprint for f in cold_findings] == \
+        [f.fingerprint for f in warm_findings]
+    assert warm * 5 <= cold, \
+        "warm re-lint %.2fs not >=5x faster than cold %.2fs" % (warm, cold)
+
+
 # -- CLI (tools/lint.py + python -m mxnet_tpu.analysis) ----------------------
+
+def test_changed_paths_git_derivation(tmp_path):
+    from mxnet_tpu.analysis.cli import _changed_paths
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo), "-c",
+                        "user.email=t@t", "-c", "user.name=t"]
+                       + list(args), check=True, capture_output=True)
+
+    git("init")
+    pkg = repo / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("x = 1\n")
+    (repo / "notes.md").write_text("not lintable\n")
+    (repo / "outside.py").write_text("z = 0\n")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    (pkg / "a.py").write_text("x = 2\n")           # modified, tracked
+    (pkg / "b.py").write_text("y = 1\n")           # untracked
+    (repo / "notes.md").write_text("still not\n")  # changed, not lintable
+    (repo / "outside.py").write_text("z = 1\n")    # outside package scope
+    worktree = _changed_paths(str(repo), None)
+    assert sorted(os.path.basename(p) for p in worktree) == ["a.py", "b.py"]
+    vs_head = _changed_paths(str(repo), "HEAD")
+    assert sorted(os.path.basename(p) for p in vs_head) == ["a.py"]
+
+
+def test_changed_flag_rejects_explicit_paths(capsys):
+    from mxnet_tpu.analysis.cli import main
+    rc = main(["--changed", "some/path.py"])
+    # argparse consumes "some/path.py" as REF... an explicit path on top
+    rc = main(["--changed", "HEAD", "extra.py"])
+    assert rc == 2
+    assert "drop the explicit paths" in capsys.readouterr().err
+
 
 @pytest.mark.slow
 def test_cli_flags_roundtrip(tmp_path):
     bad = tmp_path / "serving" / "server.py"
     bad.parent.mkdir(parents=True)
-    bad.write_text(textwrap.dedent("""
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """))
+    bad.write_text(textwrap.dedent(_HOT_SRC))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cache = str(tmp_path / "cache.json")
     base = [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
-            str(bad), "--rule", "host-sync",
+            str(bad), "--rule", "host-sync", "--cache", cache,
             "--baseline", str(tmp_path / "bl.json")]
     r = subprocess.run(base + ["--json"], capture_output=True, text=True,
                        env=env, cwd=ROOT)
     assert r.returncode == 1, r.stderr
     assert json.loads(r.stdout)["summary"]["new"] == 1
+    r = subprocess.run(base + ["--sarif"], capture_output=True, text=True,
+                       env=env, cwd=ROOT)
+    assert r.returncode == 1, r.stderr
+    sarif = json.loads(r.stdout)
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "host-sync"
     r = subprocess.run(base + ["--update-baseline"], capture_output=True,
                        text=True, env=env, cwd=ROOT)
     assert r.returncode == 0, r.stderr
@@ -617,9 +1237,10 @@ def test_cli_flags_roundtrip(tmp_path):
     r = subprocess.run(base + ["--list-rules"], capture_output=True,
                        text=True, env=env, cwd=ROOT)
     assert r.returncode == 0
-    assert set(r.stdout.split()) >= {"host-sync", "c-api-contract",
-                                     "env-knob-drift", "lock-discipline",
-                                     "recompile-hazard"}
+    assert set(r.stdout.split()) >= {
+        "host-sync", "c-api-contract", "env-knob-drift", "lock-discipline",
+        "recompile-hazard", "tracer-escape", "mesh-contract",
+        "unguarded-global-mutation", "stale-suppression"}
 
 
 # -- the tier-1 gate ---------------------------------------------------------
@@ -627,10 +1248,10 @@ def test_cli_flags_roundtrip(tmp_path):
 def test_tree_clean_against_committed_baseline():
     """THE gate: the full analyzer over the real mxnet_tpu/ tree must
     produce no findings beyond the committed baseline.  Seeding any
-    known-bad pattern (an unguarded RMW on a guarded-by attribute, an
-    unchecked handle deref in c_api.cpp, an unregistered MXNET_* knob)
-    fails this test."""
-    findings = analysis.run([os.path.join(ROOT, "mxnet_tpu")])
+    known-bad pattern (an unguarded RMW on a guarded-by attribute, a
+    sync reachable from a dispatching loop, a leaked tracer, an
+    off-mesh axis name) fails this test."""
+    findings = list(_tree_findings())
     known = baseline_mod.load(analysis.default_path(ROOT))
     new, _old = baseline_mod.filter_new(findings, known)
     assert not new, "new graftlint findings:\n%s" % analysis.human_report(new)
@@ -641,11 +1262,17 @@ def test_committed_baseline_carries_no_dead_entries():
     finding — fixed findings must leave the baseline (run
     tools/lint.py --update-baseline) so the file never masks a
     REINTRODUCTION of a once-fixed bug."""
-    findings = analysis.run([os.path.join(ROOT, "mxnet_tpu")])
-    live = {f.fingerprint for f in findings}
+    live = {f.fingerprint for f in _tree_findings()}
     known = baseline_mod.load(analysis.default_path(ROOT))
     dead = sorted(set(known) - live)
     assert not dead, "baseline entries with no matching finding: %s" % dead
+
+
+def test_tree_has_no_stale_suppressions():
+    """The suppression mirror of the dead-entry gate: every inline
+    disable comment in the tree still earns its keep."""
+    stale = [f for f in _tree_findings() if f.rule == "stale-suppression"]
+    assert not stale, analysis.human_report(stale)
 
 
 def test_seeded_regression_is_caught(tmp_path):
@@ -675,18 +1302,30 @@ def test_seeded_regression_is_caught(tmp_path):
                         root=str(tmp_path)) == []
 
 
-def test_host_sync_closure_inherits_hotness(tmp_path):
-    """A closure defined inside a hot function runs per step — hot-ness
-    is inherited by enclosure, not derived from the closure's name."""
-    findings = _lint(tmp_path, "serving/server.py", """
-        class S:
-            def _execute(self, reqs):
-                def deliver(r):
-                    return r.out.asnumpy()
-                return [deliver(r) for r in reqs]
-    """, "host-sync")
-    assert len(findings) == 1
-    assert findings[0].symbol == "deliver"
+def test_seeded_interprocedural_regression_in_real_tree(tmp_path):
+    """The engine-era version of the seeded-regression proof: drop a
+    sync into a REAL deep helper (serving batch path) and the full
+    analyzer (as the tier-1 gate runs it) reports it as NEW against
+    the committed baseline."""
+    import shutil
+    tree = tmp_path / "mxnet_tpu"
+    shutil.copytree(os.path.join(ROOT, "mxnet_tpu"), tree,
+                    ignore=shutil.ignore_patterns("__pycache__", "*.so",
+                                                  "*.so.hash"))
+    target = tree / "serving" / "bucketing.py"
+    src = target.read_text()
+    assert "def pick_bucket" in src
+    seeded = src.replace(
+        "def pick_bucket(", "def pick_bucket(*a, **k):\n"
+        "    a[0].wait_to_read()\n"
+        "    return _pick_bucket_orig(*a, **k)\n\n"
+        "def _pick_bucket_orig(", 1)
+    target.write_text(seeded)
+    findings = analysis.run([str(tree)], root=str(tmp_path))
+    hits = [f for f in findings
+            if f.rule == "host-sync" and f.path.endswith("bucketing.py")]
+    assert hits, "seeded deep sync not caught by the whole-program pass"
+    assert "wait_to_read" in hits[0].message
 
 
 def test_update_baseline_restricted_run_preserves_out_of_scope(tmp_path):
@@ -695,17 +1334,13 @@ def test_update_baseline_restricted_run_preserves_out_of_scope(tmp_path):
     dropped (which would make the next full run gate on old debt)."""
     hot = tmp_path / "serving" / "server.py"
     hot.parent.mkdir(parents=True)
-    hot.write_text(textwrap.dedent("""
-        class S:
-            def _execute(self, reqs):
-                return [r.out.asnumpy() for r in reqs]
-    """))
+    hot.write_text(textwrap.dedent(_HOT_SRC))
     lock = tmp_path / "m.py"
     lock.write_text(textwrap.dedent(_LOCK_SRC))
     bl = tmp_path / "bl.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     base = [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
-            "--baseline", str(bl)]
+            "--baseline", str(bl), "--cache", str(tmp_path / "c.json")]
     # full-ish run over both files -> 3 baselined findings
     r = subprocess.run(base + [str(hot), str(lock), "--update-baseline"],
                        capture_output=True, text=True, env=env, cwd=ROOT)
@@ -725,3 +1360,115 @@ def test_update_baseline_restricted_run_preserves_out_of_scope(tmp_path):
 
 test_update_baseline_restricted_run_preserves_out_of_scope = pytest.mark.slow(
     test_update_baseline_restricted_run_preserves_out_of_scope)
+
+
+# -- code-review regression fixes (PR 8) -------------------------------------
+
+def test_changed_update_baseline_preserves_unchanged_files(tmp_path,
+                                                           monkeypatch):
+    """`--changed --update-baseline` is a PATH-restricted update: the
+    baseline entries of files git did NOT report must survive."""
+    from mxnet_tpu.analysis import cli as cli_mod
+    hot = tmp_path / "hot.py"
+    hot.write_text(textwrap.dedent(_HOT_SRC))
+    bl = tmp_path / "bl.json"
+    other = analysis.Finding("host-sync", "warning",
+                             "mxnet_tpu/unchanged.py", 1,
+                             "a finding in an unchanged file")
+    baseline_mod.save([other], str(bl))
+    monkeypatch.setattr(cli_mod, "_changed_paths",
+                        lambda root, ref: [str(hot)])
+    rc = cli_mod.main(["--changed", "--update-baseline",
+                       "--baseline", str(bl), "--no-cache"])
+    assert rc == 0
+    known = baseline_mod.load(str(bl))
+    assert other.fingerprint in known, \
+        "unchanged file's baseline entry was dropped"
+    assert any(e["path"].endswith("hot.py") for e in known.values())
+
+
+def test_recursive_driver_chain_has_no_repeated_frames(tmp_path):
+    """A driver that recurses into itself must not become its own
+    witness — chains degenerated into 'f -> f -> f' before the fix."""
+    findings = _lint(tmp_path, "m.py", """
+        import jax
+
+        @jax.jit
+        def prog(x):
+            return x
+
+        class Seq:
+            def run(self, subs):
+                for sub in subs:
+                    sub.run([])        # recursive dynamic dispatch
+                    out = prog(subs)
+                    self._deliver(out)
+
+            def _deliver(self, out):
+                return out.asnumpy()
+    """, "host-sync")
+    assert findings, "sync below recursive driver not found"
+    for f in findings:
+        frames = [p.strip() for p in
+                  f.message.split("reached from ")[-1]
+                  .split(" — ")[0].split("->")]
+        assert len(frames) == len(set(frames)), \
+            "repeated frame in chain: %s" % f.message
+
+
+def test_global_mutation_rebind_rmw_detected(tmp_path):
+    """`global X; X = X + [v]` is the RMW race in rebind clothing; a
+    wholesale rebind is atomic under the GIL and passes."""
+    findings = _lint(tmp_path, "m.py", """
+        import threading
+
+        _COUNT = []
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                global _COUNT
+                _COUNT = _COUNT + [1]      # lost-update RMW
+    """, "unguarded-global-mutation")
+    assert len(findings) == 1
+    assert "read-modify-write" in findings[0].message
+    assert _lint(tmp_path / "ok", "m.py", """
+        import threading
+
+        _MODE = []
+
+        class W:
+            def start(self):
+                self._t = threading.Thread(target=self._worker)
+
+            def _worker(self):
+                global _MODE
+                _MODE = ["fresh"]          # atomic wholesale rebind
+    """, "unguarded-global-mutation") == []
+
+
+def test_missing_donation_each_cross_module_bind_judged_alone(tmp_path):
+    """A donated bind in one module must not excuse an undonated bind
+    of the SAME step function in another module."""
+    findings = _pkg(tmp_path, {
+        "steps.py": """
+            def train_step(params, grads):
+                return params
+        """,
+        "good_bind.py": """
+            import jax
+            from .steps import train_step
+
+            fast = jax.jit(train_step, donate_argnums=(0,))
+        """,
+        "bad_bind.py": """
+            import jax
+            from .steps import train_step
+
+            slow = jax.jit(train_step)
+        """,
+    }, rule="missing-donation")
+    assert len(findings) == 1
+    assert findings[0].path.endswith("bad_bind.py")

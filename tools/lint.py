@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""graftlint launcher — ``tools/lint.py [paths...] [--json] [--rule R]
-[--update-baseline]``.
+"""graftlint launcher — ``tools/lint.py [paths...] [--changed [REF]]
+[--json | --sarif] [--rule R] [--stale] [--update-baseline]
+[--cache PATH | --no-cache]``.
 
 Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
-by putting the repo root on ``sys.path`` first.  See
-``docs/faq/static_analysis.md`` for the rule catalog, suppression
-syntax, and the baseline workflow.
+by putting the repo root on ``sys.path`` first.  The pre-push habit is
+``tools/lint.py --changed`` — git-derived file set + the incremental
+cache, so it is near-instant.  See ``docs/faq/static_analysis.md`` for
+the rule catalog, the whole-program engine, suppression syntax, and
+the baseline workflow.
 """
 import os
 import sys
