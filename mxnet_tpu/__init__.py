@@ -64,3 +64,14 @@ from .predictor import Predictor  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import serving  # noqa: F401
 from . import test_utils  # noqa: F401
+
+# graftsan runtime sanitizers: arm at import when any MXNET_SAN* knob
+# is set, so subprocess workloads (bench legs, CI smoke) need no code —
+# the same pure-env-knob convention telemetry and checkpoints follow.
+# All knobs off costs these five config reads once, then one boolean
+# per instrumentation site (mxnet_tpu/analysis/sanitizers/hooks.py).
+if any(config.get(_k) for _k in (
+        "MXNET_SAN", "MXNET_SAN_RECOMPILE", "MXNET_SAN_HOST_SYNC",
+        "MXNET_SAN_LOCK_ORDER", "MXNET_SAN_DONATION")):
+    from .analysis import sanitizers as _sanitizers
+    _sanitizers.install()

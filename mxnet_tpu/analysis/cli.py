@@ -61,6 +61,51 @@ def _changed_paths(root, ref):
     return picked
 
 
+def _audit_suppressions(args):
+    """``--audit-suppressions``: the one mode that executes the package
+    (everything else is stdlib AST) — run the built-in workload under
+    all four graftsan sanitizers and gate on the verdicts."""
+    import json
+
+    from .core import Finding
+    from .sanitizers import run_audit
+    rep = run_audit()
+    if args.sarif:
+        # findings travel as SARIF results (CI annotation); the
+        # suppression verdicts ride in run properties
+        findings = [Finding(d["rule"], d["severity"], d["path"],
+                            d["line"], d["message"], d.get("symbol", ""))
+                    for d in rep["findings"]]
+        sarif = json.loads(sarif_report(findings))
+        sarif["runs"][0]["properties"] = {
+            "graftsanAudit": {k: rep[k] for k in
+                              ("summary", "suppressions", "baseline")}}
+        print(json.dumps(sarif, indent=1))
+    elif args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        for row in rep["suppressions"]:
+            print("%s:%d [%s] %s — %s"
+                  % (row["path"], row["line"], ",".join(row["rules"]),
+                     row["verdict"], row["evidence"]))
+        for row in rep["baseline"]:
+            print("baseline %s (%s %s) %s — %s"
+                  % (row["fingerprint"], row["path"], row["symbol"],
+                     row["verdict"], row["evidence"]))
+        for d in rep["findings"]:
+            print("UNCLAIMED %s:%d [%s] %s"
+                  % (d["path"], d["line"], d["rule"], d["message"]))
+        s = rep["summary"]
+        print("graftsan audit: %d suppressions + %d baseline entries — "
+              "%d runtime-confirmed, %d never-exercised, "
+              "%d contradicted; %d unclaimed runtime finding%s"
+              % (s["suppressions"], s["baseline_entries"],
+                 s["runtime_confirmed"], s["never_exercised"],
+                 s["contradicted"], s["unclaimed_findings"],
+                 "s" if s["unclaimed_findings"] != 1 else ""))
+    return 0 if rep["ok"] else 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="graftlint",
@@ -93,6 +138,14 @@ def main(argv=None):
         help="list stale suppression comments as a removal worklist "
              "and exit (1 when any exist)")
     parser.add_argument(
+        "--audit-suppressions", action="store_true",
+        help="run the graftsan workload (runtime sanitizers + line "
+             "probe) and classify every inline suppression and "
+             "baseline entry as runtime-confirmed / never-exercised / "
+             "contradicted; exits 1 on contradictions or unclaimed "
+             "runtime findings.  NOTE: unlike every other mode this "
+             "imports and RUNS the package (jax required)")
+    parser.add_argument(
         "--rule", action="append", dest="rules", metavar="RULE",
         help="restrict to RULE (repeatable); see --list-rules")
     parser.add_argument(
@@ -117,6 +170,9 @@ def main(argv=None):
         for rule in rule_ids():
             print(rule)
         return 0
+
+    if args.audit_suppressions:
+        return _audit_suppressions(args)
 
     root = repo_root()
     if args.changed is not None:
@@ -167,6 +223,12 @@ def main(argv=None):
         # and `--changed --update-baseline` must not un-baseline every
         # UNCHANGED file's)
         entries = {f.fingerprint: f.to_dict() for f in findings}
+        # audit verdicts annotated onto baseline entries (the
+        # --audit-suppressions workflow) survive a refresh of an
+        # unchanged finding — only a changed fingerprint re-opens one
+        for fp, e in baseline_mod.load(baseline_path).items():
+            if fp in entries and "audit" in e:
+                entries[fp]["audit"] = e["audit"]
         restricted_rules = set(args.rules) if args.rules else None
         restricted_paths = None
         if args.paths or args.changed is not None:

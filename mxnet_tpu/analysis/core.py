@@ -35,9 +35,17 @@ import os
 import re
 
 __all__ = ["Finding", "Checker", "register", "checkers", "rule_ids",
-           "run", "repo_root", "iter_source_files"]
+           "run", "repo_root", "iter_source_files", "RUNTIME_RULES"]
 
 SEVERITIES = ("error", "warning")
+
+# rule ids owned by the graftsan RUNTIME sanitizers (analysis/
+# sanitizers/) — same Finding/fingerprint/suppression/baseline
+# machinery, but their findings come from executing the workload, so a
+# static run can neither produce them nor prove a suppression of one
+# stale (tools/lint.py --audit-suppressions classifies them instead)
+RUNTIME_RULES = frozenset((
+    "san-recompile", "san-host-sync", "san-lock-order", "san-donation"))
 
 # C++ sources the c-api-contract checker owns; everything else walked
 # is Python.
@@ -263,10 +271,16 @@ def _phase1(path, relpath, text, all_checkers, ctx):
 
 
 def _stale_findings(relpath, sup, used, universe):
-    """stale-suppression findings for one file's unused comments."""
+    """stale-suppression findings for one file's unused comments.
+
+    Suppressions naming only RUNTIME rules (``san-*``) are exempt: they
+    claim events the static pass cannot observe, so only the runtime
+    suppression audit can judge them."""
     out = []
     for lineno, rules in sup_file_entries(sup):
         if ("file", lineno) in used:
+            continue
+        if rules and rules <= RUNTIME_RULES:
             continue
         out.append(Finding(
             "stale-suppression", "warning", relpath, lineno,
@@ -276,8 +290,11 @@ def _stale_findings(relpath, sup, used, universe):
     for lineno, rules in sup_line_entries(sup):
         if ("line", lineno) in used:
             continue
+        if rules and rules <= RUNTIME_RULES:
+            continue
         unknown = sorted(r for r in rules
-                         if r != "all" and r not in universe)
+                         if r != "all" and r not in universe
+                         and r not in RUNTIME_RULES)
         if unknown:
             detail = (" (no such rule%s: %s)"
                       % ("s" if len(unknown) != 1 else "",

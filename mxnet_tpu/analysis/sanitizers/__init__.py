@@ -1,0 +1,47 @@
+"""graftsan — runtime sanitizers proving (or refuting) what static
+graftlint can only claim.
+
+Static analysis answers "could this happen"; these four sanitizers
+answer "did it happen, where, and was the suppression that excused it
+telling the truth".  They emit the same :class:`~..core.Finding`
+objects through the same reporters, fingerprints, inline-suppression
+syntax (``san-<rule>`` in a graftlint disable comment), SARIF output,
+and baseline gate as the static checkers — one toolchain, two evidence
+sources (``docs/faq/static_analysis.md`` has the catalog):
+
+==================  ========================  ===========================
+rule                knob                      proves
+==================  ========================  ===========================
+``san-recompile``   ``MXNET_SAN_RECOMPILE``   zero steady-state re-traces
+``san-host-sync``   ``MXNET_SAN_HOST_SYNC``   every hot sync is claimed
+``san-lock-order``  ``MXNET_SAN_LOCK_ORDER``  the lock graph is acyclic
+``san-donation``    ``MXNET_SAN_DONATION``    donated buffers stay dead
+==================  ========================  ===========================
+
+``MXNET_SAN=1`` arms all four; each knob is independent; everything
+off costs one boolean per instrumentation site (``hooks.py``).  The
+suppression audit (``tools/lint.py --audit-suppressions``) runs a
+built-in workload under all four and classifies every static
+suppression/baseline entry as *runtime-confirmed*, *never-exercised*,
+or *contradicted* (``audit.py``).
+"""
+from __future__ import annotations
+
+from . import hooks
+from .runtime import (RUNTIME_RULES, baseline_stats, emit, finding_counts,
+                      findings, install, installed, region_names,
+                      regions_active, report, reset, site_stats,
+                      steady_state, uninstall)
+
+__all__ = ["RUNTIME_RULES", "hooks", "install", "installed", "uninstall",
+           "reset", "steady_state", "suspended", "regions_active",
+           "region_names", "emit", "findings", "finding_counts",
+           "site_stats", "baseline_stats", "report", "run_audit"]
+
+suspended = hooks.suspended
+
+
+def run_audit(workload=None, root=None):
+    """Run the suppression audit (see :mod:`.audit`)."""
+    from . import audit
+    return audit.run_audit(workload=workload, root=root)

@@ -1,0 +1,160 @@
+"""san-donation — post-donation use of buffers consumed by a donated
+XLA program, attributed to the bind site graftlint already indexes.
+
+The fused train step donates its weight/optimizer-state/residual
+buffers (``Executor._build_fbu``: ``donate_argnums=(0, 5, 6)``) — XLA
+reuses the memory, and any alias that survives the dispatch reads
+garbage on hardware that really donates and *silently stale data* on
+backends that ignore donation (CPU).  Static ``missing-donation`` can
+only check that donation is declared; this sanitizer checks that
+nothing uses the consumed buffers afterwards:
+
+- after every donated dispatch the executor reports the consumed input
+  arrays; each is registered under a weak reference (a live alias keeps
+  the array object alive, so weakref-death exactly retires entries and
+  defeats ``id()`` recycling);
+- the executor's own arg/grad/aux dicts are probed immediately — a
+  dict slot still holding a consumed buffer means the rebind contract
+  broke;
+- every ``NDArray.asnumpy``/``wait_to_read`` probes its buffer against
+  the registry — a hit is a use-after-donation at that call site, with
+  the donated program's bind site (resolved from graftlint's
+  ``project.summarize`` jit-bind index over ``executor.py``) named in
+  the message.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+
+from ..core import repo_root
+from . import runtime
+
+__all__ = ["on_donated_dispatch", "on_buffer_read", "probe_executor",
+           "reset"]
+
+RULE = "san-donation"
+
+_REG_LOCK = threading.Lock()
+_DONATED = {}       # guarded-by: _REG_LOCK — id(arr) -> (weakref, tag)
+_BIND_SITES = {}    # guarded-by: _REG_LOCK — fn name -> (relpath, line)
+_PRUNE_EVERY = 64
+_prune_tick = [0]   # guarded-by: _REG_LOCK
+
+
+def _bind_site(tag):
+    """The jit bind site declaring donation for program ``tag`` —
+    read once from graftlint's per-file summary of executor.py (the
+    same ``jit_binds`` records the static ``missing-donation`` pass
+    consumes)."""
+    with _REG_LOCK:
+        if _BIND_SITES:
+            return _BIND_SITES.get(tag, _BIND_SITES.get("*"))
+    from ..project import summarize
+    rel = "mxnet_tpu/executor.py"
+    path = os.path.join(repo_root(), rel)
+    sites = {}
+    try:
+        import ast
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        summary = summarize(rel, text, ast.parse(text))
+        for bind in summary.get("jit_binds", ()):
+            if bind.get("donate") and bind.get("parts"):
+                sites[bind["parts"][-1]] = (rel, bind["line"])
+    except Exception:   # noqa: BLE001 — a broken tree still sanitizes
+        pass
+    sites.setdefault("*", (rel, 1))
+    # executor tags the fused program "fbu"; its bound fn is also fbu
+    with _REG_LOCK:
+        _BIND_SITES.update(sites)
+        return _BIND_SITES.get(tag, _BIND_SITES["*"])
+
+
+def on_donated_dispatch(executor, donated, tag):
+    """Register the arrays a donated dispatch just consumed, then probe
+    the executor's own dicts for slots that were not rebound."""
+    if runtime.in_guard():
+        return
+    with runtime.guard():
+        t0 = time.perf_counter()
+        with _REG_LOCK:
+            _prune_tick[0] += 1
+            if _prune_tick[0] % _PRUNE_EVERY == 0:
+                dead = [k for k, (ref, _t) in _DONATED.items()
+                        if ref() is None]
+                for k in dead:
+                    del _DONATED[k]
+            for arr in donated:
+                try:
+                    ref = weakref.ref(arr)
+                except TypeError:
+                    continue
+                _DONATED[id(arr)] = (ref, tag)
+        probe_executor(executor, tag)
+        runtime._overhead(t0)
+
+
+def probe_executor(executor, tag):
+    """Flag executor dict slots still referencing a consumed buffer —
+    the donated-dispatch rebind contract (every donated arg NDArray is
+    rebound to a program output) failed for them."""
+    rel, line = _bind_site(tag)
+    for dict_name in ("arg_dict", "grad_dict", "aux_dict"):
+        d = getattr(executor, dict_name, None) or {}
+        for name, nd in d.items():
+            data = getattr(nd, "_data", None)
+            if data is None or not _is_donated(data):
+                continue
+            runtime.emit(
+                RULE, rel, line,
+                "post-donation use: executor %s[%r] still references a "
+                "buffer donated to program %r (bind site declares "
+                "donate_argnums) — the slot was not rebound to the "
+                "program's output and now aliases reclaimed memory"
+                % (dict_name, name, tag), symbol="Executor._forward_fused")
+
+
+def _is_donated(data):
+    with _REG_LOCK:
+        slot = _DONATED.get(id(data))
+    if slot is None:
+        return False
+    ref, _tag = slot
+    return ref() is data
+
+
+def on_buffer_read(nd):
+    """Probe a buffer about to be read (asnumpy/wait_to_read funnel)."""
+    if runtime.in_guard():
+        return
+    data = getattr(nd, "_data", None)
+    if data is None or not _is_donated(data):
+        return
+    with runtime.guard():
+        t0 = time.perf_counter()
+        with _REG_LOCK:
+            tag = _DONATED[id(data)][1]
+        rel, line = _bind_site(tag)
+        claim, frames = runtime.attribute_event({RULE})
+        if claim is None:
+            placed = next(
+                (fr for fr in frames
+                 if not fr[0].endswith("/ndarray/ndarray.py")),
+                frames[0] if frames else (rel, line, "", ""))
+            runtime.emit(
+                RULE, placed[0], placed[1],
+                "post-donation use: buffer donated to program %r (bind "
+                "site %s:%d) read afterwards — garbage on donating "
+                "backends, silently stale data where donation is "
+                "ignored (observed live: %s)"
+                % (tag, rel, line, runtime.witness(frames)),
+                symbol=placed[2])
+        runtime._overhead(t0)
+
+
+def reset():
+    with _REG_LOCK:
+        _DONATED.clear()

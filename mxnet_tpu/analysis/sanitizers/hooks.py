@@ -1,0 +1,114 @@
+"""graftsan hook surface — the ONLY sanitizer module runtime code imports.
+
+Hot paths (``NDArray.asnumpy``, ``Executor._dispatch_compiled``, lock
+constructors) must not pay for disabled sanitizers.  This module is a
+dependency-free leaf: flat flag lists (one list-index read — the same
+fast-path shape as ``telemetry.enabled()``) plus late-bound callables
+the sanitizer runtime installs.  The contract at every instrumentation
+site is::
+
+    from mxnet_tpu.analysis.sanitizers import hooks as _san
+    ...
+    if _san.HOST_SYNC[0]:
+        _san.on_host_sync("asnumpy")
+
+so the all-off cost is exactly one boolean check per event — measured
+by ``tests/test_sanitizers.py::test_disabled_fast_path_overhead``.
+
+Nothing here imports the package runtime (no jax, no telemetry): the
+runtime imports *us*, and :mod:`.runtime` rebinds the ``on_*`` slots
+when :func:`mxnet_tpu.analysis.sanitizers.install` runs.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["RECOMPILE", "HOST_SYNC", "LOCK_ORDER", "DONATION",
+           "any_active", "region_sanitizers_active", "make_lock",
+           "suspended", "on_host_sync", "on_compile",
+           "on_donated_dispatch", "on_buffer_read"]
+
+# per-sanitizer master switches, flipped by sanitizers.install()
+RECOMPILE = [False]
+HOST_SYNC = [False]
+LOCK_ORDER = [False]
+DONATION = [False]
+
+
+def any_active():
+    return RECOMPILE[0] or HOST_SYNC[0] or LOCK_ORDER[0] or DONATION[0]
+
+
+def region_sanitizers_active():
+    """Do steady-state regions matter?  (The region installers in
+    ``fit`` / ``ModelServer.warmup`` gate on this so a sanitizer-free
+    process never touches region bookkeeping.)"""
+    return RECOMPILE[0] or HOST_SYNC[0]
+
+
+# -- late-bound event sinks (rebound by sanitizers.runtime.install) ----------
+# Default no-ops keep an instrumentation site safe even if a flag is
+# flipped by hand without install() — nothing crashes, nothing records.
+
+def on_host_sync(kind):                      # pragma: no cover - rebound
+    """A device->host sync primitive ran (asnumpy/wait_to_read funnel)."""
+
+
+def on_compile(tag, signature, prior_sigs):  # pragma: no cover - rebound
+    """An XLA compile was observed at dispatch (jit-cache growth)."""
+
+
+def on_donated_dispatch(executor, donated, tag):  # pragma: no cover - rebound
+    """A donated program dispatched; ``donated`` are the consumed arrays."""
+
+
+def on_buffer_read(nd):                      # pragma: no cover - rebound
+    """An NDArray buffer is about to be read (post-donation probe)."""
+
+
+# -- lock construction -------------------------------------------------------
+
+def make_lock(name, lock):
+    """Route an instance lock through the lock-order sanitizer.
+
+    Off (the default): returns ``lock`` unchanged — zero wrapping, zero
+    per-acquire cost.  On: returns a ``TrackedLock`` proxy that records
+    the runtime acquisition-order graph under the lock-class ``name``
+    (all instances of one class are one node, the lockdep convention).
+    Constructors run this once per object, never per operation."""
+    if not LOCK_ORDER[0]:
+        return lock
+    from . import lock_order
+    return lock_order.TrackedLock(name, lock)
+
+
+# -- suspension --------------------------------------------------------------
+# One process-wide depth counter (not thread-local): warmup dispatches
+# are EXECUTED on the batcher thread while the suspending caller is the
+# watcher thread, so a per-thread scope would miss exactly the events
+# it exists to exempt.  The brief global blind window during a hot-swap
+# warm is documented in docs/faq/static_analysis.md.
+_SUSPEND_DEPTH = [0]    # guarded-by: runtime._LOCK
+
+
+@contextlib.contextmanager
+def _suspend_cm():
+    from . import runtime
+    runtime.suspend_enter()
+    try:
+        yield
+    finally:
+        runtime.suspend_exit()
+
+
+def suspended():
+    """Context manager exempting enclosed work from steady-state event
+    emission (warmup plans, checkpoint capture, evaluation binds).
+    A no-op nullcontext when no region sanitizer is active."""
+    if not region_sanitizers_active():
+        return contextlib.nullcontext()
+    return _suspend_cm()
+
+
+def is_suspended():
+    return _SUSPEND_DEPTH[0] > 0

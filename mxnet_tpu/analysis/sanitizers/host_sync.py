@@ -1,0 +1,71 @@
+"""san-host-sync — device->host syncs in hot regions, attributed to the
+static suppression that claimed them (or reported when none did).
+
+The tree carries inline ``host-sync`` graftlint suppressions and three
+baselined entries whose justifications are assertions
+("host-by-contract", "result delivery is a sync by definition",
+"warmup-only fetch").  This sanitizer turns each into evidence: every
+``asnumpy``/``asscalar``/``item``/``wait_to_read`` that runs while a
+steady-state region is active walks the stack and must find a claiming
+site — an inline ``host-sync``/``san-host-sync`` comment on a frame's
+line (or the line above, or a file-level entry), or a baselined
+``host-sync`` (path, symbol) pair.  Claimed events bump that site's
+counters (``runtime.site_stats``); an unclaimed event is a finding at
+the deepest non-primitive frame, carrying the live call chain.
+
+``asscalar``/``item``/``__float__`` all funnel through ``asnumpy``, so
+the single ndarray hook covers all four interceptors; the reported
+operation name is refined from the stack.
+"""
+from __future__ import annotations
+
+import time
+
+from . import runtime
+
+__all__ = ["on_host_sync"]
+
+RULE = "san-host-sync"
+
+# user-facing funnels over asnumpy — the reported op name is refined
+# to whichever of these appears in the captured stack
+_FUNNEL_NAMES = ("asscalar", "item", "__float__", "__int__", "__bool__")
+
+
+def on_host_sync(kind):
+    """Handle one sync primitive execution (hooks.HOST_SYNC fast path
+    already passed)."""
+    if runtime.in_guard():
+        return
+    with runtime.guard():
+        t0 = time.perf_counter()
+        hot = runtime.regions_active()
+        claim, frames = runtime.attribute_event(
+            {"host-sync", RULE},
+            skip_basenames=(),
+            baseline_rule="host-sync")
+        # refine "asnumpy" to the user-facing funnel that invoked it
+        op = kind
+        for _rel, _line, func, _cls in frames:
+            if func in _FUNNEL_NAMES:
+                op = func
+                break
+        if claim is None and hot:
+            placed = next((fr for fr in frames
+                           if not fr[0].endswith("/ndarray/ndarray.py")),
+                          frames[0] if frames else None)
+            if placed is not None:
+                path, line, func, cls = placed
+                symbol = "%s.%s" % (cls, func) if cls else func
+                runtime.emit(
+                    RULE, path, line,
+                    ".%s() forced a device->host sync inside the "
+                    "steady-state region [%s] with no claiming "
+                    "suppression or baseline entry (observed live: %s) "
+                    "— each occurrence blocks the XLA stream and "
+                    "round-trips HBM (runtime counterpart: "
+                    "mxnet_transfer_d2h_total)"
+                    % (op, ",".join(runtime.region_names()) or "<none>",
+                       runtime.witness(frames)),
+                    symbol=symbol)
+        runtime._overhead(t0)

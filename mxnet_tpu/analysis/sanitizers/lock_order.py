@@ -1,0 +1,215 @@
+"""san-lock-order — runtime lock-acquisition-order graph + cycle report.
+
+The tree's deadlock surface is review-enforced today: PR 5 *designed
+around* a SIGTERM-save inversion (the handler only sets a flag because
+an inline save could re-acquire locks the interrupted thread holds),
+and the static ``lock-discipline`` checker can see unguarded writes
+but not ordering.  This sanitizer is the kernel-lockdep idea in
+miniature: every tracked lock belongs to a *lock class* (its
+``make_lock`` name — all instances of ``ExecutorCache._lock`` are one
+node), each blocking acquire records an edge from every class the
+thread already holds to the acquired class with a witness stack, and
+the first edge that closes a cycle produces a finding carrying BOTH
+witnesses — the two call paths that, interleaved, deadlock.
+
+What is tracked:
+
+- module-level locks declared via ``__san_locks__`` (engine scope/exc,
+  ``random._STATE_LOCK``, checkpoint store/manager) — swapped in place
+  by :func:`wrap_declared_locks` at install;
+- instance locks routed through ``hooks.make_lock`` at construction
+  (serving cache/server cv, checkpoint async/manager, telemetry
+  registry).
+
+Non-blocking acquires are ignored (a trylock cannot deadlock, and
+``Condition._is_owned`` probes with ``acquire(0)``); sanitizer-internal
+acquisitions are excluded via the runtime reentrancy guard.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import hooks, runtime
+
+__all__ = ["TrackedLock", "wrap_declared_locks", "reset"]
+
+RULE = "san-lock-order"
+
+# modules whose ``__san_locks__`` tuples name the process-wide locks to
+# swap; the declaration lives NEXT TO the lock (the guarded-by idiom)
+_LOCK_MODULES = (
+    "mxnet_tpu.engine",
+    "mxnet_tpu.random",
+    "mxnet_tpu.checkpoint.store",
+    "mxnet_tpu.checkpoint.manager",
+)
+
+_GRAPH_LOCK = threading.Lock()      # untracked — sanitizer-internal
+_EDGES = {}        # guarded-by: _GRAPH_LOCK — (a, b) -> witness text
+_ADJ = {}          # guarded-by: _GRAPH_LOCK — a -> set of b
+_EMITTED = set()   # guarded-by: _GRAPH_LOCK — frozenset lock pairs reported
+
+_TLS = threading.local()
+
+
+def _held():
+    stack = getattr(_TLS, "held", None)
+    if stack is None:
+        stack = _TLS.held = []
+    return stack
+
+
+class TrackedLock:
+    """Order-tracking proxy over a ``threading.Lock``.
+
+    Duck-compatible with the uses in this tree: ``with`` statement,
+    ``acquire(blocking, timeout)``/``release()``/``locked()``, and as
+    the backing lock of a ``threading.Condition`` (which relies only on
+    acquire/release plus ``acquire(0)`` ownership probes)."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+
+    def acquire(self, blocking=True, timeout=-1):
+        track = blocking and hooks.LOCK_ORDER[0] \
+            and not runtime.in_guard()
+        if track:
+            _note_acquire(self)
+        if timeout == -1:
+            got = self._lock.acquire(blocking)
+        else:
+            got = self._lock.acquire(blocking, timeout)
+        if got and track:
+            _held().append((self.name, id(self)))
+        return got
+
+    def release(self):
+        stack = _held()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][1] == id(self):
+                del stack[i]
+                break
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return "TrackedLock(%s)" % self.name
+
+
+def _note_acquire(lock):
+    """Record ordering edges held-class -> acquiring-class; report the
+    first edge closing a cycle (with both witness stacks) and a
+    blocking re-acquire of an instance this thread already holds."""
+    stack = _held()
+    if not stack:
+        return
+    with runtime.guard() as fresh:
+        if not fresh:
+            return
+        t0 = time.perf_counter()
+        frames = runtime._frames()
+        me = runtime.witness(frames)
+        placed = frames[0] if frames else ("mxnet_tpu/engine.py", 1, "", "")
+        if any(iid == id(lock) for _n, iid in stack):
+            runtime.emit(
+                RULE, placed[0], placed[1],
+                "self-deadlock: non-reentrant lock %s re-acquired by "
+                "the thread that already holds it (acquire site: %s)"
+                % (lock.name, me), symbol=placed[2])
+            runtime._overhead(t0)
+            return
+        cycle_report = None
+        with _GRAPH_LOCK:
+            for held_name, _iid in stack:
+                if held_name == lock.name:
+                    continue
+                edge = (held_name, lock.name)
+                if edge in _EDGES:
+                    continue
+                _EDGES[edge] = "%s [thread %s]" % (
+                    me, threading.current_thread().name)
+                _ADJ.setdefault(held_name, set()).add(lock.name)
+                path = _find_path(lock.name, held_name)
+                if path is not None:
+                    pair = frozenset((held_name, lock.name))
+                    if pair not in _EMITTED:
+                        _EMITTED.add(pair)
+                        back = _EDGES.get((path[0], path[1]), "<unknown>")
+                        cycle_report = (held_name, lock.name, path, back)
+        if cycle_report is not None:
+            held_name, new_name, path, back_witness = cycle_report
+            cycle = " -> ".join([held_name, new_name] + path[1:])
+            runtime.emit(
+                RULE, placed[0], placed[1],
+                "lock-order inversion: %s acquired while holding %s, "
+                "but the opposite order already exists — cycle %s; "
+                "this order's witness: %s; opposing witness: %s"
+                % (new_name, held_name, cycle, me, back_witness),
+                symbol=placed[2])
+        runtime._overhead(t0)
+
+
+def _find_path(src, dst):
+    """DFS path src -> ... -> dst through _ADJ (caller holds
+    _GRAPH_LOCK); None when unreachable."""
+    seen = {src}
+    trail = [(src, [src])]
+    while trail:
+        node, path = trail.pop()
+        if node == dst:
+            return path
+        for nxt in _ADJ.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                trail.append((nxt, path + [nxt]))
+    return None
+
+
+def wrap_declared_locks():
+    """Swap every ``__san_locks__``-declared module lock for a tracked
+    proxy, plus the telemetry registry's family lock.  Module globals
+    are read at ``with`` time, so an in-place setattr retrofits all
+    call sites; instances created before install keep raw locks (only
+    construction after install routes through ``hooks.make_lock``)."""
+    import importlib
+    for modname in _LOCK_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:   # noqa: BLE001 — a module the build lacks
+            continue
+        for attr in getattr(mod, "__san_locks__", ()):
+            cur = getattr(mod, attr, None)
+            if cur is None or isinstance(cur, TrackedLock):
+                continue
+            setattr(mod, attr, TrackedLock(
+                "%s.%s" % (modname.rsplit(".", 1)[-1], attr), cur))
+    from ... import telemetry
+    reg = telemetry.get_registry()
+    if not isinstance(reg._lock, TrackedLock):
+        reg._lock = TrackedLock("telemetry.MetricsRegistry._lock",
+                                reg._lock)
+
+
+def edges():
+    with _GRAPH_LOCK:
+        return dict(_EDGES)
+
+
+def reset():
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+        _ADJ.clear()
+        _EMITTED.clear()

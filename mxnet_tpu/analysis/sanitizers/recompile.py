@@ -1,0 +1,69 @@
+"""san-recompile — steady-state recompiles, proven at dispatch.
+
+Static graftlint's ``recompile-hazard`` can say "this value branch
+*would* concretize under jit"; it cannot say whether the running
+workload actually re-traces once warm.  This sanitizer can: the
+executor's dispatch choke point (``Executor._dispatch_compiled``)
+detects a compile exactly — jax's jit cache growing across the call,
+the same probe the telemetry counter uses — and forwards the event
+here.  Inside a steady-state region (installed after
+``ModelServer.warmup()`` and after ``fit``'s first step; see
+``runtime.steady_state``) any compile is a defect: the finding carries
+the program tag, the freshly traced shape signature, and how many
+signatures that program had already compiled before the region began —
+the re-trace diff a human needs to spot the unstable dimension.
+
+Warmup plans, checkpoint capture, and evaluation's first binds run
+under ``hooks.suspended()`` — deliberate cold work never counts.
+"""
+from __future__ import annotations
+
+import time
+
+from . import runtime
+
+__all__ = ["on_compile"]
+
+RULE = "san-recompile"
+
+
+def on_compile(tag, signature, prior_sigs):
+    """Handle one observed XLA compile.
+
+    ``tag`` names the dispatched program (``fb``/``fbu``/``fwd_eval``/
+    ``fwd_train``), ``signature`` is the argument-shape tuple that
+    provoked the trace, ``prior_sigs`` how many distinct signatures the
+    program had compiled before this one."""
+    if not runtime.regions_active():
+        return
+    with runtime.guard() as fresh:
+        if not fresh:
+            return
+        t0 = time.perf_counter()
+        claim, frames = runtime.attribute_event(
+            {"recompile-hazard", RULE}, skip_basenames=("executor.py",))
+        if claim is None:
+            if frames:
+                path, line, func, _cls = frames[0]
+            else:
+                path, line, func = "mxnet_tpu/executor.py", 1, ""
+            regions = ",".join(runtime.region_names()) or "<none>"
+            runtime.emit(
+                RULE, path, line,
+                "steady-state recompile in region [%s]: program %r "
+                "re-traced a new signature %s (%d signature%s already "
+                "compiled before the region began) — every occurrence "
+                "is a full XLA compile on the hot path (runtime "
+                "counterpart: mxnet_xla_compiles_total)"
+                % (regions, tag, _fmt_sig(signature), prior_sigs,
+                   "s" if prior_sigs != 1 else ""),
+                symbol=func)
+        runtime._overhead(t0)
+
+
+def _fmt_sig(signature):
+    try:
+        return "shapes=(%s)" % ", ".join(
+            "x".join(map(str, s)) if s else "scalar" for s in signature)
+    except TypeError:
+        return repr(signature)

@@ -1,0 +1,503 @@
+"""graftsan runtime core — regions, event attribution, finding emission.
+
+One toolchain, two evidence sources: the sanitizers emit the same
+:class:`~..core.Finding` objects as static graftlint, with the same
+line-free fingerprints, through the same reporters/SARIF/baseline gate,
+and honor the same inline-suppression syntax under the runtime rule ids
+(``san-recompile``, ``san-host-sync``, ``san-lock-order``,
+``san-donation``).  The reference precedent is the check-at-runtime
+discipline the TensorFlow paper leans on for its concurrent executor
+(arxiv 1605.08695) and the runtime-enforced invariants of the original
+MXNet dependency engine: some hazard classes (steady-state recompiles,
+lock-order inversions, donated-buffer reuse) are fundamentally dynamic
+— a static pass can only *claim*, the sanitizer *proves or refutes*.
+
+Three shared facilities live here:
+
+- **steady-state regions** (:func:`steady_state`): installed after
+  ``ModelServer.warmup()`` and after ``fit``'s first step; the
+  recompile and host-sync sanitizers only *emit* while a region is
+  active and not :func:`suspended <.hooks.suspended>` (warmup plans,
+  checkpoint capture and evaluation binds are deliberate cold work);
+- **attribution** (:func:`attribute_event`): walk the Python stack,
+  find the static suppression site or baseline entry that *claimed*
+  the event, and record per-site statistics — the raw evidence
+  ``tools/lint.py --audit-suppressions`` classifies;
+- **emission** (:func:`emit`): dedup by fingerprint, honor ``san-*``
+  graftlint disable comments at the attributed line,
+  count into ``mxnet_sanitizer_findings_total{rule=...}`` and
+  accumulate handler wall time into
+  ``mxnet_sanitizer_overhead_seconds``.
+
+Thread safety: events arrive from the serving batcher, checkpoint
+workers and prefetch producers concurrently; all shared state below is
+guarded by ``_LOCK``, and a thread-local reentrancy latch keeps the
+sanitizer's own bookkeeping (telemetry locks, file reads) out of the
+lock-order graph and the event stream.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+from ..core import Finding, RUNTIME_RULES, repo_root
+from . import hooks
+
+__all__ = ["RUNTIME_RULES", "install", "installed", "reset",
+           "steady_state", "regions_active", "emit", "findings",
+           "finding_counts", "site_stats", "baseline_stats", "report",
+           "attribute_event", "guard", "in_guard"]
+
+# RUNTIME_RULES is canonical in ..core (the stale-suppression pass
+# exempts them there); re-exported here for sanitizer callers
+_SEVERITY = {"san-recompile": "error", "san-host-sync": "warning",
+             "san-lock-order": "error", "san-donation": "error"}
+
+_LOCK = threading.Lock()
+_INSTALLED = [False]      # guarded-by: _LOCK
+_FINDINGS = {}            # guarded-by: _LOCK — fingerprint -> [Finding, count]
+_REGIONS = []             # guarded-by: _LOCK — active region names
+_SITE_STATS = {}          # guarded-by: _LOCK — (path, line) -> stats dict
+_BASELINE_STATS = {}      # guarded-by: _LOCK — fingerprint -> stats dict
+_CLAIMS = {}              # guarded-by: _LOCK — relpath -> claim index
+_BASELINE_SYMS = []       # guarded-by: _LOCK — host-sync baseline entries
+
+_TLS = threading.local()
+
+_SANITIZER_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class guard:
+    """Thread-local reentrancy latch: while held, instrumentation hooks
+    fired by the sanitizer's OWN work (telemetry counter locks, source
+    reads) are ignored instead of recursing or polluting the lock
+    graph."""
+
+    def __enter__(self):
+        prev = getattr(_TLS, "in_san", False)
+        self._prev = prev
+        _TLS.in_san = True
+        return not prev     # False means we were already inside
+
+    def __exit__(self, *exc):
+        _TLS.in_san = self._prev
+
+
+def in_guard():
+    return getattr(_TLS, "in_san", False)
+
+
+def _overhead(t0):
+    from ... import telemetry
+    telemetry.counter(
+        "mxnet_sanitizer_overhead_seconds",
+        "cumulative wall time spent inside graftsan event handlers "
+        "(attribution, lock-graph updates, probes); the all-off fast "
+        "path never reaches a handler").inc(
+            max(0.0, time.perf_counter() - t0))
+
+
+def count_finding(rule):
+    from ... import telemetry
+    telemetry.counter(
+        "mxnet_sanitizer_findings_total",
+        "runtime-sanitizer finding occurrences by rule (deduplicated "
+        "Finding objects may repeat; each observed occurrence counts)"
+    ).labels(rule=rule).inc()
+
+
+# -- install -----------------------------------------------------------------
+
+_EXIT_HOOKED = [False]    # guarded-by: _LOCK
+
+
+def install(root=None, rules=None):
+    """Arm the sanitizers selected by the ``MXNET_SAN_*`` knobs (or all
+    four under the ``MXNET_SAN`` master switch), build the static claim
+    index, and swap the declared module locks.  Idempotent for the
+    knob-driven form; an explicit ``rules`` iterable (sanitizer names
+    ``recompile``/``host-sync``/``lock-order``/``donation`` — the audit
+    and the test fixtures) re-arms exactly that set."""
+    from ... import config
+    with _LOCK:
+        if _INSTALLED[0] and rules is None:
+            return False
+        _INSTALLED[0] = True
+    if rules is not None:
+        want = set(rules)
+        unknown = want - {"recompile", "host-sync", "lock-order",
+                          "donation"}
+        if unknown:
+            raise ValueError("unknown sanitizers: %s" % sorted(unknown))
+        hooks.RECOMPILE[0] = "recompile" in want
+        hooks.HOST_SYNC[0] = "host-sync" in want
+        hooks.LOCK_ORDER[0] = "lock-order" in want
+        hooks.DONATION[0] = "donation" in want
+    else:
+        master = bool(config.get("MXNET_SAN"))
+        hooks.RECOMPILE[0] = master or bool(
+            config.get("MXNET_SAN_RECOMPILE"))
+        hooks.HOST_SYNC[0] = master or bool(
+            config.get("MXNET_SAN_HOST_SYNC"))
+        hooks.LOCK_ORDER[0] = master or bool(
+            config.get("MXNET_SAN_LOCK_ORDER"))
+        hooks.DONATION[0] = master or bool(
+            config.get("MXNET_SAN_DONATION"))
+    _build_claim_index(root)
+    from . import donation, host_sync, lock_order, recompile
+    hooks.on_host_sync = (host_sync.on_host_sync if hooks.HOST_SYNC[0]
+                          else _noop_host_sync)
+    hooks.on_compile = (recompile.on_compile if hooks.RECOMPILE[0]
+                        else _noop_compile)
+    if hooks.LOCK_ORDER[0]:
+        lock_order.wrap_declared_locks()
+    hooks.on_donated_dispatch = (
+        donation.on_donated_dispatch if hooks.DONATION[0]
+        else _noop_donated)
+    hooks.on_buffer_read = (donation.on_buffer_read if hooks.DONATION[0]
+                            else _noop_read)
+    report_path = config.get("MXNET_SAN_REPORT")
+    if report_path:
+        with _LOCK:
+            hook_now = not _EXIT_HOOKED[0]
+            _EXIT_HOOKED[0] = True
+        if hook_now:
+            import atexit
+            import json
+
+            def _write():
+                try:
+                    with open(report_path, "w", encoding="utf-8") as f:
+                        json.dump(report(), f, indent=1)
+                except Exception:       # noqa: BLE001 — exit hook
+                    pass
+            atexit.register(_write)
+    return True
+
+
+def _noop_host_sync(kind):
+    pass
+
+
+def _noop_compile(tag, signature, prior_sigs):
+    pass
+
+
+def _noop_donated(executor, donated, tag):
+    pass
+
+
+def _noop_read(nd):
+    pass
+
+
+def uninstall():
+    """Disarm every sanitizer and drop collected state (test teardown:
+    the tier-1 suite shares one process, so an armed sanitizer must
+    never leak past its test).  Wrapped locks stay wrapped — the proxy
+    is inert while the flag is off."""
+    hooks.RECOMPILE[0] = False
+    hooks.HOST_SYNC[0] = False
+    hooks.LOCK_ORDER[0] = False
+    hooks.DONATION[0] = False
+    hooks.on_host_sync = _noop_host_sync
+    hooks.on_compile = _noop_compile
+    hooks.on_donated_dispatch = _noop_donated
+    hooks.on_buffer_read = _noop_read
+    reset()
+    with _LOCK:
+        _INSTALLED[0] = False
+
+
+def installed():
+    return _INSTALLED[0]
+
+
+def reset():
+    """Drop findings/stats/regions (tests, fresh audit windows); armed
+    flags and wrapped locks stay as installed."""
+    with _LOCK:
+        _FINDINGS.clear()
+        _REGIONS[:] = []
+        for st in _SITE_STATS.values():
+            st["events"] = 0
+            st["hot_events"] = 0
+        _BASELINE_STATS.clear()
+    hooks._SUSPEND_DEPTH[0] = 0
+    from . import lock_order
+    lock_order.reset()
+    from . import donation
+    donation.reset()
+
+
+def _build_claim_index(root=None):
+    """Index every static suppression site that can *claim* a runtime
+    event: inline/file ``graftlint: disable=`` comments whose rules
+    include a relevant static rule or a ``san-*`` runtime rule, plus
+    the committed baseline's host-sync entries (path + symbol)."""
+    from ..core import _suppressions, iter_source_files
+    from .. import baseline as baseline_mod
+    root = root or repo_root()
+    pkg = os.path.join(root, "mxnet_tpu")
+    claims = {}
+    for path in iter_source_files([pkg] if os.path.isdir(pkg) else [root]):
+        if not path.endswith(".py"):
+            continue
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        if "graftlint:" not in text:
+            continue
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        file_entries, per_line = _suppressions(text)
+        if file_entries or per_line:
+            claims[relpath] = {"file": file_entries, "lines": per_line}
+    baseline_syms = []
+    try:
+        for fp, e in baseline_mod.load(
+                baseline_mod.default_path(root)).items():
+            baseline_syms.append(
+                {"fingerprint": fp, "rule": e.get("rule", ""),
+                 "path": e.get("path", ""),
+                 "symbol": (e.get("symbol", "") or "").rsplit(".", 1)[-1]})
+    except Exception:   # noqa: BLE001 — a broken baseline must not
+        pass            # break the runtime; the static gate reports it
+    with _LOCK:
+        _CLAIMS.clear()
+        _CLAIMS.update(claims)
+        _BASELINE_SYMS[:] = baseline_syms
+
+
+# -- suspension (backs hooks.suspended) --------------------------------------
+
+def suspend_enter():
+    with _LOCK:
+        hooks._SUSPEND_DEPTH[0] += 1
+
+
+def suspend_exit():
+    with _LOCK:
+        hooks._SUSPEND_DEPTH[0] -= 1
+
+
+# -- steady-state regions ----------------------------------------------------
+
+class SteadyStateRegion:
+    """A handle marking "compiles and unclaimed host syncs beyond this
+    point are defects".  Install-and-keep (``fit``/serving) or scoped
+    (``with sanitizers.steady_state("bench"):``)."""
+
+    __slots__ = ("name", "_open")
+
+    def __init__(self, name, register=True):
+        self.name = name
+        self._open = register
+        if register:
+            with _LOCK:
+                _REGIONS.append(name)
+
+    def close(self):
+        if self._open:
+            self._open = False
+            with _LOCK:
+                try:
+                    _REGIONS.remove(self.name)
+                except ValueError:
+                    pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_NOOP_REGION = SteadyStateRegion("<inactive>", register=False)
+
+
+def steady_state(name):
+    """Begin a steady-state region named ``name``; returns a region
+    handle (a shared closed no-op when no region sanitizer is armed, so
+    disabled processes never touch the registry)."""
+    if not hooks.region_sanitizers_active():
+        return _NOOP_REGION
+    return SteadyStateRegion(str(name))
+
+
+def regions_active():
+    """True when at least one region is open and emission is not
+    suspended — the "hot" predicate events are gated on."""
+    return bool(_REGIONS) and not hooks.is_suspended()
+
+
+def region_names():
+    with _LOCK:
+        return list(_REGIONS)
+
+
+# -- attribution -------------------------------------------------------------
+
+def _frames(skip_basenames=()):
+    """Repo-package frames innermost-first as (relpath, lineno, func,
+    self_class) — sanitizer frames and ``skip_basenames`` excluded."""
+    root = repo_root()
+    pkg_prefix = os.path.join(root, "mxnet_tpu") + os.sep
+    out = []
+    f = sys._getframe(1)
+    while f is not None and len(out) < 25:
+        fname = f.f_code.co_filename
+        if fname.startswith(pkg_prefix) \
+                and not fname.startswith(_SANITIZER_DIR) \
+                and os.path.basename(fname) not in skip_basenames:
+            rel = os.path.relpath(fname, root).replace(os.sep, "/")
+            slf = f.f_locals.get("self")
+            out.append((rel, f.f_lineno, f.f_code.co_name,
+                        type(slf).__name__ if slf is not None else ""))
+        f = f.f_back
+    return out
+
+
+def _claimed_by_comment(relpath, lineno, rules):
+    """The suppression-comment line at ``lineno``/``lineno - 1`` (or a
+    file-level entry) claiming one of ``rules`` — None when unclaimed."""
+    with _LOCK:
+        idx = _CLAIMS.get(relpath)
+    if idx is None:
+        return None
+    for lineno_c, entry_rules in idx["file"]:
+        if entry_rules & rules or "all" in entry_rules:
+            return ("file", lineno_c, entry_rules)
+    for c in (lineno, lineno - 1):
+        entry_rules = idx["lines"].get(c)
+        if entry_rules and (entry_rules & rules or "all" in entry_rules):
+            return ("line", c, entry_rules)
+    return None
+
+
+def attribute_event(rules, skip_basenames=(), baseline_rule=None):
+    """Attribute a runtime event to its claiming site.
+
+    Walks the captured frames outward; the first frame carrying a
+    suppression comment for one of ``rules`` (same line or line above,
+    or a file-level entry) claims the event, else a baseline entry of
+    ``baseline_rule`` whose (path, symbol) matches a frame claims it.
+    Returns ``(claim, frames)`` where ``claim`` is ``("site", path,
+    comment_line)`` / ``("baseline", fingerprint)`` / ``None``, and
+    ``frames`` is the walked frame list (deepest first) for witness
+    text and finding placement."""
+    frames = _frames(skip_basenames)
+    rules = set(rules)
+    for rel, lineno, func, cls in frames:
+        hit = _claimed_by_comment(rel, lineno, rules)
+        if hit is not None:
+            kind, comment_line, _entry_rules = hit
+            _bump_site(rel, comment_line, kind)
+            return ("site", rel, comment_line), frames
+    if baseline_rule is not None:
+        with _LOCK:
+            entries = list(_BASELINE_SYMS)
+        for e in entries:
+            if e["rule"] != baseline_rule:
+                continue
+            for rel, _lineno, func, cls in frames:
+                if rel == e["path"] and func == e["symbol"]:
+                    _bump_baseline(e["fingerprint"])
+                    return ("baseline", e["fingerprint"]), frames
+    return None, frames
+
+
+def _bump_site(relpath, comment_line, kind):
+    hot = regions_active()
+    with _LOCK:
+        st = _SITE_STATS.setdefault(
+            (relpath, comment_line),
+            {"kind": kind, "events": 0, "hot_events": 0})
+        st["events"] += 1
+        if hot:
+            st["hot_events"] += 1
+
+
+def _bump_baseline(fingerprint):
+    hot = regions_active()
+    with _LOCK:
+        st = _BASELINE_STATS.setdefault(
+            fingerprint, {"events": 0, "hot_events": 0})
+        st["events"] += 1
+        if hot:
+            st["hot_events"] += 1
+
+
+def witness(frames, limit=4):
+    """Compact call-chain text from a :func:`_frames` list."""
+    return " <- ".join("%s:%d %s" % (rel, lineno, func)
+                       for rel, lineno, func, _cls in frames[:limit])
+
+
+# -- emission ----------------------------------------------------------------
+
+def emit(rule, path, line, message, symbol=""):
+    """Record one runtime finding (deduplicated by fingerprint) unless
+    an inline ``# graftlint: disable=<rule>`` comment at the attributed
+    line claims it; returns the Finding or None when suppressed."""
+    claim = _claimed_by_comment(path, line, {rule})
+    if claim is not None:
+        _bump_site(path, claim[1], claim[0])
+        return None
+    f = Finding(rule, _SEVERITY.get(rule, "error"), path, line, message,
+                symbol=symbol)
+    with _LOCK:
+        slot = _FINDINGS.get(f.fingerprint)
+        if slot is None:
+            _FINDINGS[f.fingerprint] = [f, 1]
+        else:
+            slot[1] += 1
+    count_finding(rule)
+    return f
+
+
+def findings():
+    """The accumulated runtime findings, sorted like a lint run."""
+    with _LOCK:
+        out = [f for f, _n in _FINDINGS.values()]
+    out.sort(key=Finding.sort_key)
+    return out
+
+
+def finding_counts():
+    """``{fingerprint: occurrence_count}`` for the accumulated set."""
+    with _LOCK:
+        return {fp: n for fp, (_f, n) in _FINDINGS.items()}
+
+
+def site_stats():
+    """``{(path, comment_line): {"events", "hot_events", ...}}`` —
+    claimed-event counts per static suppression site."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _SITE_STATS.items()}
+
+
+def baseline_stats():
+    """``{fingerprint: {"events", "hot_events"}}`` for baseline-claimed
+    events."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _BASELINE_STATS.items()}
+
+
+def report():
+    """JSON-shaped snapshot: findings with occurrence counts plus the
+    per-site claim statistics (the audit's raw evidence)."""
+    counts = finding_counts()
+    return {
+        "version": 1,
+        "findings": [dict(f.to_dict(), occurrences=counts[f.fingerprint])
+                     for f in findings()],
+        "claimed_sites": [
+            {"path": p, "comment_line": line, **st}
+            for (p, line), st in sorted(site_stats().items())],
+        "claimed_baseline": baseline_stats(),
+        "regions": region_names(),
+    }

@@ -93,9 +93,11 @@ class AsyncCheckpointer:
     at-most-one in-flight save."""
 
     def __init__(self, store, retention=None):
+        from ..analysis.sanitizers import hooks as _san_hooks
         self.store = store
         self.retention = retention
-        self._lock = threading.Lock()
+        self._lock = _san_hooks.make_lock(
+            "checkpoint.AsyncCheckpointer._lock", threading.Lock())
         self._inflight = None     # guarded-by: _lock — live writer thread
         self._last_error = None   # guarded-by: _lock — newest failed save's exc
         self._saves_started = 0   # guarded-by: _lock

@@ -80,8 +80,10 @@ class CheckpointManager:
         self.async_save = bool(async_save)
         self.period_steps = int(period_steps or 0)
         self.period_epochs = int(period_epochs or 0)
+        from ..analysis.sanitizers import hooks as _san_hooks
         self._async = AsyncCheckpointer(self.store, retention=self.retention)
-        self._lock = threading.Lock()
+        self._lock = _san_hooks.make_lock(
+            "checkpoint.CheckpointManager._lock", threading.Lock())
         # commit-sequence high-water mark: starts past everything on
         # disk so resumed jobs keep appending, and never reuses an id
         # even after retention deletes old directories
@@ -188,6 +190,9 @@ class CheckpointManager:
 # ---------------------------------------------------------------------------
 _DEFAULT_LOCK = threading.Lock()
 _DEFAULT = {}   # guarded-by: _DEFAULT_LOCK — directory -> CheckpointManager
+
+# graftsan lock-order sanitizer swap list (docs/faq/static_analysis.md)
+__san_locks__ = ("_DEFAULT_LOCK",)
 
 
 def default_manager(directory=None):

@@ -68,6 +68,10 @@ _TMP_RE = re.compile(r"^\.tmp-ckpt-\d{8}-(?P<pid>\d+)-[0-9a-f]+$")
 _ACTIVE_LOCK = threading.Lock()
 _ACTIVE_TMP = set()   # guarded-by: _ACTIVE_LOCK
 
+# graftsan lock-order sanitizer: module locks declared here are swapped
+# for tracked proxies at install (docs/faq/static_analysis.md)
+__san_locks__ = ("_ACTIVE_LOCK",)
+
 
 class CheckpointError(MXNetError):
     """A checkpoint could not be written or resolved."""

@@ -220,6 +220,33 @@ register_env("MXNET_PARALLEL_COMPRESSION", str, None,
 register_env("MXNET_PARALLEL_COMPRESSION_THRESHOLD", float, 0.5,
              "quantization threshold of the 2bit codec (reference "
              "gradient_compression.cc pos/neg threshold)")
+register_env("MXNET_SAN", bool, False,
+             "master switch arming all four graftsan runtime "
+             "sanitizers (recompile, host-sync, lock-order, donation); "
+             "each is also individually switchable — see "
+             "docs/faq/static_analysis.md")
+register_env("MXNET_SAN_RECOMPILE", bool, False,
+             "graftsan recompile sanitizer: XLA compiles observed "
+             "inside a steady-state region (after serving warmup / "
+             "after fit's first step) become san-recompile findings "
+             "carrying the re-traced shape signature")
+register_env("MXNET_SAN_HOST_SYNC", bool, False,
+             "graftsan host-sync sanitizer: asnumpy/asscalar/item/"
+             "wait_to_read in a steady-state region must be claimed by "
+             "a static suppression or baseline entry, else they become "
+             "san-host-sync findings")
+register_env("MXNET_SAN_LOCK_ORDER", bool, False,
+             "graftsan lock-order sanitizer: tracked locks build a "
+             "runtime acquisition-order graph; a cycle (potential "
+             "deadlock) is reported with both witness stacks")
+register_env("MXNET_SAN_DONATION", bool, False,
+             "graftsan donation sanitizer: buffers consumed by a "
+             "donated XLA dispatch are registered and any later use "
+             "is reported with the declaring bind site")
+register_env("MXNET_SAN_REPORT", str, None,
+             "path for the graftsan findings/claim-statistics JSON "
+             "report written at process exit when any sanitizer is "
+             "armed")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

@@ -34,6 +34,13 @@ __all__ = ["bulk", "set_bulk_size", "record_exception", "check_raise",
 _SCOPE_LOCK = threading.Lock()
 _NAIVE_DEPTH = [0]   # guarded-by: _SCOPE_LOCK
 
+# graftsan lock-order sanitizer: the engine-control and deferred-
+# exception locks join the runtime acquisition-order graph when
+# MXNET_SAN_LOCK_ORDER is armed — the SIGTERM-save inversion PR 5
+# designed around is exactly the cycle class this proves absent
+# (docs/faq/static_analysis.md)
+__san_locks__ = ("_SCOPE_LOCK", "_EXC_LOCK")
+
 
 @contextlib.contextmanager
 def naive():

@@ -27,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .analysis.sanitizers import hooks as _san_hooks
 from .base import MXNetError, dtype_np
 from .context import Context, current_context
 from .ndarray.ndarray import NDArray, zeros as nd_zeros, _wrap
@@ -321,6 +322,15 @@ class Executor:
         if self._jit_fbu is None:
             self._jit_fbu = self._build_fbu()
         self._replay_key_data = key_dev  # for backward(out_grads) replay
+        # graftsan donation sanitizer: the dispatch below consumes
+        # (donate_argnums=(0, 5, 6)) these exact arrays — snapshot the
+        # references first so post-donation use can be attributed
+        donated = None
+        if _san_hooks.DONATION[0]:
+            import jax.tree_util as _tree
+            donated = (list(diff)
+                       + _tree.tree_leaves(self._fused_state)
+                       + _tree.tree_leaves(self._fused_resids))
         outs, new_diff, new_states, new_resids, new_aux, new_key = \
             self._dispatch_compiled(
                 "fbu", self._jit_fbu, diff, diff, rest, aux, key_dev,
@@ -333,6 +343,10 @@ class Executor:
             self.arg_dict[self.arg_names[i]]._data = new_diff[j]
         self._cached_grads = None
         self._updates_applied = True
+        if donated is not None:
+            # after the rebinds: any executor slot (or later NDArray
+            # read) still referencing a donated buffer is a defect
+            _san_hooks.on_donated_dispatch(self, donated, "fbu")
         return outs, new_aux
 
     # -- binding constructors ----------------------------------------------
@@ -439,11 +453,19 @@ class Executor:
         compile cost (dispatch itself is async and returns in
         microseconds).  Disabled telemetry pays one boolean check and
         an extra frame.  Fallback for jit objects without a cache-size
-        probe: a per-executor (tag, shapes) signature set."""
+        probe: a per-executor (tag, shapes) signature set.
+
+        The graftsan recompile sanitizer shares this exact detection:
+        when armed, every observed compile is forwarded with its shape
+        signature and the count of signatures this program had already
+        compiled — inside a steady-state region that event is a
+        san-recompile finding (docs/faq/static_analysis.md)."""
         from . import telemetry
-        if not telemetry.enabled():
+        san_on = _san_hooks.RECOMPILE[0]
+        if not telemetry.enabled() and not san_on:
             return fn(*call_args)
         import time as _time
+        sig = None
         size_fn = getattr(fn, "_cache_size", None)
         if size_fn is not None:
             before = size_fn()
@@ -455,18 +477,27 @@ class Executor:
             compiled = sig not in self._compile_seen
             t0 = _time.perf_counter()
             out = fn(*call_args)
-            if compiled:
-                self._compile_seen.add(sig)
         if compiled:
-            telemetry.counter(
-                "mxnet_xla_compiles_total",
-                "XLA program compilations observed at dispatch "
-                "(jit-cache growth; cache-miss == recompile)").inc()
-            telemetry.histogram(
-                "mxnet_xla_compile_seconds",
-                "wall time of compiling dispatches (trace + XLA compile)",
-                buckets=telemetry.exponential_buckets(0.001, 4.0, 12)
-            ).observe(_time.perf_counter() - t0)
+            # the signature tuple is O(arg count) to build — only pay
+            # for it on the rare compiling dispatch (or the fallback
+            # branch above, which needs it for detection itself)
+            if sig is None:
+                sig = (tag, tuple(tuple(a.shape) for a in sig_arrays))
+            prior = sum(1 for s in self._compile_seen if s[0] == tag)
+            self._compile_seen.add(sig)
+            if telemetry.enabled():
+                telemetry.counter(
+                    "mxnet_xla_compiles_total",
+                    "XLA program compilations observed at dispatch "
+                    "(jit-cache growth; cache-miss == recompile)").inc()
+                telemetry.histogram(
+                    "mxnet_xla_compile_seconds",
+                    "wall time of compiling dispatches (trace + XLA "
+                    "compile)",
+                    buckets=telemetry.exponential_buckets(0.001, 4.0, 12)
+                ).observe(_time.perf_counter() - t0)
+            if san_on:
+                _san_hooks.on_compile(tag, sig[1], prior)
         return out
 
     def _args(self):

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from ..analysis.sanitizers import hooks as _san_hooks
 from ..base import MXNetError, dtype_np, dtype_id, _DTYPE_MX_TO_NP, numeric_types
 from ..context import Context, current_context
 from ..imperative import invoke, invoke_fn
@@ -251,6 +252,10 @@ class NDArray:
         engine.check_raise()
         if telemetry.enabled():
             _sync_metrics()[1].inc()
+        if _san_hooks.HOST_SYNC[0]:
+            _san_hooks.on_host_sync("wait_to_read")
+        if _san_hooks.DONATION[0]:
+            _san_hooks.on_buffer_read(self)
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
@@ -267,6 +272,12 @@ class NDArray:
             _gen, _sync, d2h, d2h_bytes = _sync_metrics()
             d2h.inc()
             d2h_bytes.inc(int(data.size) * np.dtype(data.dtype).itemsize)
+        # graftsan: the asnumpy funnel covers asscalar/item/__float__
+        # too — the sanitizer names the outermost caller from the stack
+        if _san_hooks.HOST_SYNC[0]:
+            _san_hooks.on_host_sync("asnumpy")
+        if _san_hooks.DONATION[0]:
+            _san_hooks.on_buffer_read(self)
         return np.asarray(data)
 
     def asscalar(self):

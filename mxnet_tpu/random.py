@@ -30,6 +30,11 @@ import jax
 _STATE_LOCK = threading.Lock()
 _STATE = {"seed": 0, "count": 0}    # guarded-by: _STATE_LOCK
 
+# graftsan lock-order sanitizer swap list: the RNG chain lock is taken
+# from worker threads too (see the thread-safety note above), so it
+# belongs in the runtime acquisition-order graph
+__san_locks__ = ("_STATE_LOCK",)
+
 
 def seed(seed_state=0, ctx="all"):
     """Reference: python/mxnet/random.py:28 (mx.random.seed)."""

@@ -20,6 +20,7 @@ import threading
 from collections import OrderedDict
 
 from .. import telemetry
+from ..analysis.sanitizers import hooks as _san_hooks
 from ..predictor import Predictor
 
 __all__ = ["ExecutorCache"]
@@ -30,7 +31,8 @@ class ExecutorCache:
         if capacity < 1:
             raise ValueError("executor cache capacity must be >= 1")
         self._capacity = int(capacity)
-        self._lock = threading.Lock()
+        self._lock = _san_hooks.make_lock(
+            "serving.ExecutorCache._lock", threading.Lock())
         # (name, version, id(entry), bucket) -> (ModelVersion, Predictor)
         self._entries = OrderedDict()   # guarded-by: _lock
         self.hits = 0                   # guarded-by: _lock

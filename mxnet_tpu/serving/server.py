@@ -45,6 +45,7 @@ from .. import config
 from .. import engine
 from .. import profiler
 from .. import telemetry
+from ..analysis.sanitizers import hooks as _san_hooks
 from ..io import pad_batch
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
@@ -192,8 +193,13 @@ class ModelServer:
             else config.get("MXNET_SERVING_EXECUTOR_CACHE"),
             on_miss=(self.manifest.record if self.manifest is not None
                      else None))
-        self._cv = threading.Condition()
+        # the cv's backing lock joins the graftsan lock-order graph as
+        # lock class "serving.ModelServer._cv" when that sanitizer is
+        # armed (hooks.make_lock is identity otherwise)
+        self._cv = threading.Condition(_san_hooks.make_lock(
+            "serving.ModelServer._cv", threading.Lock()))
         self._queue = []                # guarded-by: _cv
+        self._san_region = None         # graftsan steady-state handle
         self._stopping = False
         self._drain = True
         self._thread = None
@@ -222,7 +228,8 @@ class ModelServer:
             "mxnet_serving_latency_ms",
             "submit-to-result latency of served requests",
             buckets=telemetry.exponential_buckets(0.5, 2.0, 14))
-        self._mlock = threading.Lock()
+        self._mlock = _san_hooks.make_lock(
+            "serving.ModelServer._mlock", threading.Lock())
         self._req_counts = {o: 0           # guarded-by: _mlock
                             for o in ("submitted", "served", "failed",
                                       "rejected_queue_full", "expired")}
@@ -296,6 +303,9 @@ class ModelServer:
             del self._queue[:]
         for r in leftovers:
             r.future._set_exception(ServerClosed("server stopped"))
+        if self._san_region is not None:
+            self._san_region.close()
+            self._san_region = None
 
     def __enter__(self):
         return self.start()
@@ -412,7 +422,10 @@ class ModelServer:
             entry = self.registry.get(n, version)
             plan.append((entry, [int(b) for b in (
                 buckets if buckets is not None else self._buckets)]))
-        return self._warm(plan, timeout_ms)
+        warmed = self._warm(plan, timeout_ms)
+        if warmed:
+            self._enter_steady_state()
+        return warmed
 
     def warmup_from_manifest(self, name=None, version=None,
                              timeout_ms=600000.0):
@@ -442,7 +455,10 @@ class ModelServer:
                     self._buckets)
             if on_ladder:
                 plan.append((entry, on_ladder))
-        return self._warm(plan, timeout_ms)
+        warmed = self._warm(plan, timeout_ms)
+        if warmed:
+            self._enter_steady_state()
+        return warmed
 
     def warmup_version(self, name, version, timeout_ms=600000.0):
         """Warm ONE version's executors — the checkpoint watcher's
@@ -459,6 +475,16 @@ class ModelServer:
                 bucket_list = recorded
         return self._warm([(entry, bucket_list)], timeout_ms)
 
+    def _enter_steady_state(self):
+        """After a completed warmup plan the server is steady-state by
+        contract (zero recompiles, every sync claimed): open the
+        graftsan region proving it.  One region per server; a no-op
+        handle when no region sanitizer is armed."""
+        if self._san_region is None and \
+                _san_hooks.region_sanitizers_active():
+            from ..analysis import sanitizers as _san
+            self._san_region = _san.steady_state("serving")
+
     def _warm(self, plan, timeout_ms):
         """Execute a warmup plan of (entry, buckets) pairs, timing it
         into ``mxnet_serving_warmup_seconds{mode=warm|cold}`` — warm
@@ -474,23 +500,27 @@ class ModelServer:
         before = compile_cache.stats(refresh=False)
         t0 = time.perf_counter()
         warmed = []
-        for entry, bucket_list in plan:
-            for b in bucket_list:
-                feed = {k: np.zeros((b,) + s, np.float32)
-                        for k, s in entry.sample_shapes.items()}
-                if batcher_owns:
-                    self.infer_async(entry.name, feed,
-                                     version=entry.version,
-                                     timeout_ms=timeout_ms,
-                                     _solo=True).result()
-                else:
-                    pred = self.cache.get(entry, b)
-                    pred.forward(**feed)
-                    for i in range(entry.num_outputs):
-                        # deliberate sync: warmup EXISTS to force the
-                        # compile + first execution before live traffic
-                        pred.get_output(i).asnumpy()  # graftlint: disable=host-sync
-                warmed.append((entry.name, entry.version, b))
+        # graftsan: a warmup plan is deliberate cold work — its
+        # compiles and syncs are exempt from steady-state emission even
+        # when a hot-swap warms a new version mid-traffic
+        with _san_hooks.suspended():
+            for entry, bucket_list in plan:
+                for b in bucket_list:
+                    feed = {k: np.zeros((b,) + s, np.float32)
+                            for k, s in entry.sample_shapes.items()}
+                    if batcher_owns:
+                        self.infer_async(entry.name, feed,
+                                         version=entry.version,
+                                         timeout_ms=timeout_ms,
+                                         _solo=True).result()
+                    else:
+                        pred = self.cache.get(entry, b)
+                        pred.forward(**feed)
+                        for i in range(entry.num_outputs):
+                            # deliberate sync: warmup EXISTS to force the
+                            # compile + first execution before live traffic
+                            pred.get_output(i).asnumpy()  # graftlint: disable=host-sync,san-host-sync
+                    warmed.append((entry.name, entry.version, b))
         if warmed:
             wall = time.perf_counter() - t0
             after = compile_cache.stats(refresh=False)

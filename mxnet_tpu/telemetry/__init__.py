@@ -18,7 +18,12 @@ Gating: instrumentation in training hot paths (executor dispatch,
 ``enabled()`` — one boolean check on the disabled fast path, toggled by
 ``MXNET_TELEMETRY`` or ``enable()``/``disable()``.  The serving layer
 records unconditionally: its ``stats()`` surface always existed and the
-registry is simply its new backing store.
+registry is simply its new backing store.  The graftsan sanitizers
+(``analysis/sanitizers/``) record unconditionally too — their
+``mxnet_sanitizer_findings_total{rule=...}`` /
+``mxnet_sanitizer_overhead_seconds`` series only move while a
+``MXNET_SAN*`` knob is armed, and ride the same scalar-totals bridge
+into chrome traces as every other family.
 """
 from __future__ import annotations
 

@@ -3,6 +3,15 @@
 Reference analogue: tests/nightly/test_image_classification.sh and the
 tutorial-execution suite — examples are executable documentation and
 break silently unless exercised.
+
+Budget: tier-1 runs ``-m 'not slow'`` under a hard 870 s wall.  The
+full example sweep measures ~36 min on this class of container — it
+used to blow the whole budget (rc=124 on every run, killing the suite
+at ~28% and silently masking failures in everything alphabetically
+after this file).  Examples measured over ~10 s are therefore marked
+``slow`` (they still run in the slow leg / nightly); the fast third
+keeps end-to-end example coverage inside tier-1.  If you add an
+example test, time it and mark accordingly.
 """
 import os
 import re
@@ -73,6 +82,7 @@ def run_example(relpath, *argv, timeout=1800, env_extra=None, done_marker=None):
     assert False, "%s failed (rc=%s):\n%s" % (relpath, rc, out[-3000:])
 
 
+@pytest.mark.slow
 def test_train_mnist():
     out = run_example("image-classification/train_mnist.py",
                       "--num-epochs", "2", "--batch-size", "64",
@@ -80,6 +90,7 @@ def test_train_mnist():
     assert "Train-accuracy" in out
 
 
+@pytest.mark.slow
 def test_train_imagenet_benchmark():
     out = run_example("image-classification/train_imagenet.py",
                       "--benchmark", "1", "--kv-store", "tpu",
@@ -90,12 +101,14 @@ def test_train_imagenet_benchmark():
     assert "Speed:" in out
 
 
+@pytest.mark.slow
 def test_gluon_mnist():
     out = run_example("gluon/mnist.py", "--epochs", "1",
                       "--batch-size", "64", done_marker="Validation-accuracy")
     assert "training acc" in out.lower() or "accuracy" in out.lower()
 
 
+@pytest.mark.slow
 def test_lstm_bucketing():
     out = run_example("rnn/lstm_bucketing.py", "--num-epochs", "1",
                       "--num-hidden", "32", "--num-embed", "32",
@@ -103,6 +116,7 @@ def test_lstm_bucketing():
     assert "Train-perplexity" in out
 
 
+@pytest.mark.slow
 def test_quantization_example():
     out = run_example("quantization/quantize_model.py",
                       "--num-epochs", "3", "--calib-mode", "naive",
@@ -110,6 +124,7 @@ def test_quantization_example():
     assert "int8 accuracy" in out
 
 
+@pytest.mark.slow
 def test_sparse_example():
     out = run_example("sparse/linear_classification.py",
                       "--num-epochs", "4",
@@ -117,6 +132,7 @@ def test_sparse_example():
     assert "final train accuracy" in out
 
 
+@pytest.mark.slow
 def test_ssd_example():
     out = run_example("ssd/train.py", "--num-iters", "120",
                       "--disp", "40", "--min-iou", "0.25",
@@ -138,18 +154,21 @@ def test_profiler_example():
     assert "trace events" in out
 
 
+@pytest.mark.slow
 def test_custom_op_example():
     out = run_example("numpy-ops/custom_softmax.py", "--num-iters", "80",
                       done_marker="final accuracy")
     assert "final accuracy" in out
 
 
+@pytest.mark.slow
 def test_svm_example():
     out = run_example("svm_mnist/svm_mnist.py", "--num-epochs", "3",
                       done_marker="validation accuracy")
     assert "validation accuracy" in out
 
 
+@pytest.mark.slow
 def test_multi_task_example():
     out = run_example("multi-task/multi_task.py", "--num-epochs", "4",
                       done_marker="parity-acc")
@@ -171,6 +190,7 @@ def test_benchmark_score():
     assert "img/s" in out
 
 
+@pytest.mark.slow
 def test_gluon_image_classification():
     out = run_example("gluon/image_classification.py",
                       "--model", "mobilenet0_25", "--batch-size", "2",
@@ -179,6 +199,7 @@ def test_gluon_image_classification():
     assert "samples/sec" in out
 
 
+@pytest.mark.slow
 def test_matrix_fact_example():
     out = run_example("recommenders/matrix_fact.py", "--users", "200",
                       "--items", "100", "--ratings", "8000",
@@ -189,6 +210,7 @@ def test_matrix_fact_example():
     assert rmse < 0.3, out[-500:]
 
 
+@pytest.mark.slow
 def test_dcgan_example():
     out = run_example("gan/dcgan.py", "--epochs", "1",
                       "--batches-per-epoch", "6", "--batch-size", "16",
@@ -196,6 +218,7 @@ def test_dcgan_example():
     assert "(4, 1, 28, 28)" in out
 
 
+@pytest.mark.slow
 def test_autoencoder_example():
     out = run_example("autoencoder/mnist_sae.py", "--pretrain-epochs", "1",
                       "--finetune-epochs", "1", "--batch-size", "128",
@@ -205,6 +228,7 @@ def test_autoencoder_example():
     assert final < 0.05, out[-500:]
 
 
+@pytest.mark.slow
 def test_fgsm_example():
     out = run_example("adversary/fgsm.py", "--epochs", "1",
                       "--batch-size", "128", done_marker="adversarial accuracy")
@@ -215,6 +239,7 @@ def test_fgsm_example():
     assert adv < clean, out[-500:]
 
 
+@pytest.mark.slow
 def test_benchmark_sweep_driver():
     out = run_example("image-classification/benchmark.py",
                       "--networks", "mlp", "--batch-sizes", "32",
@@ -223,6 +248,7 @@ def test_benchmark_sweep_driver():
     assert '"network": "mlp"' in out and "FAILED" not in out
 
 
+@pytest.mark.slow
 def test_long_context_transformer_example():
     out = run_example(
         "long-context/transformer_lm.py", "--epochs", "1",
@@ -233,6 +259,7 @@ def test_long_context_transformer_example():
     assert err < 1e-3
 
 
+@pytest.mark.slow
 def test_bi_lstm_sort_example():
     out = run_example("bi-lstm-sort/lstm_sort.py", "--num-epochs", "3",
                       "--batches-per-epoch", "40",
@@ -241,6 +268,7 @@ def test_bi_lstm_sort_example():
     assert acc > 0.8, out[-500:]
 
 
+@pytest.mark.slow
 def test_checkpoint_resume_roundtrip(tmp_path):
     """fit -> do_checkpoint -> resume with --load-epoch (reference:
     model.py save/load_checkpoint + base_module.fit(begin_epoch))."""
@@ -263,6 +291,7 @@ def test_checkpoint_resume_roundtrip(tmp_path):
     assert "Resumed" in out2 or "load" in out2.lower()
 
 
+@pytest.mark.slow
 def test_cnn_text_classification():
     out = run_example("cnn_text_classification/text_cnn.py",
                       "--num-epochs", "8",
@@ -271,6 +300,7 @@ def test_cnn_text_classification():
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
 
+@pytest.mark.slow
 def test_rcnn_lite_end2end():
     out = run_example("rcnn/train_end2end.py",
                       "--epochs", "60",
@@ -284,6 +314,7 @@ def test_rcnn_lite_end2end():
     assert miou > 0.30, miou                      # proposals find objects
 
 
+@pytest.mark.slow
 def test_toy_nce():
     out = run_example("nce-loss/toy_nce.py", "--steps", "300",
                       done_marker="toy-nce done")
@@ -291,6 +322,7 @@ def test_toy_nce():
     assert m and float(m.group(1)) > 0.8, out[-1500:]
 
 
+@pytest.mark.slow
 def test_lstm_ocr_ctc():
     out = run_example("ctc/lstm_ocr_train.py", "--steps", "80",
                       "--lr", "0.02",
@@ -302,6 +334,7 @@ def test_lstm_ocr_ctc():
     assert last < 1.0 and acc >= 0.8, (first, last, acc)
 
 
+@pytest.mark.slow
 def test_neural_style():
     out = run_example("neural-style/nstyle.py", "--iters", "90",
                       done_marker="neural-style done")
@@ -311,6 +344,7 @@ def test_neural_style():
     assert last < first * 0.2, (first, last)
 
 
+@pytest.mark.slow
 def test_vae():
     out = run_example("vae/vae.py", "--steps", "300",
                       done_marker="vae done")
@@ -318,6 +352,7 @@ def test_vae():
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
 
+@pytest.mark.slow
 def test_sgld_posterior():
     out = run_example("bayesian-methods/sgld.py", "--steps", "3000",
                       "--burn-in", "800", done_marker="sgld done")
@@ -328,6 +363,7 @@ def test_sgld_posterior():
     assert mean_err < 0.1 and 0.6 < std_ratio < 1.6, (mean_err, std_ratio)
 
 
+@pytest.mark.slow
 def test_fcn_segmentation():
     out = run_example("fcn-xs/fcn_train.py", "--epochs", "12",
                       done_marker="fcn done")
@@ -337,6 +373,7 @@ def test_fcn_segmentation():
     assert miou > 0.6 and acc > 0.9, (miou, acc)
 
 
+@pytest.mark.slow
 def test_dqn_cartpole():
     out = run_example("reinforcement-learning/dqn_cartpole.py",
                       "--episodes", "200", "--target-sync", "100",
@@ -345,6 +382,7 @@ def test_dqn_cartpole():
     assert m and float(m.group(1)) > 50.0, out[-1500:]
 
 
+@pytest.mark.slow
 def test_onnx_roundtrip_example(tmp_path):
     out = run_example("onnx/onnx_inference.py",
                       "--output", str(tmp_path / "m.onnx"),
@@ -353,6 +391,7 @@ def test_onnx_roundtrip_example(tmp_path):
     assert m and float(m.group(1)) > 0.95, out[-1500:]
 
 
+@pytest.mark.slow
 def test_stochastic_depth():
     out = run_example("stochastic-depth/sd_resnet.py", "--steps", "150",
                       done_marker="stochastic-depth done")
@@ -362,6 +401,7 @@ def test_stochastic_depth():
     assert dropped > 50 and acc > 0.9, (dropped, acc)
 
 
+@pytest.mark.slow
 def test_dsd_training():
     out = run_example("dsd/dsd_train.py", "--steps", "250",
                       done_marker="dsd done")
@@ -372,6 +412,7 @@ def test_dsd_training():
     assert sparse_ > 0.5                               # sparse net works
 
 
+@pytest.mark.slow
 def test_lstnet_forecast():
     out = run_example("multivariate_time_series/lstnet.py",
                       "--steps", "200",
@@ -380,6 +421,7 @@ def test_lstnet_forecast():
     assert m and float(m.group(1)) < 0.85, out[-1500:]  # beats persistence
 
 
+@pytest.mark.slow
 def test_deep_embedded_clustering():
     out = run_example("deep-embedded-clustering/dec.py",
                       done_marker="dec done")
@@ -394,6 +436,7 @@ def test_caffe_example():
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
 
+@pytest.mark.slow
 def test_capsnet_routing():
     out = run_example("capsnet/capsnet.py", "--steps", "80",
                       done_marker="capsnet done")
@@ -401,6 +444,7 @@ def test_capsnet_routing():
     assert m and float(m.group(1)) > 0.9, out[-1500:]
 
 
+@pytest.mark.slow
 def test_speech_keyword_spotting():
     out = run_example("speech_recognition/speech_commands.py",
                       "--steps", "60", done_marker="speech done")
@@ -414,6 +458,7 @@ def test_python_howto():
     assert "multiple_outputs: both heads returned" in out
 
 
+@pytest.mark.slow
 def test_rnn_time_major():
     out = run_example("rnn-time-major/rnn_cell_demo.py",
                       done_marker="rnn-time-major done")
@@ -421,36 +466,42 @@ def test_rnn_time_major():
     assert m and float(m.group(1)) < 1e-5, out[-1500:]
 
 
+@pytest.mark.slow
 def test_module_mnist_mlp_example():
     out = run_example("module/mnist_mlp.py", "--epochs", "3",
                       done_marker="DONE")
     assert "FINAL train accuracy" in out and "DONE" in out
 
 
+@pytest.mark.slow
 def test_module_sequential_example():
     out = run_example("module/sequential_module.py", "--epochs", "8",
                       done_marker="DONE")
     assert "FINAL train accuracy" in out and "DONE" in out
 
 
+@pytest.mark.slow
 def test_module_python_loss_example():
     out = run_example("module/python_loss.py", "--epochs", "8",
                       done_marker="DONE")
     assert "FINAL train accuracy" in out and "DONE" in out
 
 
+@pytest.mark.slow
 def test_adversarial_vae_example():
     out = run_example("mxnet_adversarial_vae/vaegan.py",
                       "--epochs", "20", done_marker="DONE")
     assert "latent linear separation" in out and "DONE" in out
 
 
+@pytest.mark.slow
 def test_chinese_text_cnn_example():
     out = run_example("cnn_chinese_text_classification/text_cnn.py",
                       "--epochs", "8", done_marker="DONE")
     assert "FINAL train accuracy" in out and "DONE" in out
 
 
+@pytest.mark.slow
 def test_captcha_example():
     out = run_example("captcha/captcha_cnn.py", "--epochs", "10",
                       done_marker="DONE")

@@ -46,7 +46,10 @@ UNARY = [
     ("negative", sym.negative(a), {"a": _n(3, 4)}),
     ("reciprocal", sym.reciprocal(a), {"a": _u(3, 4)}),
     ("softmax", sym.softmax(a), {"a": _n(3, 5)}),
-    ("log_softmax", sym.log_softmax(a), {"a": _n(3, 5)}),
+    # log(softmax) chains two transcendentals: central differences at
+    # eps=1e-3 in f32 carry ~2e-3 absolute truncation, like conv below
+    ("log_softmax", sym.log_softmax(a), {"a": _n(3, 5)}, None,
+     {"atol": 4e-3}),
     ("sum", sym.sum(a), {"a": _n(3, 4)}),
     ("mean", sym.mean(a, axis=1), {"a": _n(3, 4)}),
     ("max", sym.max(a, axis=1), {"a": _u(3, 4) + np.arange(12).reshape(3, 4)}),

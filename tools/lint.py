@@ -1,14 +1,18 @@
 #!/usr/bin/env python
 """graftlint launcher — ``tools/lint.py [paths...] [--changed [REF]]
 [--json | --sarif] [--rule R] [--stale] [--update-baseline]
-[--cache PATH | --no-cache]``.
+[--cache PATH | --no-cache] [--audit-suppressions]``.
 
 Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
 by putting the repo root on ``sys.path`` first.  The pre-push habit is
 ``tools/lint.py --changed`` — git-derived file set + the incremental
-cache, so it is near-instant.  See ``docs/faq/static_analysis.md`` for
-the rule catalog, the whole-program engine, suppression syntax, and
-the baseline workflow.
+cache, so it is near-instant.  ``--audit-suppressions`` is the one
+RUNTIME mode: it executes a built-in workload under the graftsan
+sanitizers and classifies every suppression/baseline entry as
+runtime-confirmed / never-exercised / contradicted (contradictions
+fail).  See ``docs/faq/static_analysis.md`` for the rule catalog, the
+whole-program engine, suppression syntax, the baseline workflow, and
+the sanitizer catalog.
 """
 import os
 import sys
