@@ -77,9 +77,21 @@ def bench_width(width, batch, steps, image_size, zero=0, compression=None,
 
 def _state_cols(trainer):
     """The per-row observability columns: static wire model + measured
-    optimizer-state residency."""
+    optimizer-state residency, PAIRED with graftplan's predictions
+    from the declarative plan spec (analysis/plan/) — the harness
+    itself asserts prediction == measurement, so a drift between the
+    trainer's layout rules and the static model fails the bench run,
+    not just the unit tests."""
+    from mxnet_tpu.analysis.plan import (PlanSpec, predict_comm,
+                                         predict_opt_state)
+    from mxnet_tpu.analysis.plan.configs import verify_predictions
     comm = trainer.comm_stats()
     sb = trainer.optimizer_state_bytes()
+    spec = PlanSpec.from_trainer(trainer)
+    pred_opt = predict_opt_state(spec)
+    pred_comm = predict_comm(spec)
+    problems = verify_predictions(spec, {"opt_state": sb, "comm": comm})
+    assert not problems, "graftplan prediction mismatch: %s" % problems
     return {
         "collective_bytes_per_step": comm["total_bytes"],
         "grad_reduce_bytes_per_step": comm["grad_reduce_bytes"],
@@ -87,6 +99,11 @@ def _state_cols(trainer):
                            for k, v in comm["kinds"].items() if v["ops"]},
         "opt_state_bytes_total": sb["total"],
         "opt_state_bytes_per_device": sb["per_device"],
+        "plan_predicted_collective_bytes_per_step":
+            pred_comm["total_bytes"],
+        "plan_predicted_opt_state_bytes_per_device":
+            pred_opt["per_device"],
+        "plan_prediction_match": True,
     }
 
 

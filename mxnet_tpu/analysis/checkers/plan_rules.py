@@ -1,0 +1,137 @@
+"""Plan checkers — graftplan verdicts as graftlint rules.
+
+These four rules consume :func:`mxnet_tpu.analysis.plan.analyze`
+reports (pure data) instead of source files: ``check()`` is inert in
+the file-walk pass (``suffixes = ()``), and ``check_plan(report,
+ctx)`` runs under ``tools/lint.py --plan`` (and the tier-1 gate in
+``tests/test_plan.py``) over the in-tree configuration catalog.  They
+emit the same :class:`~..core.Finding` objects — fingerprints, SARIF,
+committed baseline (``--plan --update-baseline`` is the acceptance
+path for a deliberate finding) — as every other rule; a finding
+anchors to the source file that *declares* the offending
+configuration, with the config name as the enclosing symbol so the
+line-free fingerprint is stable.
+
+| rule | catches |
+|---|---|
+| ``spmd-divisibility``  | a sharded dim that does not divide its mesh axes, a bucket that does not pad to the mesh, a batch that does not divide its sharding axes |
+| ``collective-mismatch`` | a reduce-scatter with no later all-gather (sharded update never re-broadcast), or an incompatible reshard-on-restore pair |
+| ``oom-risk``           | predicted per-chip peak bytes over the ``MXNET_PLAN_HBM_BYTES`` budget |
+| ``bucket-plan-waste``  | serving-ladder rungs with predicted fill below ``MXNET_PLAN_BUCKET_FILL_MIN``, or shadowed rungs ``pick_bucket`` can never select |
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["SpmdDivisibilityChecker", "CollectiveMismatchChecker",
+           "OomRiskChecker", "BucketPlanWasteChecker",
+           "plan_checkers", "run_plan_checkers"]
+
+
+class _PlanChecker(Checker):
+    """Base: inert in the file walk, active in the plan pass."""
+
+    suffixes = ()           # never interested in any file
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []
+
+    def _finding(self, report, message):
+        return Finding(self.rule, self.severity, report["origin"], 1,
+                       message, symbol="plan:%s" % report["name"])
+
+    def check_plan(self, report, ctx):
+        raise NotImplementedError
+
+
+@register
+class SpmdDivisibilityChecker(_PlanChecker):
+    rule = "spmd-divisibility"
+    severity = "error"
+
+    def check_plan(self, report, ctx):
+        return [self._finding(report, p["detail"])
+                for p in report.get("divisibility", ())]
+
+
+@register
+class CollectiveMismatchChecker(_PlanChecker):
+    rule = "collective-mismatch"
+    severity = "error"
+
+    def check_plan(self, report, ctx):
+        out = [self._finding(report, p["detail"])
+               for p in report.get("schedule_problems", ())]
+        restore = report.get("restore")
+        if restore and not restore.get("compatible", True):
+            for p in restore["problems"]:
+                out.append(self._finding(
+                    report, "reshard-on-restore: %s" % p["detail"]))
+        return out
+
+
+@register
+class OomRiskChecker(_PlanChecker):
+    rule = "oom-risk"
+    severity = "warning"
+
+    def check_plan(self, report, ctx):
+        mem = report.get("memory")
+        budget = report.get("hbm_budget")
+        if not mem or not budget:
+            return []
+        if mem["total"] <= budget:
+            return []
+        return [self._finding(
+            report,
+            "predicted per-chip peak %d bytes exceeds the "
+            "MXNET_PLAN_HBM_BYTES budget of %d (params=%d, "
+            "opt_state=%d, staging=%d, activations=%s) — shard more, "
+            "shrink buckets, or raise the budget"
+            % (mem["total"], budget, mem["params"], mem["opt_state"],
+               mem["staging"], mem["activations"]))]
+
+
+@register
+class BucketPlanWasteChecker(_PlanChecker):
+    rule = "bucket-plan-waste"
+    severity = "warning"
+
+    def check_plan(self, report, ctx):
+        out = []
+        ladder = report.get("ladder")
+        if ladder:
+            out.extend(self._finding(report, p["detail"])
+                       for p in ladder.get("problems", ()))
+        # the warmup manifest's recorded working sets are ladders too:
+        # a restarted replica warms exactly those buckets
+        for tag, rep in sorted((report.get("manifest_ladders")
+                                or {}).items()):
+            out.extend(self._finding(
+                report, "manifest working set %s: %s"
+                % (tag, p["detail"]))
+                for p in rep.get("problems", ()))
+        return out
+
+
+def plan_checkers():
+    """The registered checkers that implement a plan pass."""
+    from ..core import checkers
+    return [cls() for cls in checkers()
+            if issubclass(cls, _PlanChecker)]
+
+
+def run_plan_checkers(reports, ctx=None):
+    """All plan findings over ``reports``, sorted and fingerprint-
+    deduplicated the same way ``core.run`` does for file findings."""
+    findings = []
+    for checker in plan_checkers():
+        for report in reports:
+            findings.extend(checker.check_plan(report, ctx))
+    findings.sort(key=Finding.sort_key)
+    counts = {}
+    for f in findings:
+        key = (f.rule, f.path, f.symbol, f.message)
+        f._dup = counts.get(key, 0)
+        counts[key] = f._dup + 1
+    return findings

@@ -45,7 +45,18 @@ def _changed_paths(root, ref):
     else:
         names = set(git("diff", "--name-only", ref, "--"))
     picked = []
+    analysis_dir = os.path.join(root, "mxnet_tpu", "analysis")
     for rel in sorted(names):
+        rel_n = rel.replace(os.sep, "/")
+        # analysis fixtures (plan-spec corpora, checker inputs) under
+        # tests/fixtures/ feed the checker tests' lint paths: a
+        # fixture-only edit re-lints the analysis package instead of
+        # being silently dropped as "no changed lintable files"
+        if rel_n.startswith("tests/fixtures/"):
+            if os.path.isdir(analysis_dir) \
+                    and analysis_dir not in picked:
+                picked.append(analysis_dir)
+            continue
         if not (rel.endswith(".py")
                 or os.path.basename(rel) in C_API_BASENAMES):
             continue
@@ -53,12 +64,114 @@ def _changed_paths(root, ref):
         # suppression scanner, which reads raw text) are calibrated
         # for mxnet_tpu sources, not for test files full of fixture
         # snippets embedded in strings
-        if not rel.replace(os.sep, "/").startswith("mxnet_tpu/"):
+        if not rel_n.startswith("mxnet_tpu/"):
             continue
         full = os.path.join(root, rel)
         if os.path.exists(full):        # deletions need no lint
             picked.append(full)
     return picked
+
+
+def _plan(args):
+    """``--plan``: run graftplan over the in-tree configuration
+    catalog (analysis/plan/configs.py) — like ``--audit-suppressions``
+    this imports and instantiates the package (jax required; trainers
+    are built, never stepped — nothing XLA-compiles), then gates the
+    plan findings through the same baseline as the static rules and
+    verifies the closed loop: predicted optimizer-state and collective
+    bytes must equal the live objects' measurements exactly."""
+    import json
+
+    from .checkers.plan_rules import run_plan_checkers
+
+    def _load_plan():
+        from mxnet_tpu import config as _config
+        from .plan.configs import catalog_reports
+        budget = int(_config.get("MXNET_PLAN_HBM_BYTES") or 0) or None
+        fill_min = float(_config.get("MXNET_PLAN_BUCKET_FILL_MIN"))
+        reports, verify_problems = catalog_reports(fill_min=fill_min)
+        for r in reports:
+            if r.get("hbm_budget") is None:
+                r["hbm_budget"] = budget
+        return reports, verify_problems
+
+    from .core import rule_ids as _rule_ids
+    plan_rules = {"spmd-divisibility", "collective-mismatch",
+                  "oom-risk", "bucket-plan-waste"}
+    if args.rules:
+        unknown = set(args.rules) - set(_rule_ids())
+        if unknown:
+            print("graftlint: unknown rule ids: %s" % sorted(unknown),
+                  file=sys.stderr)
+            return 2
+    reports, verify_problems = _load_plan()
+    findings = run_plan_checkers(reports)
+    if args.rules:
+        findings = [f for f in findings if f.rule in set(args.rules)]
+    baseline_path = args.baseline or baseline_mod.default_path(repo_root())
+    if args.update_baseline:
+        # same restricted-merge semantics as the static path: a --plan
+        # update re-derives only the plan rules' findings (narrowed
+        # further by --rule), so every other entry — and any plan entry
+        # outside the --rule scope — is preserved, with audit
+        # annotations carried over for unchanged fingerprints
+        scope = set(args.rules) & plan_rules if args.rules else plan_rules
+        entries = {f.fingerprint: f.to_dict() for f in findings}
+        kept = 0
+        for fp, e in baseline_mod.load(baseline_path).items():
+            if fp in entries:
+                if "audit" in e:
+                    entries[fp]["audit"] = e["audit"]
+                continue
+            if e.get("rule") not in scope:
+                entries[fp] = e
+                kept += 1
+        baseline_mod.save_entries(list(entries.values()), baseline_path)
+        print("graftlint: wrote %d finding%s to %s"
+              % (len(entries), "s" if len(entries) != 1 else "",
+                 baseline_path)
+              + (" (%d out-of-scope entr%s preserved)"
+                 % (kept, "ies" if kept != 1 else "y") if kept else ""))
+        return 0
+    known = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old = baseline_mod.filter_new(findings, known)
+    if args.sarif:
+        doc = json.loads(sarif_report(new, old))
+        doc["runs"][0]["properties"] = {
+            "graftplan": {"configs": [r["name"] for r in reports],
+                          "verify_problems": verify_problems}}
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        doc = json.loads(json_report(new, old))
+        doc["plan"] = {"reports": reports,
+                       "verify_problems": verify_problems}
+        print(json.dumps(doc, indent=1))
+    else:
+        for r in reports:
+            mem = r.get("memory")
+            comm = r.get("comm")
+            bits = []
+            if mem:
+                bits.append("per-chip %d B (params %d, opt %d, "
+                            "staging %d, act %s)"
+                            % (mem["total"], mem["params"],
+                               mem["opt_state"], mem["staging"],
+                               mem["activations"]))
+            if comm:
+                bits.append("%d wire B/step" % comm["total_bytes"])
+            if r.get("ladder"):
+                fills = [x["fill"] for x in r["ladder"]["rungs"]]
+                bits.append("ladder fill %s" % fills)
+            print("plan %-32s %s" % (r["name"], "; ".join(bits)))
+        for p in verify_problems:
+            print("PREDICTION MISMATCH: %s" % p)
+        print(human_report(new, old, show_baselined=args.show_baselined))
+        agreed = len(reports) - len(verify_problems)
+        print("graftplan: %d configuration%s analyzed, predictions "
+              "match measurements on %d"
+              % (len(reports), "s" if len(reports) != 1 else "",
+                 agreed))
+    return 1 if (new or verify_problems) else 0
 
 
 def _audit_suppressions(args):
@@ -98,9 +211,11 @@ def _audit_suppressions(args):
         s = rep["summary"]
         print("graftsan audit: %d suppressions + %d baseline entries — "
               "%d runtime-confirmed, %d never-exercised, "
-              "%d contradicted; %d unclaimed runtime finding%s"
+              "%d justified-unreachable, %d contradicted; "
+              "%d unclaimed runtime finding%s"
               % (s["suppressions"], s["baseline_entries"],
                  s["runtime_confirmed"], s["never_exercised"],
+                 s.get("justified_unreachable", 0),
                  s["contradicted"], s["unclaimed_findings"],
                  "s" if s["unclaimed_findings"] != 1 else ""))
     return 0 if rep["ok"] else 1
@@ -138,6 +253,15 @@ def main(argv=None):
         help="list stale suppression comments as a removal worklist "
              "and exit (1 when any exist)")
     parser.add_argument(
+        "--plan", action="store_true",
+        help="run graftplan (static shape/sharding/memory analysis) "
+             "over the in-tree configuration catalog and gate the "
+             "spmd-divisibility / collective-mismatch / oom-risk / "
+             "bucket-plan-waste findings; also verifies predicted "
+             "optimizer-state and collective bytes against the live "
+             "measurements.  NOTE: imports and instantiates the "
+             "package (jax required), but nothing XLA-compiles")
+    parser.add_argument(
         "--audit-suppressions", action="store_true",
         help="run the graftsan workload (runtime sanitizers + line "
              "probe) and classify every inline suppression and "
@@ -173,6 +297,9 @@ def main(argv=None):
 
     if args.audit_suppressions:
         return _audit_suppressions(args)
+
+    if args.plan:
+        return _plan(args)
 
     root = repo_root()
     if args.changed is not None:

@@ -1,0 +1,56 @@
+"""graftplan — static shape/sharding/memory analysis of the tensor
+program (the third leg of the analysis stack).
+
+graftlint (PRs 4/8) analyzes the Python *source* and graftsan (PR 9)
+checks *runtime* behavior; graftplan symbolically evaluates a **bound
+program** — (symbol, input shapes, dtypes, mesh, sharding specs, ZeRO
+stage, compression codec, bucket plan) — WITHOUT invoking XLA.  This is
+the reference MXNet memory planner (``infer_shape`` + plan-memory
+passes, PAPER.md §graph-IR) and the TensorFlow paper's pre-execution
+placement/memory planning rebuilt for the SPMD stack: sharding
+mistakes, non-divisible shards, orphaned reduce-scatters, and per-chip
+OOM become *static* verdicts instead of XLA compile-time (or OOM-time)
+surprises.
+
+Layers (each pure data in, pure data out):
+
+- :mod:`.spec`      — :class:`PlanSpec`: the declarative bound-program
+  description (captured from a live ``ParallelTrainer`` /
+  ``ModelServer`` / ``Executor``, or hand-written in tests);
+- :mod:`.shapes`    — stdlib abstract interpreter over the symbol-JSON
+  graph (independent of ``Symbol.infer_shape``; the two are
+  cross-checked over the test corpus);
+- :mod:`.memory`    — per-chip peak-memory model: params + ZeRO-sharded
+  optimizer slots (EXACT vs ``optimizer_state_bytes()``) + activation
+  liveness over a topo order + collective staging buffers;
+- :mod:`.schedule`  — the static collective schedule (kind, axes,
+  bytes per step; EXACT vs ``mxnet_collective_bytes_total``);
+- :mod:`.contracts` — sharding-contract verdicts: divisibility,
+  reduce-scatter/all-gather matching, checkpoint reshard-on-restore
+  compatibility;
+- :mod:`.interpreter` — :func:`analyze` folding the above into one
+  :class:`PlanReport` dict the plan checkers consume;
+- :mod:`.configs`   — the in-tree configuration catalog behind
+  ``tools/lint.py --plan`` and the tier-1 gate.
+
+The four graftlint-native rules built on top (``spmd-divisibility``,
+``collective-mismatch``, ``oom-risk``, ``bucket-plan-waste``) live in
+``analysis/checkers/plan_rules.py`` — same ``Finding`` objects,
+fingerprints, SARIF output, and baseline gate as the rest of the
+suite.  See ``docs/faq/static_analysis.md`` §"Program-plan analysis".
+"""
+from __future__ import annotations
+
+from .spec import MeshSpec, PlanSpec
+from .shapes import UnsupportedOp, infer_symbol_shapes
+from .memory import activation_liveness, predict_memory, predict_opt_state
+from .schedule import build_schedule, predict_comm
+from .contracts import (check_divisibility, check_schedule,
+                        ladder_report, reshard_compat)
+from .interpreter import PlanError, analyze
+
+__all__ = ["MeshSpec", "PlanSpec", "PlanError", "UnsupportedOp",
+           "analyze", "infer_symbol_shapes", "activation_liveness",
+           "predict_memory", "predict_opt_state", "predict_comm",
+           "build_schedule", "check_divisibility", "check_schedule",
+           "ladder_report", "reshard_compat"]

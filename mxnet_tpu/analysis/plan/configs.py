@@ -1,0 +1,155 @@
+"""The in-tree configuration catalog — what ``tools/lint.py --plan``
+and the tier-1 gate actually analyze.
+
+One entry per configuration the tree ships and the ROADMAP makes
+claims about: the ParallelTrainer at every ZeRO stage on the 8-device
+mesh, the MULTICHIP dryrun's zero2+bf16 leg, the serving warmup
+ladder, and a bound symbol program (activation liveness).  Each entry
+carries the *measured* counterpart where one exists — the catalog is
+where prediction meets reality: ``verify_predictions`` asserts
+graftplan's optimizer-state bytes equal ``optimizer_state_bytes()``
+and its wire bytes equal ``comm_stats()`` (the numbers behind
+``mxnet_collective_bytes_total``), byte for byte.
+
+This module is the ONE place in the plan package that instantiates
+live objects (and therefore needs jax + >= 8 visible devices for the
+full catalog); everything it returns is pure data.  No step runs and
+nothing jit-compiles — trainers are built, never stepped.
+"""
+from __future__ import annotations
+
+from .interpreter import analyze
+from .spec import PlanSpec
+
+__all__ = ["in_tree_configs", "verify_predictions", "catalog_reports"]
+
+# the dryrun/scaling-net shape, small enough to build 4 trainers on a
+# virtual mesh in well under a second of device work
+_WIDTH = 8
+
+
+def _make_net():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool2D(), nn.Flatten(),
+            nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Zero())
+    r = np.random.RandomState(42)
+    for _, p in sorted(net.collect_params().items()):
+        p.set_data(nd.array((r.randn(*p.shape) * 0.2)
+                            .astype(np.float32)))
+    return net
+
+
+def _trainer_config(name, width, zero, compression=None,
+                    bucket_bytes=4096, optimizer="sgd"):
+    import jax
+    from mxnet_tpu import gluon, parallel
+    devices = jax.devices()[:width]
+    mesh = parallel.make_mesh(dp=width, devices=devices)
+    opt_params = ({"learning_rate": 0.1, "momentum": 0.9}
+                  if optimizer == "sgd" else {"learning_rate": 1e-3})
+    trainer = parallel.ParallelTrainer(
+        _make_net(), gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        opt_params, mesh=mesh, zero=zero, compression=compression,
+        bucket_bytes=bucket_bytes)
+    spec = PlanSpec.from_trainer(trainer, name=name)
+    measured = {"opt_state": trainer.optimizer_state_bytes(),
+                "comm": trainer.comm_stats()}
+    return spec, measured
+
+
+def _program_config(name):
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                          name="c1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                      pool_type="max", name="p1")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    exe = net.simple_bind(data=(8, 3, 16, 16))
+    return PlanSpec.from_executor(exe, name=name), None
+
+
+def _serving_config(name):
+    from mxnet_tpu import config as _config
+    from mxnet_tpu.serving.bucketing import shape_buckets
+    ladder = shape_buckets(_config.get("MXNET_SERVING_MAX_BATCH"))
+    spec = PlanSpec.from_ladder(ladder, name=name)
+    # when this host carries a warmup manifest, judge its recorded
+    # working sets too — those are the ladders a restarted replica
+    # actually warms
+    manifest_path = _config.get("MXNET_COMPILE_CACHE_MANIFEST")
+    if manifest_path:
+        from mxnet_tpu.serving.manifest import WarmupManifest
+        spec.manifest_ladders = {
+            str(k): list(v)
+            for k, v in WarmupManifest(manifest_path).ladders().items()}
+    return spec, None
+
+
+def in_tree_configs(width=None):
+    """``[(spec, measured_or_None), ...]`` for every in-tree
+    configuration.  ``width`` caps the mesh (default: 8, shrunk to the
+    available device count so the CLI still runs on odd hosts; the
+    tier-1 gate pins the full 8)."""
+    import jax
+    n = len(jax.devices())
+    width = min(width or _WIDTH, n)
+    out = [
+        _trainer_config("trainer/zero0-dp%d" % width, width, zero=0),
+        _trainer_config("trainer/zero1-dp%d" % width, width, zero=1),
+        _trainer_config("trainer/zero2-dp%d" % width, width, zero=2),
+        # the MULTICHIP dryrun leg (__graft_entry__): zero2 + bf16
+        # compressed buckets at 2 KiB
+        _trainer_config("trainer/multichip-zero2-bf16-dp%d" % width,
+                        width, zero=2, compression="bf16",
+                        bucket_bytes=2048),
+        _serving_config("serving/warmup-ladder"),
+        _program_config("program/convnet"),
+    ]
+    return out
+
+
+def verify_predictions(spec, measured):
+    """The closed loop against reality: graftplan's static numbers vs
+    the live object's measurements.  Returns a list of mismatch
+    strings (empty = model exact)."""
+    from .memory import predict_opt_state
+    from .schedule import predict_comm
+    problems = []
+    if not measured:
+        return problems
+    pred_opt = predict_opt_state(spec)
+    if pred_opt != measured["opt_state"]:
+        problems.append(
+            "%s: predicted optimizer-state bytes %r != measured %r"
+            % (spec.name, pred_opt, measured["opt_state"]))
+    pred_comm = predict_comm(spec)
+    meas_comm = measured["comm"]
+    for key in ("kinds", "grad_reduce_bytes", "total_bytes"):
+        if pred_comm[key] != meas_comm[key]:
+            problems.append(
+                "%s: predicted comm %s %r != measured %r"
+                % (spec.name, key, pred_comm[key], meas_comm[key]))
+    return problems
+
+
+def catalog_reports(width=None, fill_min=None):
+    """Analyze the whole catalog: ``(reports, verify_problems)``."""
+    reports, problems = [], []
+    for spec, measured in in_tree_configs(width=width):
+        reports.append(analyze(spec, fill_min=fill_min))
+        problems.extend(verify_predictions(spec, measured))
+    return reports, problems

@@ -1,0 +1,66 @@
+"""analyze(spec) — fold shapes/memory/schedule/contracts into one
+PlanReport dict.
+
+The report is the checkers' input and ``tools/lint.py --plan``'s
+output: pure data, json-serializable, carrying the spec identity
+(name/kind/origin) so findings anchor to the source that declared the
+configuration.  A spec may also name a restore source
+(``analyze(spec, restore_from=other_spec)``) to fold the
+reshard-on-restore verdict in.
+"""
+from __future__ import annotations
+
+from .contracts import (check_divisibility, check_schedule,
+                        ladder_report, reshard_compat)
+from .memory import predict_memory, predict_opt_state
+from .schedule import build_schedule, predict_comm
+
+__all__ = ["PlanError", "analyze"]
+
+
+class PlanError(Exception):
+    """The spec itself is malformed (not a finding — a usage error)."""
+
+
+def analyze(spec, restore_from=None, fill_min=None):
+    """Symbolically evaluate ``spec`` and return the PlanReport dict:
+
+    - ``divisibility``   — contract problems (spmd-divisibility);
+    - ``schedule`` / ``schedule_problems`` — the static collective
+      schedule and its matching verdict (collective-mismatch);
+    - ``comm``           — predicted per-step wire bytes by kind (the
+      ``mxnet_collective_bytes_total`` twin);
+    - ``memory``         — per-chip byte breakdown, ``opt_state``
+      exact vs ``optimizer_state_bytes()`` (oom-risk reads ``total``);
+    - ``ladder``         — serving-ladder fill/shadowing economics
+      (bucket-plan-waste);
+    - ``restore``        — reshard-on-restore verdict when
+      ``restore_from`` is given.
+    """
+    if spec.kind not in ("trainer", "serving", "program"):
+        raise PlanError("unknown plan kind %r" % (spec.kind,))
+    report = {"name": spec.name, "kind": spec.kind,
+              "origin": spec.origin, "zero": spec.zero,
+              "codec": (spec.codec or {}).get("name"),
+              "mesh": spec.mesh.to_dict() if spec.mesh else None,
+              "hbm_budget": spec.hbm_budget,
+              "divisibility": [], "schedule": [],
+              "schedule_problems": [], "comm": None, "memory": None,
+              "ladder": None, "manifest_ladders": None, "restore": None}
+    if spec.kind in ("trainer", "program"):
+        report["divisibility"] = check_divisibility(spec)
+        report["memory"] = predict_memory(spec)
+    if spec.kind == "trainer":
+        report["schedule"] = build_schedule(spec)
+        report["schedule_problems"] = check_schedule(report["schedule"])
+        report["comm"] = predict_comm(spec)
+    kw = {} if fill_min is None else {"fill_min": fill_min}
+    if spec.ladder is not None:
+        report["ladder"] = ladder_report(spec.ladder, **kw)
+    if spec.manifest_ladders:
+        report["manifest_ladders"] = {
+            tag: ladder_report(ladder, **kw)
+            for tag, ladder in sorted(spec.manifest_ladders.items())}
+    if restore_from is not None:
+        report["restore"] = reshard_compat(restore_from, spec)
+    return report

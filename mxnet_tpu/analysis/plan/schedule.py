@@ -1,0 +1,154 @@
+"""Static collective schedule + the exact wire-byte mirror.
+
+Two views of the same plan:
+
+- :func:`build_schedule` — the ordered list of collectives one step
+  issues (``{"phase", "kind", "axes", "bytes", "bucket"}``), which the
+  ``collective-mismatch`` checker pattern-matches (every
+  reduce-scatter must be closed by a later all-gather of the same
+  bucket — an orphan means the sharded update never re-broadcasts the
+  params);
+- :func:`predict_comm` — an independent reimplementation of the ring
+  wire model behind ``ParallelTrainer.comm_stats()`` /
+  ``mxnet_collective_bytes_total``, mirrored field-for-field so
+  ``tests/test_plan.py`` can assert the prediction equals the live
+  counter delta of a real dryrun step EXACTLY (integer-for-integer,
+  including the ``(n-1)//n`` floor and the 2bit ``ceil(n/16)`` word
+  packing).
+
+Both are pure functions of a :class:`~.spec.PlanSpec` — no jax.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["codec_wire_bytes", "ring_all_reduce_bytes",
+           "ring_shard_bytes", "build_schedule", "predict_comm"]
+
+
+def codec_wire_bytes(codec, n_elems):
+    """On-wire payload bytes of ``n_elems`` gradients under ``codec``
+    (``{"name": ...}`` or None) — mirrors each codec's
+    ``wire_bytes``."""
+    n = int(n_elems)
+    if codec is None:
+        return 4 * n
+    name = codec.get("name") if isinstance(codec, dict) else codec
+    if name == "2bit":
+        return 4 * ((n + 15) // 16)
+    if name in ("bf16", "bfloat16", "fp16"):
+        return 2 * n
+    if name == "fp8":
+        return n
+    raise ValueError("unknown codec %r in plan spec" % (name,))
+
+
+def ring_all_reduce_bytes(nbytes, n):
+    if n <= 1:
+        return 0
+    return 2 * int(nbytes) * (n - 1) // n
+
+
+def ring_shard_bytes(nbytes, n):
+    if n <= 1:
+        return 0
+    return int(nbytes) * (n - 1) // n
+
+
+def _prod(shape):
+    return int(math.prod(shape)) if shape else 1
+
+
+def _sharded_pairs(spec):
+    """``(local_bytes, replication_factor)`` of each trainable
+    mesh-sharded (per-param path) parameter — the dp-replicated
+    reduction of its gradient."""
+    mesh = spec.mesh
+    n = mesh.size if mesh is not None else 1
+    fused = {nm for b in spec.buckets for nm in b["names"]}
+    pairs = []
+    for p in spec.params:
+        if not p.get("trainable", True) or p["name"] in fused:
+            continue
+        nb = _prod(p["shape"]) * int(p.get("dtype_size", 4))
+        f = 1
+        for entry in p.get("spec") or ():
+            f *= mesh.factor(entry) if mesh is not None else 1
+        pairs.append((nb // f, n // f))
+    return pairs
+
+
+def build_schedule(spec):
+    """The ordered per-step collective schedule of one trainer config.
+
+    Grad-reduction entries fire in bucket order inside the backward
+    stream (the overlap design); the parameter re-broadcast
+    (``all_gather``) runs in the update phase.  ``spec.param_gather``
+    False models the classic misconfiguration — a sharded update whose
+    new params are never re-gathered — which the collective-mismatch
+    checker must catch."""
+    mesh = spec.mesh
+    n = mesh.size if mesh is not None else 1
+    axes = list(mesh.names) if mesh is not None else []
+    sched = []
+    for b in spec.buckets:
+        wire = codec_wire_bytes(spec.codec, int(b["padded_n"]))
+        if spec.zero >= 2:
+            sched.append({"phase": "backward", "kind": "reduce_scatter",
+                          "axes": axes, "bucket": int(b["index"]),
+                          "bytes": ring_shard_bytes(wire, n)})
+        else:
+            sched.append({"phase": "backward", "kind": "all_reduce",
+                          "axes": axes, "bucket": int(b["index"]),
+                          "bytes": ring_all_reduce_bytes(wire, n)})
+    for local, repl in _sharded_pairs(spec):
+        if repl > 1:
+            sched.append({"phase": "backward", "kind": "all_reduce",
+                          "axes": ["dp"], "bucket": None,
+                          "bytes": ring_all_reduce_bytes(local, repl)})
+    if spec.zero >= 1 and spec.buckets and spec.param_gather:
+        for b in spec.buckets:
+            sched.append({"phase": "update", "kind": "all_gather",
+                          "axes": axes, "bucket": int(b["index"]),
+                          "bytes": ring_shard_bytes(
+                              4 * int(b["padded_n"]), n)})
+    return sched
+
+
+def predict_comm(spec):
+    """Field-for-field mirror of ``parallel.collectives.comm_stats``
+    for this spec — what ``mxnet_collective_{ops,bytes}_total`` advance
+    by on every step of this configuration."""
+    mesh = spec.mesh
+    n = max(mesh.size if mesh is not None else 1, 1)
+    kinds = {"all_reduce": {"ops": 0, "bytes": 0},
+             "reduce_scatter": {"ops": 0, "bytes": 0},
+             "all_gather": {"ops": 0, "bytes": 0}}
+    grad_reduce = 0
+    param_bytes = sum(4 * int(b["padded_n"]) for b in spec.buckets)
+    for b in spec.buckets:
+        wire = codec_wire_bytes(spec.codec, int(b["padded_n"]))
+        if spec.zero >= 2:
+            cost = ring_shard_bytes(wire, n)
+            kinds["reduce_scatter"]["ops"] += 1
+            kinds["reduce_scatter"]["bytes"] += cost
+        else:
+            cost = ring_all_reduce_bytes(wire, n)
+            kinds["all_reduce"]["ops"] += 1
+            kinds["all_reduce"]["bytes"] += cost
+        grad_reduce += cost
+    if spec.zero >= 1 and spec.buckets:
+        kinds["all_gather"]["ops"] += len(spec.buckets)
+        kinds["all_gather"]["bytes"] += ring_shard_bytes(param_bytes, n)
+    for local, repl in _sharded_pairs(spec):
+        if repl > 1:
+            kinds["all_reduce"]["ops"] += 1
+            cost = ring_all_reduce_bytes(local, repl)
+            kinds["all_reduce"]["bytes"] += cost
+            grad_reduce += cost
+    total = sum(k["bytes"] for k in kinds.values())
+    codec = spec.codec.get("name") if spec.codec else None
+    return {"kinds": kinds, "grad_reduce_bytes": int(grad_reduce),
+            "total_bytes": int(total), "mesh_size": n,
+            "zero": int(spec.zero), "codec": codec,
+            "buckets": len(spec.buckets)}

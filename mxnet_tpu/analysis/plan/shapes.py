@@ -1,0 +1,493 @@
+"""Stdlib abstract shape interpreter over the symbol-JSON graph.
+
+This is graftplan's own reimplementation of shape inference — a
+per-op rule table over the nnvm-schema JSON (``Symbol.tojson()``),
+pure ``math`` over tuples, no jax, no tracing.  It deliberately does
+NOT call ``Symbol.infer_shape`` (which abstract-evaluates the real op
+functions via ``jax.eval_shape``): the two engines derive every
+formula independently, and ``tests/test_plan.py`` cross-checks them
+over the ``test_infer_shape.py`` / ``test_golden_files.py`` symbol
+corpus — every graph both can handle must agree on every output
+shape.  That agreement is what lets the memory model downstream
+(:mod:`.memory`) trust these shapes without ever binding the program.
+
+Coverage is the op set the in-tree configurations and the corpus use;
+an op without a rule raises :class:`UnsupportedOp` and the caller
+skips the graph (under-approximate, never wrong).  Bidirectional
+weight inference (the reference's ``FInferShape``) is reproduced by
+``_PARAM_RULES``: when an op's variable input has no shape yet, the
+rule derives it from the data shape + attrs — independently of
+``symbol.py``'s ``_PARAM_SHAPE_HOOKS``.
+"""
+from __future__ import annotations
+
+import ast
+import math
+
+__all__ = ["UnsupportedOp", "ShapeError", "infer_symbol_shapes"]
+
+
+class UnsupportedOp(Exception):
+    """The interpreter has no rule for this op — skip the graph."""
+
+
+class ShapeError(Exception):
+    """The graph is shape-inconsistent (a real finding, not a gap)."""
+
+
+def _coerce(v):
+    """Symbol JSON stringifies every attr; bring back python values."""
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def _attrs(node):
+    return {k: _coerce(v) for k, v in (node.get("attrs") or {}).items()}
+
+
+def _tup(v):
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return (int(v),)
+    return tuple(int(x) for x in v)
+
+
+def _prod(shape):
+    return int(math.prod(shape)) if shape else 1
+
+
+# -- per-op output rules -----------------------------------------------------
+# rule(attrs, in_shapes) -> list of output shapes (in_shapes may contain
+# None only where a _PARAM_RULES hook will have filled variables first)
+
+def _conv_out(a, ins):
+    d = ins[0]
+    k = _tup(a["kernel"])
+    nd = len(k)
+    stride = _tup(a.get("stride")) or (1,) * nd
+    pad = _tup(a.get("pad")) or (0,) * nd
+    dilate = _tup(a.get("dilate")) or (1,) * nd
+    nf = int(a["num_filter"])
+    spatial = []
+    for i in range(nd):
+        eff = dilate[i] * (k[i] - 1) + 1
+        spatial.append((d[2 + i] + 2 * pad[i] - eff) // stride[i] + 1)
+    return [(d[0], nf) + tuple(spatial)]
+
+
+def _pool_out(a, ins):
+    d = ins[0]
+    if a.get("global_pool", False):
+        return [d[:2] + (1,) * (len(d) - 2)]
+    k = _tup(a["kernel"])
+    nd = len(k)
+    stride = _tup(a.get("stride")) or (1,) * nd
+    pad = _tup(a.get("pad")) or (0,) * nd
+    full = a.get("pooling_convention", "valid") == "full"
+    spatial = []
+    for i in range(nd):
+        span = d[2 + i] + 2 * pad[i] - k[i]
+        n = (math.ceil(span / stride[i]) if full
+             else span // stride[i]) + 1
+        spatial.append(int(n))
+    return [d[:2] + tuple(spatial)]
+
+
+def _fc_out(a, ins):
+    d = ins[0]
+    nh = int(a["num_hidden"])
+    if a.get("flatten", True):
+        return [(d[0], nh)]
+    return [tuple(d[:-1]) + (nh,)]
+
+
+def _reshape_out(a, ins):
+    d = ins[0]
+    target = _tup(a.get("shape"))
+    if target is None:
+        raise UnsupportedOp("Reshape without shape attr")
+    out, src = [], list(d)
+    i = 0
+    infer_at = None
+    for t in target:
+        if t == 0:            # copy this dim
+            out.append(src[i])
+            i += 1
+        elif t == -1:         # infer
+            infer_at = len(out)
+            out.append(-1)
+            i += 1            # consumes at least a position marker
+        elif t == -2:         # copy ALL remaining dims
+            out.extend(src[i:])
+            i = len(src)
+        elif t == -3:         # merge two consecutive dims
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif t > 0:
+            out.append(int(t))
+        else:
+            raise UnsupportedOp("Reshape special value %d" % t)
+    if infer_at is not None:
+        known = _prod([x for x in out if x != -1])
+        total = _prod(d)
+        if known == 0 or total % known:
+            raise ShapeError("Reshape cannot infer -1 from %s -> %s"
+                             % (d, target))
+        out[infer_at] = total // known
+    if _prod(out) != _prod(d):
+        raise ShapeError("Reshape %s -> %s changes element count"
+                         % (d, tuple(out)))
+    return [tuple(out)]
+
+
+def _broadcast(a, b):
+    """numpy broadcasting of two shapes."""
+    out = []
+    for x, y in zip(((1,) * (len(b) - len(a)) + tuple(a)),
+                    ((1,) * (len(a) - len(b)) + tuple(b))):
+        if x == y or y == 1:
+            out.append(x)
+        elif x == 1:
+            out.append(y)
+        else:
+            raise ShapeError("cannot broadcast %s with %s" % (a, b))
+    return tuple(out)
+
+
+def _elemwise_out(a, ins):
+    s = ins[0]
+    for o in ins[1:]:
+        if tuple(o) != tuple(s):
+            raise ShapeError("elemwise operands %s vs %s" % (s, o))
+    return [tuple(s)]
+
+
+def _broadcast_out(a, ins):
+    s = tuple(ins[0])
+    for o in ins[1:]:
+        s = _broadcast(s, o)
+    return [s]
+
+
+def _reduce_out(a, ins):
+    d = ins[0]
+    axis = a.get("axis")
+    keep = bool(a.get("keepdims", False))
+    if axis is None:
+        return [(1,) * len(d) if keep else ()]
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    axes = {ax % len(d) for ax in axes}
+    out = [(1 if i in axes else s) if keep or i not in axes else None
+           for i, s in enumerate(d)]
+    return [tuple(s for s in out if s is not None)]
+
+
+def _transpose_out(a, ins):
+    d = ins[0]
+    axes = _tup(a.get("axes"))
+    if not axes:
+        axes = tuple(reversed(range(len(d))))
+    return [tuple(d[ax] for ax in axes)]
+
+
+def _concat_out(a, ins):
+    dim = int(a.get("dim", 1))
+    base = list(ins[0])
+    dim %= len(base)
+    base[dim] = sum(s[dim] for s in ins)
+    return [tuple(base)]
+
+
+def _slice_axis_out(a, ins):
+    d = list(ins[0])
+    axis = int(a["axis"]) % len(d)
+    begin = int(a.get("begin", 0) or 0)
+    end = a.get("end")
+    end = d[axis] if end is None else int(end)
+    if begin < 0:
+        begin += d[axis]
+    if end < 0:
+        end += d[axis]
+    d[axis] = max(0, end - begin)
+    return [tuple(d)]
+
+
+def _slice_channel_out(a, ins):
+    d = list(ins[0])
+    n = int(a.get("num_outputs", 1))
+    axis = int(a.get("axis", 1)) % len(d)
+    if d[axis] % n:
+        raise ShapeError("SliceChannel axis %d (%d) not divisible by %d"
+                         % (axis, d[axis], n))
+    d[axis] //= n
+    if a.get("squeeze_axis", False) and d[axis] == 1:
+        d.pop(axis)
+    return [tuple(d)] * n
+
+
+def _expand_dims_out(a, ins):
+    d = list(ins[0])
+    axis = int(a["axis"])
+    if axis < 0:
+        axis += len(d) + 1
+    d.insert(axis, 1)
+    return [tuple(d)]
+
+
+def _squeeze_out(a, ins):
+    d = list(ins[0])
+    axis = a.get("axis")
+    if axis is None:
+        return [tuple(s for s in d if s != 1)]
+    axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+    axes = {ax % len(d) for ax in axes}
+    return [tuple(s for i, s in enumerate(d)
+                  if i not in axes or s != 1)]
+
+
+def _flatten_out(a, ins):
+    d = ins[0]
+    return [(d[0], _prod(d[1:]))]
+
+
+def _embedding_out(a, ins):
+    return [tuple(ins[0]) + (int(a["output_dim"]),)]
+
+
+def _rnn_state_zeros_out(a, ins):
+    ref = ins[0]
+    b = ref[int(a.get("ref_batch_axis", 0))]
+    return [tuple(b if s == 0 else int(s) for s in _tup(a["shape"]))]
+
+
+def _dot_out(a, ins):
+    x, y = ins
+    ta, tb = a.get("transpose_a", False), a.get("transpose_b", False)
+    x = tuple(reversed(x)) if ta else tuple(x)
+    y = tuple(reversed(y)) if tb else tuple(y)
+    if len(x) != 2 or len(y) != 2 or x[1] != y[0]:
+        raise ShapeError("dot %s x %s" % (x, y))
+    return [(x[0], y[1])]
+
+
+def _identity_out(a, ins):
+    return [tuple(ins[0])]
+
+
+def _batchnorm_out(a, ins):
+    return [tuple(ins[0])]
+
+
+_IDENTITY_OPS = (
+    "Activation", "relu", "sigmoid", "tanh", "softrelu", "softsign",
+    "exp", "log", "sqrt", "square", "abs", "negative", "clip",
+    "Dropout", "Cast", "cast", "LeakyReLU", "SoftmaxActivation",
+    "softmax", "log_softmax", "SoftmaxOutput", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput", "BlockGrad",
+    "identity", "_copy", "zeros_like", "ones_like", "L2Normalization",
+    "InstanceNorm", "LayerNorm", "BatchNorm", "BatchNorm_v1", "LRN",
+)
+
+_SCALAR_OPS = (
+    "_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+    "_div_scalar", "_rdiv_scalar", "_power_scalar", "_equal_scalar",
+    "_not_equal_scalar", "_greater_scalar", "_greater_equal_scalar",
+    "_lesser_scalar", "_lesser_equal_scalar", "_maximum_scalar",
+    "_minimum_scalar",
+)
+
+_ELEMWISE_OPS = (
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_power", "_equal", "_not_equal", "_greater", "_greater_equal",
+    "_lesser", "_lesser_equal", "_maximum", "_minimum",
+)
+
+_BROADCAST_OPS = (
+    "broadcast_add", "broadcast_plus", "broadcast_sub", "broadcast_minus",
+    "broadcast_mul", "broadcast_div", "broadcast_maximum",
+    "broadcast_minimum", "broadcast_power",
+)
+
+_OUT_RULES = {
+    "Convolution": _conv_out, "Convolution_v1": _conv_out,
+    "Pooling": _pool_out, "Pooling_v1": _pool_out,
+    "FullyConnected": _fc_out,
+    "Reshape": _reshape_out, "reshape": _reshape_out,
+    "transpose": _transpose_out, "SwapAxis": None,
+    "Concat": _concat_out, "concat": _concat_out,
+    "slice_axis": _slice_axis_out,
+    "SliceChannel": _slice_channel_out, "split": _slice_channel_out,
+    "expand_dims": _expand_dims_out,
+    "squeeze": _squeeze_out,
+    "Flatten": _flatten_out, "flatten": _flatten_out,
+    "Embedding": _embedding_out,
+    "_rnn_state_zeros": _rnn_state_zeros_out,
+    "dot": _dot_out,
+    "sum": _reduce_out, "mean": _reduce_out, "max": _reduce_out,
+    "min": _reduce_out, "prod": _reduce_out,
+}
+_OUT_RULES.update({op: _identity_out for op in _IDENTITY_OPS})
+_OUT_RULES.update({op: _identity_out for op in _SCALAR_OPS})
+_OUT_RULES.update({op: _elemwise_out for op in _ELEMWISE_OPS})
+_OUT_RULES.update({op: _broadcast_out for op in _BROADCAST_OPS})
+_OUT_RULES.pop("SwapAxis")
+
+
+# -- bidirectional weight rules ----------------------------------------------
+# rule(attrs, data_shape) -> {input_name: shape} for this op's variable
+# inputs; _INPUT_NAMES names the op's positional inputs so the derived
+# shapes land on the right variables.
+
+def _conv_params(a, d):
+    k = _tup(a["kernel"])
+    nf = int(a["num_filter"])
+    ng = int(a.get("num_group", 1))
+    out = {"weight": (nf, d[1] // ng) + k}
+    if not a.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _fc_params(a, d):
+    nh = int(a["num_hidden"])
+    in_dim = _prod(d[1:]) if a.get("flatten", True) else d[-1]
+    out = {"weight": (nh, in_dim)}
+    if not a.get("no_bias", False):
+        out["bias"] = (nh,)
+    return out
+
+
+def _bn_params(a, d):
+    c = d[int(a.get("axis", 1))]
+    return {"gamma": (c,), "beta": (c,),
+            "moving_mean": (c,), "moving_var": (c,)}
+
+
+def _ln_params(a, d):
+    c = d[int(a.get("axis", -1))]
+    return {"gamma": (c,), "beta": (c,)}
+
+
+def _embed_params(a, d):
+    return {"weight": (int(a["input_dim"]), int(a["output_dim"]))}
+
+
+def _softmax_out_params(a, d):
+    if a.get("multi_output", False):
+        return {"label": (d[0],) + tuple(d[2:])}
+    return {"label": tuple(d[:-1])}
+
+
+def _regression_params(a, d):
+    return {"label": tuple(d)}
+
+
+_PARAM_RULES = {
+    "Convolution": _conv_params, "Convolution_v1": _conv_params,
+    "FullyConnected": _fc_params,
+    "BatchNorm": _bn_params, "BatchNorm_v1": _bn_params,
+    "LayerNorm": _ln_params, "InstanceNorm": lambda a, d: {
+        "gamma": (d[1],), "beta": (d[1],)},
+    "Embedding": _embed_params,
+    "SoftmaxOutput": _softmax_out_params,
+    "LinearRegressionOutput": _regression_params,
+    "LogisticRegressionOutput": _regression_params,
+    "MAERegressionOutput": _regression_params,
+}
+
+_INPUT_NAMES = {
+    "Convolution": ("data", "weight", "bias"),
+    "Convolution_v1": ("data", "weight", "bias"),
+    "FullyConnected": ("data", "weight", "bias"),
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "BatchNorm_v1": ("data", "gamma", "beta", "moving_mean",
+                     "moving_var"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "Embedding": ("data", "weight"),
+    "SoftmaxOutput": ("data", "label"),
+    "LinearRegressionOutput": ("data", "label"),
+    "LogisticRegressionOutput": ("data", "label"),
+    "MAERegressionOutput": ("data", "label"),
+}
+
+_DTYPE_SIZES = {"float32": 4, "float64": 8, "float16": 2,
+                "bfloat16": 2, "int64": 8, "int32": 4, "int8": 1,
+                "uint8": 1, "bool": 1}
+
+
+def infer_symbol_shapes(graph, inputs, default_itemsize=4):
+    """Interpret ``graph`` (a symbol-JSON dict) under ``inputs``
+    (``{variable_name: shape}``).
+
+    Returns ``{"args": {name: shape}, "outputs": [shape, ...],
+    "node_outputs": [[shape, ...] per node], "itemsizes": [per node]}``.
+    Raises :class:`UnsupportedOp` for ops outside the rule table,
+    :class:`ShapeError` for genuinely inconsistent graphs."""
+    nodes = graph["nodes"]
+    shapes = [None] * len(nodes)        # list of per-output shape lists
+    itemsizes = [default_itemsize] * len(nodes)
+    args = {}
+
+    def _set_var(idx, shape):
+        shapes[idx] = [tuple(int(s) for s in shape)]
+        args[nodes[idx]["name"]] = shapes[idx][0]
+
+    for i, node in enumerate(nodes):
+        a = _attrs(node)
+        if node["op"] == "null":
+            if node["name"] in inputs:
+                _set_var(i, inputs[node["name"]])
+            elif "__shape__" in a:
+                _set_var(i, _tup(a["__shape__"]))
+            if "__dtype__" in a:
+                itemsizes[i] = _DTYPE_SIZES.get(str(a["__dtype__"]),
+                                                default_itemsize)
+            continue
+        op = node["op"]
+        rule = _OUT_RULES.get(op)
+        if rule is None:
+            raise UnsupportedOp(op)
+        in_edges = node["inputs"]
+        # bidirectional fill of still-unknown variable inputs
+        prule = _PARAM_RULES.get(op)
+        if prule is not None and in_edges:
+            d0 = shapes[in_edges[0][0]]
+            if d0 is None:
+                raise ShapeError("no shape for data input of %s (%s)"
+                                 % (node["name"], op))
+            derived = prule(a, d0[in_edges[0][1]])
+            names = _INPUT_NAMES.get(op, ())
+            for slot, (src, _oi, *_rest) in enumerate(in_edges):
+                if shapes[src] is not None or slot >= len(names):
+                    continue
+                nm = names[slot]
+                if nm in derived and nodes[src]["op"] == "null":
+                    _set_var(src, derived[nm])
+        ins = []
+        for (src, oi, *_rest) in in_edges:
+            if shapes[src] is None:
+                raise ShapeError(
+                    "cannot infer shape for input %r of node %r (%s)"
+                    % (nodes[src]["name"], node["name"], op))
+            ins.append(shapes[src][oi])
+        outs = rule(a, ins)
+        shapes[i] = [tuple(int(s) for s in o) for o in outs]
+        if op in ("Cast", "cast") and "dtype" in a:
+            itemsizes[i] = _DTYPE_SIZES.get(str(a["dtype"]),
+                                            default_itemsize)
+        elif in_edges:
+            itemsizes[i] = itemsizes[in_edges[0][0]]
+    outputs = []
+    for (nid, oi, *_rest) in graph["heads"]:
+        if shapes[nid] is None:
+            raise ShapeError("head node %r has no shape"
+                             % nodes[nid]["name"])
+        outputs.append(shapes[nid][oi])
+    return {"args": args, "outputs": outputs, "node_outputs": shapes,
+            "itemsizes": itemsizes}

@@ -29,6 +29,7 @@ checkpoints into the serving layer.  See ``docs/faq/checkpoint.md``.
 from __future__ import annotations
 
 from .async_ckpt import AsyncCheckpointer, write_checkpoint  # noqa: F401
+from .compat import check_restore_compat, state_plan_spec  # noqa: F401
 from .manager import (CheckpointManager, default_manager,  # noqa: F401
                       sigterm_flag_scope)
 from .state import (ParallelTrainerState, TrainState,  # noqa: F401
@@ -39,5 +40,6 @@ from .store import (CheckpointError, CheckpointStore,  # noqa: F401
 __all__ = ["AsyncCheckpointer", "CheckpointError", "CheckpointManager",
            "ParallelTrainerState",
            "CheckpointStore", "IntegrityError", "RetentionPolicy",
-           "TrainState", "capture_iter_state", "default_manager",
-           "restore_iter_state", "sigterm_flag_scope", "write_checkpoint"]
+           "TrainState", "capture_iter_state", "check_restore_compat",
+           "default_manager", "restore_iter_state", "sigterm_flag_scope",
+           "state_plan_spec", "write_checkpoint"]

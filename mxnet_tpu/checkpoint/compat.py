@@ -1,0 +1,54 @@
+"""Reshard-on-restore compatibility — checked BEFORE binding anything.
+
+``ParallelTrainerState`` payloads are mesh-independent by design
+(state.py), so a restore may land on a different mesh width / fsdp
+split / ZeRO stage / bucket plan.  What it may NOT survive is a
+*logical* mismatch: missing or reshaped params, a different optimizer
+slot family.  ``ParallelTrainer.load_state_dict`` rejects those at
+restore time; this module gives the same verdict statically — from a
+snapshot (or just its manifest-level shapes) and a target trainer's
+declarative plan — so an elastic-training controller can validate a
+(checkpoint, new-topology) pair before tearing anything down.  The
+actual comparison lives in ``analysis/plan/contracts.reshard_compat``;
+this is the checkpoint-side adapter.
+"""
+from __future__ import annotations
+
+__all__ = ["state_plan_spec", "check_restore_compat"]
+
+
+def state_plan_spec(state, name="checkpoint"):
+    """A :class:`~mxnet_tpu.analysis.plan.PlanSpec` view of a
+    :class:`~.state.ParallelTrainerState` (or its ``as_state_dict()``
+    dict): param names/shapes, slot vocabulary, codec/zero metadata."""
+    from ..analysis.plan import MeshSpec, PlanSpec
+    if hasattr(state, "as_state_dict"):
+        state = state.as_state_dict()
+    meta = dict(state.get("meta", {}))
+    params = [{"name": n, "shape": [int(s) for s in v.shape],
+               "dtype_size": int(getattr(v, "itemsize", None)
+                                 or v.dtype.itemsize),
+               "trainable": True, "spec": None}
+              for n, v in sorted(state.get("params", {}).items())]
+    slots = sorted(state.get("slots", {}).keys())
+    scalars = [[n, 4] for n in sorted(state.get("scalars", {}))]
+    codec = meta.get("codec")
+    return PlanSpec(
+        name=name, kind="trainer",
+        origin="mxnet_tpu/checkpoint/state.py",
+        mesh=MeshSpec([("dp", 1)]),     # payload is mesh-independent
+        params=params, zero=int(meta.get("zero", 0)),
+        optimizer={"slots": slots, "scalar_slots": scalars},
+        codec={"name": codec} if codec else None)
+
+
+def check_restore_compat(state, trainer, name="checkpoint"):
+    """Static verdict for restoring ``state`` into ``trainer``:
+    ``{"compatible": bool, "problems": [...], "notes": [...]}``.
+    ``problems`` mirrors exactly what ``load_state_dict`` would raise;
+    ``notes`` records the legal reshard (mesh width, zero stage,
+    dropped residuals)."""
+    from ..analysis.plan import PlanSpec, reshard_compat
+    saved = state_plan_spec(state, name=name)
+    target = PlanSpec.from_trainer(trainer, name="restore-target")
+    return reshard_compat(saved, target)

@@ -247,6 +247,17 @@ register_env("MXNET_SAN_REPORT", str, None,
              "path for the graftsan findings/claim-statistics JSON "
              "report written at process exit when any sanitizer is "
              "armed")
+register_env("MXNET_PLAN_HBM_BYTES", int, 0,
+             "per-chip memory budget (bytes) for graftplan's oom-risk "
+             "checker: configurations whose predicted per-chip peak "
+             "(params + ZeRO-sharded optimizer slots + activation "
+             "liveness + collective staging) exceeds it fail "
+             "tools/lint.py --plan; 0 disables the budget gate")
+register_env("MXNET_PLAN_BUCKET_FILL_MIN", float, 0.6,
+             "minimum predicted per-rung fill of a serving bucket "
+             "ladder (uniform-arrival model) before graftplan's "
+             "bucket-plan-waste checker flags the rung as padding "
+             "waste")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

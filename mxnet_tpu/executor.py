@@ -750,3 +750,29 @@ class Executor:
 
     def debug_str(self):
         return self._symbol.debug_str()
+
+    def program_plan(self):
+        """This bound program, declaratively, for graftplan
+        (``analysis/plan/``): the symbol-JSON graph plus the bound
+        array shapes/dtypes.  graftplan's stdlib shape interpreter and
+        activation-liveness walk (the reference's ``infer_shape`` +
+        plan-memory passes, done pre-bind) run over exactly this —
+        no trace, no XLA compile."""
+        import json as _json
+        params = []
+        inputs = {}
+        for name in self.arg_names + self.aux_names:
+            arr = self.arg_dict.get(name)
+            if arr is None:
+                arr = self.aux_dict.get(name)
+            if arr is None:
+                continue
+            shape = [int(s) for s in arr.shape]
+            inputs[name] = tuple(shape)
+            params.append({
+                "name": name, "shape": shape,
+                "dtype_size": int(np.dtype(arr.dtype).itemsize),
+                "trainable": self._grad_req.get(name, "null") != "null",
+                "spec": None})
+        return {"graph": _json.loads(self._symbol.tojson()),
+                "inputs": inputs, "params": params}

@@ -52,6 +52,14 @@ class Bucket:
         """Unpadded fp32 payload bytes of this bucket."""
         return 4 * self.n
 
+    def to_dict(self):
+        """Plain-data form for graftplan specs (analysis/plan/)."""
+        return {"index": self.index, "names": list(self.names),
+                "shapes": [list(s) for s in self.shapes],
+                "sizes": list(self.sizes),
+                "offsets": list(self.offsets),
+                "n": self.n, "padded_n": self.padded_n}
+
     def __repr__(self):
         return "Bucket(%d: %d params, %d elems, %d padded)" % (
             self.index, len(self.names), self.n, self.padded_n)

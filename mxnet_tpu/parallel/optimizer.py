@@ -55,6 +55,16 @@ class PureSGD:
             return {}
         return {"mom": sharded_zeros_like(params, shardings)}
 
+    def slot_spec(self):
+        """Declarative slot layout for graftplan (analysis/plan/): the
+        per-param slot names :meth:`init` allocates plus the scalar
+        slots with their byte sizes.  The static optimizer-state
+        predictor is a pure function of this spec — keep it in
+        lockstep with :meth:`init` (tests/test_plan.py asserts the two
+        agree byte-for-byte against real shardings)."""
+        return {"slots": [] if self.momentum == 0.0 else ["mom"],
+                "scalar_slots": []}
+
     def apply(self, params, grads, state, lr=None):
         lr = self.lr if lr is None else lr
         clip = self.clip_gradient
@@ -97,6 +107,13 @@ class PureAdam:
         return {"mean": sharded_zeros_like(params, shardings),
                 "var": sharded_zeros_like(params, shardings),
                 "t": jnp.zeros((), jnp.int32)}
+
+    def slot_spec(self):
+        """See :meth:`PureSGD.slot_spec`.  ``t`` is a scalar slot:
+        :meth:`init` returns it unconditionally, so under ZeRO it
+        exists once per state subtree (fused AND perparam) — the
+        predictor models exactly that."""
+        return {"slots": ["mean", "var"], "scalar_slots": [["t", 4]]}
 
     def apply(self, params, grads, state, lr=None):
         lr = self.lr if lr is None else lr

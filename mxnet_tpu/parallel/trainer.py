@@ -347,6 +347,40 @@ class ParallelTrainer:
         import copy
         return copy.deepcopy(self._comm)
 
+    def plan_spec(self):
+        """This trainer's bound program, declaratively — the graftplan
+        input (``analysis/plan/``): mesh axes, per-param shapes/dtype
+        sizes/partition specs/trainable flags, the ZeRO stage, the
+        optimizer slot spec, the serialized bucket plan, and the codec
+        wire model.  Pure data; graftplan's static predictions from
+        this spec are test-asserted EXACT against the measured
+        :meth:`optimizer_state_bytes` and :meth:`comm_stats` — if you
+        change a layout rule here or in ``_init_opt_state``, the plan
+        model (``analysis/plan/memory.py``/``schedule.py``) must move
+        with it or tests/test_plan.py fails."""
+        from ..analysis.plan.spec import normalize_pspec
+        mesh = self._mesh
+        fused = set(self._fused_names)
+        params = []
+        for name, t in zip(self._param_names, self._trainable):
+            arr = self._params[name]
+            params.append({
+                "name": name, "shape": [int(s) for s in arr.shape],
+                "dtype_size": int(arr.dtype.itemsize),
+                "trainable": bool(t),
+                "spec": normalize_pspec(self._pspecs[name], arr.ndim),
+                "fused": name in fused})
+        return {
+            "mesh": [[a, int(mesh.shape[a])] for a in mesh.axis_names],
+            "params": params,
+            "zero": self._zero,
+            "optimizer": self._opt.slot_spec(),
+            "buckets": [b.to_dict() for b in self._plan],
+            "codec": ({"name": self._codec.name}
+                      if self._codec is not None else None),
+            "batch": {"axes": ["dp", "fsdp"]},
+        }
+
     def optimizer_state_bytes(self):
         """``{"total": logical bytes, "per_device": bytes resident per
         chip}`` over every optimizer-state leaf (+ compression

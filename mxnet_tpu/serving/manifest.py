@@ -131,6 +131,20 @@ class WarmupManifest:
                            and (backend is None
                                 or e["backend"] == backend)})
 
+    def ladders(self):
+        """Every recorded working set as a ladder:
+        ``{"model@sha12": sorted buckets}`` — the graftplan feed
+        (``ModelServer.plan_spec``), so bucket-plan-waste judges the
+        ladders a restarted replica will actually warm, not just the
+        configured default."""
+        with self._lock:
+            out = {}
+            for e in self._entries.values():
+                key = "%s@%s" % (e["model"],
+                                 str(e["symbol_sha256"])[:12])
+                out.setdefault(key, set()).add(int(e["bucket"]))
+        return {k: sorted(v) for k, v in sorted(out.items())}
+
     def __len__(self):
         with self._lock:
             return len(self._entries)

@@ -661,6 +661,20 @@ class ModelServer:
         self._t_batch_rows.labels(bucket=bucket).inc(rows_total)
 
     # -- observability ------------------------------------------------------
+    def plan_spec(self):
+        """This server's bucket plan, declaratively — the graftplan
+        feed (``analysis/plan/``): the configured shape-bucket ladder
+        plus every ladder the warmup manifest recorded (a restarted
+        replica warms THOSE buckets, so their economics matter too).
+        The ``bucket-plan-waste`` checker predicts per-rung fill and
+        shadowing from this; the measured counterpart is
+        ``stats()["batches"]["occupancy"]``."""
+        manifest_ladders = (self.manifest.ladders()
+                            if self.manifest is not None else {})
+        return {"ladder": list(self._buckets),
+                "max_batch": int(self._max_batch),
+                "manifest_ladders": manifest_ladders}
+
     def stats(self):
         """One consistent /stats snapshot (all counters since start).
 

@@ -1197,6 +1197,39 @@ def test_changed_paths_git_derivation(tmp_path):
     assert sorted(os.path.basename(p) for p in vs_head) == ["a.py"]
 
 
+def test_changed_paths_fixture_edits_relint_analysis_package(tmp_path):
+    """PR 11 satellite: a fixture-only edit under tests/fixtures/
+    (plan-spec corpora, checker inputs) maps to the analysis package —
+    the checker tests consume those fixtures, so their lint paths must
+    re-run instead of --changed reporting nothing to lint."""
+    from mxnet_tpu.analysis.cli import _changed_paths
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo), "-c",
+                        "user.email=t@t", "-c", "user.name=t"]
+                       + list(args), check=True, capture_output=True)
+
+    git("init")
+    ana = repo / "mxnet_tpu" / "analysis"
+    ana.mkdir(parents=True)
+    (ana / "core.py").write_text("x = 1\n")
+    fix = repo / "tests" / "fixtures" / "analysis"
+    fix.mkdir(parents=True)
+    (fix / "plan_bad_specs.json").write_text("{}\n")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    (fix / "plan_bad_specs.json").write_text('{"specs": []}\n')
+    picked = _changed_paths(str(repo), None)
+    assert picked == [str(ana)]
+    # an analysis edit alongside the fixture does not duplicate the dir
+    (ana / "core.py").write_text("x = 2\n")
+    picked = _changed_paths(str(repo), None)
+    assert sorted(picked) == sorted([str(ana),
+                                    str(ana / "core.py")])
+
+
 def test_changed_flag_rejects_explicit_paths(capsys):
     from mxnet_tpu.analysis.cli import main
     rc = main(["--changed", "some/path.py"])
@@ -1240,7 +1273,9 @@ def test_cli_flags_roundtrip(tmp_path):
     assert set(r.stdout.split()) >= {
         "host-sync", "c-api-contract", "env-knob-drift", "lock-discipline",
         "recompile-hazard", "tracer-escape", "mesh-contract",
-        "unguarded-global-mutation", "stale-suppression"}
+        "unguarded-global-mutation", "stale-suppression",
+        "spmd-divisibility", "collective-mismatch", "oom-risk",
+        "bucket-plan-waste"}
 
 
 # -- the tier-1 gate ---------------------------------------------------------

@@ -1,0 +1,516 @@
+"""graftplan — static shape/sharding/memory analysis of the tensor
+program (PR 11).
+
+Four proof obligations:
+
+1. the stdlib shape interpreter agrees with ``Symbol.infer_shape``
+   over the test corpus (two independent engines, one answer);
+2. the closed loop against reality is EXACT: predicted optimizer-state
+   bytes == measured ``optimizer_state_bytes()`` for zero ∈ {0, 1, 2}
+   on the 8-device mesh, and predicted collective bytes == the live
+   ``mxnet_collective_bytes_total`` delta of a real dryrun step;
+3. each plan checker catches its seeded misconfiguration STATICALLY —
+   the failing path is pure data, proven by poisoning ``jax.jit``;
+4. the in-tree configuration catalog is clean against the committed
+   baseline (the tier-1 gate).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel, telemetry
+from mxnet_tpu.analysis import baseline as baseline_mod
+from mxnet_tpu.analysis import rule_ids, sarif_report
+from mxnet_tpu.analysis.checkers.plan_rules import run_plan_checkers
+from mxnet_tpu.analysis.plan import (MeshSpec, PlanSpec, UnsupportedOp,
+                                     activation_liveness, analyze,
+                                     infer_symbol_shapes, ladder_report,
+                                     predict_comm, predict_opt_state,
+                                     reshard_compat)
+from mxnet_tpu.analysis.plan.configs import (catalog_reports,
+                                             in_tree_configs,
+                                             verify_predictions)
+from mxnet_tpu.analysis.plan.shapes import ShapeError
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures")
+
+
+# ---------------------------------------------------------------------------
+# 1. shape interpreter vs infer_shape over the symbol corpus
+# ---------------------------------------------------------------------------
+
+def _corpus():
+    """The test_infer_shape / test_golden_files symbol corpus, plus a
+    few net shapes the in-tree configs use."""
+    sym = mx.sym
+    graphs = []
+
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                         name="c1")
+    p1 = sym.Pooling(c1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                     name="p1")
+    c2 = sym.Convolution(p1, num_filter=16, kernel=(3, 3),
+                         stride=(2, 2), name="c2")
+    graphs.append(("conv-chain", c2, {"data": (4, 3, 32, 32)}))
+
+    a = sym.Variable("a")
+    merged = sym.FullyConnected(a, num_hidden=6, name="l") + \
+        sym.FullyConnected(a, num_hidden=6, name="r")
+    graphs.append(("branch-merge", merged, {"a": (3, 4)}))
+
+    x = sym.Variable("x")
+    graphs.append(("reshape-0--1", sym.Reshape(x, shape=(0, -1)),
+                   {"x": (2, 3, 4)}))
+    graphs.append(("reshape--2", sym.Reshape(x, shape=(-2,)),
+                   {"x": (2, 3, 4)}))
+
+    embed = sym.Embedding(data, input_dim=10, output_dim=6, name="emb")
+    cell = mx.rnn.LSTMCell(12, prefix="lstm_")
+    outputs, _states = cell.unroll(5, inputs=embed, merge_outputs=True,
+                                   layout="NTC")
+    graphs.append(("lstm-unroll", outputs, {"data": (3, 5)}))
+
+    golden = mx.sym.load(os.path.join(FIX, "golden_symbol.json"))
+    blob = np.load(os.path.join(FIX, "golden_symbol_io.npz"))
+    graphs.append(("golden-symbol", golden,
+                   {"data": tuple(blob["x"].shape)}))
+
+    net = sym.FullyConnected(data, num_hidden=64, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.FullyConnected(net, num_hidden=10, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    graphs.append(("mlp-bn", net, {"data": (32, 100)}))
+
+    left = sym.transpose(sym.FullyConnected(a, num_hidden=6, name="t1"))
+    right = sym.slice_axis(sym.Variable("b"), axis=1, begin=0, end=3)
+    both = sym.Concat(sym.transpose(left), right, dim=1)
+    graphs.append(("transpose-slice-concat", both,
+                   {"a": (3, 4), "b": (3, 5)}))
+    return graphs
+
+
+def test_shape_interpreter_agrees_with_infer_shape():
+    """Satellite: every corpus graph BOTH engines handle must agree on
+    every output shape AND every inferred argument shape."""
+    handled = 0
+    for tag, symbol, inputs in _corpus():
+        g = json.loads(symbol.tojson())
+        try:
+            res = infer_symbol_shapes(g, inputs)
+        except UnsupportedOp:
+            continue
+        handled += 1
+        args, outs, _aux = symbol.infer_shape(**inputs)
+        assert [tuple(s) for s in res["outputs"]] == \
+            [tuple(s) for s in outs], tag
+        ref_args = dict(zip(symbol.list_arguments(), args))
+        for name, shape in ref_args.items():
+            if shape is None or name not in res["args"]:
+                continue
+            assert tuple(res["args"][name]) == tuple(shape), (tag, name)
+    # the cross-check is vacuous if the interpreter skips everything
+    assert handled >= 6, "interpreter handled only %d corpus graphs" \
+        % handled
+
+
+def test_shape_interpreter_unsupported_op_is_clean_skip():
+    s = mx.sym.RNN(mx.sym.Variable("d"), state_size=4, num_layers=1,
+                   mode="lstm", name="rnn")
+    with pytest.raises(UnsupportedOp):
+        infer_symbol_shapes(json.loads(s.tojson()), {"d": (5, 2, 3)})
+
+
+def test_shape_interpreter_flags_inconsistent_graph():
+    bad = mx.sym.Variable("a") + mx.sym.Variable("b")
+    with pytest.raises(ShapeError):
+        infer_symbol_shapes(json.loads(bad.tojson()),
+                            {"a": (2, 3), "b": (3, 3)})
+
+
+def test_activation_liveness_peak_and_batch_shard():
+    """A 3-op chain: peak is the two adjacent buffers, freed buffers
+    leave the live set, heads persist, and batch sharding divides."""
+    g = {"nodes": [
+        {"op": "null", "name": "x", "attrs": {}, "inputs": []},
+        {"op": "relu", "name": "r1", "attrs": {},
+         "inputs": [[0, 0, 0]]},
+        {"op": "relu", "name": "r2", "attrs": {},
+         "inputs": [[1, 0, 0]]},
+        {"op": "relu", "name": "r3", "attrs": {},
+         "inputs": [[2, 0, 0]]},
+    ], "arg_nodes": [0], "heads": [[3, 0, 0]]}
+    out = activation_liveness(g, {"x": (4, 4)})
+    # each activation is 4*4*4 = 64 B; at any node only producer +
+    # consumer are live -> peak 128, total 3 buffers = 192
+    assert out["peak"] == 128
+    assert out["total"] == 192
+    half = activation_liveness(g, {"x": (4, 4)}, batch_shard=2)
+    assert half["peak"] == 64
+
+
+# ---------------------------------------------------------------------------
+# 2. the closed loop: predictions == measurements, exactly
+# ---------------------------------------------------------------------------
+
+def _make_net():
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3),
+            nn.Activation("relu"),
+            nn.GlobalAvgPool2D(), nn.Flatten(),
+            nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier(), force_reinit=True)
+    net(nd.ones((1, 3, 8, 8)))
+    return net
+
+
+def _trainer(zero, optimizer="sgd", compression=None, width=8,
+             bucket_bytes=2048, net=None):
+    import jax
+    mesh = parallel.make_mesh(dp=width, devices=jax.devices()[:width])
+    opt_params = ({"learning_rate": 0.1, "momentum": 0.9}
+                  if optimizer == "sgd" else {"learning_rate": 1e-3})
+    return parallel.ParallelTrainer(
+        net if net is not None else _make_net(),
+        gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        opt_params, mesh=mesh, zero=zero, compression=compression,
+        bucket_bytes=bucket_bytes)
+
+
+@pytest.mark.parametrize("zero", [0, 1, 2])
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_opt_state_prediction_exact(zero, optimizer):
+    """ACCEPTANCE: predicted optimizer-state bytes == measured
+    ``optimizer_state_bytes()`` for zero ∈ {0,1,2} on the 8-device
+    mesh — byte for byte, total AND per-device, SGD and Adam."""
+    tr = _trainer(zero, optimizer=optimizer)
+    spec = PlanSpec.from_trainer(tr)
+    assert predict_opt_state(spec) == tr.optimizer_state_bytes()
+
+
+@pytest.mark.parametrize("compression", [None, "2bit", "bf16"])
+def test_opt_state_prediction_exact_with_residuals(compression):
+    tr = _trainer(2, compression=compression)
+    spec = PlanSpec.from_trainer(tr)
+    assert predict_opt_state(spec) == tr.optimizer_state_bytes()
+
+
+def test_comm_prediction_matches_wire_model():
+    """predict_comm mirrors comm_stats field-for-field on every
+    config shape (zero stages, codecs, monolithic bucket)."""
+    for kwargs in (dict(zero=0), dict(zero=1), dict(zero=2),
+                   dict(zero=2, compression="2bit"),
+                   dict(zero=0, compression="bf16", bucket_bytes=0)):
+        tr = _trainer(**kwargs)
+        spec = PlanSpec.from_trainer(tr)
+        assert predict_comm(spec) == tr.comm_stats(), kwargs
+
+
+def test_comm_prediction_matches_live_counter_delta():
+    """ACCEPTANCE: predicted per-step collective bytes == the
+    ``mxnet_collective_bytes_total`` delta of a LIVE dryrun step."""
+    telemetry.enable()
+    try:
+        for kwargs in (dict(zero=2, compression="bf16"), dict(zero=0)):
+            tr = _trainer(**kwargs)
+            pred = predict_comm(PlanSpec.from_trainer(tr))
+            x = nd.array(np.random.RandomState(0)
+                         .rand(16, 3, 8, 8).astype(np.float32))
+            y = nd.array(np.random.RandomState(1)
+                         .randint(0, 4, 16).astype(np.float32))
+            tr.step(x, y)           # compile + warm
+            before = telemetry.scalar_totals().get(
+                "mxnet_collective_bytes_total", 0)
+            tr.step(x, y)           # the measured dryrun step
+            after = telemetry.scalar_totals().get(
+                "mxnet_collective_bytes_total", 0)
+            assert after - before == pred["total_bytes"], kwargs
+    finally:
+        telemetry.disable()
+
+
+def test_trainer_plan_spec_is_plain_data():
+    tr = _trainer(2, compression="bf16")
+    spec = PlanSpec.from_trainer(tr)
+    # json round trip preserves every prediction input
+    back = PlanSpec.from_json(spec.to_json())
+    assert predict_opt_state(back) == predict_opt_state(spec)
+    assert predict_comm(back) == predict_comm(spec)
+
+
+# ---------------------------------------------------------------------------
+# 3. seeded misconfigurations — caught statically
+# ---------------------------------------------------------------------------
+
+def test_seeded_misconfigurations_caught_statically(monkeypatch):
+    """ACCEPTANCE: each checker catches its seeded misconfiguration
+    (non-divisible shard, orphaned reduce-scatter, over-budget config,
+    shadowed bucket) with NO XLA compile in the failing path —
+    ``jax.jit`` is poisoned for the duration to prove it."""
+    import jax
+
+    def _no_compile(*_a, **_k):
+        raise AssertionError("jax.jit reached from the static plan path")
+
+    monkeypatch.setattr(jax, "jit", _no_compile)
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "plan_bad_specs.json")))
+    seen_rules = set()
+    for entry in doc["specs"]:
+        spec = PlanSpec.from_dict(entry["spec"])
+        findings = run_plan_checkers([analyze(spec)])
+        rules = {f.rule for f in findings}
+        assert entry["expect_rule"] in rules, \
+            (spec.name, [f.message for f in findings])
+        seen_rules.add(entry["expect_rule"])
+    assert seen_rules == {"spmd-divisibility", "collective-mismatch",
+                          "oom-risk", "bucket-plan-waste"}
+
+
+def test_plan_findings_ride_graftlint_reporting():
+    """Satellite: the SARIF reporter covers the plan rule ids — same
+    fingerprints/levels machinery as the file-walk rules."""
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "plan_bad_specs.json")))
+    findings = run_plan_checkers(
+        [analyze(PlanSpec.from_dict(e["spec"])) for e in doc["specs"]])
+    sarif = json.loads(sarif_report(findings))
+    ids = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert ids == {"spmd-divisibility", "collective-mismatch",
+                   "oom-risk", "bucket-plan-waste"}
+    for res in sarif["runs"][0]["results"]:
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+        assert res["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"].startswith("mxnet_tpu/")
+    assert set(rule_ids()) >= ids
+
+
+def test_oom_risk_respects_budget_direction():
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "plan_bad_specs.json")))
+    entry = next(e for e in doc["specs"]
+                 if e["expect_rule"] == "oom-risk")
+    spec = PlanSpec.from_dict(entry["spec"])
+    spec.hbm_budget = 10 ** 12          # generous budget: silent
+    assert not run_plan_checkers([analyze(spec)])
+    spec.hbm_budget = None              # no budget: gate disabled
+    assert not run_plan_checkers([analyze(spec)])
+
+
+def test_ladder_report_economics():
+    # the in-tree power-of-two ladder is healthy at the default bar
+    rep = ladder_report([1, 2, 4, 8, 16])
+    assert not rep["problems"]
+    fills = [r["fill"] for r in rep["rungs"]]
+    assert fills[0] == 1.0 and abs(fills[-1] - 0.78125) < 1e-3
+    # a sparse ladder wastes padding; duplicate rungs are shadowed
+    bad = ladder_report([1, 2, 2, 64])
+    kinds = [("shadowed" if r["shadowed"] else "ok")
+             for r in bad["rungs"]]
+    assert "shadowed" in kinds
+    assert any("fill" in p["detail"] for p in bad["problems"])
+
+
+# ---------------------------------------------------------------------------
+# reshard-on-restore compatibility
+# ---------------------------------------------------------------------------
+
+def test_reshard_compat_across_mesh_zero_and_codec():
+    from mxnet_tpu.checkpoint import check_restore_compat, \
+        state_plan_spec
+    # ONE block: param names must match across trainers exactly as a
+    # real restarted process rebuilds them (gluon prefixes are
+    # process-unique, so fresh nets in one process would disagree)
+    net = _make_net()
+    src = _trainer(2, compression="bf16", width=8, net=net)
+    state = src.state_dict()
+    # legal reshard: different width, different zero stage, no codec
+    target = _trainer(0, width=4, net=net)
+    verdict = check_restore_compat(state, target)
+    assert verdict["compatible"], verdict["problems"]
+    assert any("zero stage" in n for n in verdict["notes"])
+    assert any("residuals" in n for n in verdict["notes"])
+    # illegal: optimizer family changes (sgd momentum -> adam slots)
+    adam = _trainer(2, optimizer="adam", width=8, net=net)
+    verdict = check_restore_compat(state, adam)
+    assert not verdict["compatible"]
+    assert any("slots" in p["detail"] for p in verdict["problems"])
+    # illegal: a param went missing from the snapshot
+    broken = dict(state)
+    broken["params"] = {k: v for i, (k, v)
+                        in enumerate(state["params"].items()) if i}
+    verdict = check_restore_compat(
+        {"params": broken["params"], "slots": state["slots"],
+         "scalars": state["scalars"], "meta": state["meta"]}, target)
+    assert not verdict["compatible"]
+    assert any("missing param" in p["detail"]
+               for p in verdict["problems"])
+
+
+def test_reshard_incompat_surfaces_as_collective_mismatch():
+    saved = PlanSpec(
+        name="saved", kind="trainer", origin="x.py",
+        mesh=MeshSpec([("dp", 1)]),
+        params=[{"name": "w", "shape": [4, 4], "dtype_size": 4,
+                 "trainable": True, "spec": None}],
+        optimizer={"slots": ["mean", "var"],
+                   "scalar_slots": [["t", 4]]})
+    target = PlanSpec(
+        name="target", kind="trainer",
+        origin="mxnet_tpu/parallel/trainer.py",
+        mesh=MeshSpec([("dp", 2)]),
+        params=[{"name": "w", "shape": [4, 4], "dtype_size": 4,
+                 "trainable": True, "spec": None}],
+        optimizer={"slots": ["mom"], "scalar_slots": []})
+    findings = run_plan_checkers([analyze(target,
+                                          restore_from=saved)])
+    assert any(f.rule == "collective-mismatch"
+               and "reshard-on-restore" in f.message
+               for f in findings)
+    # same optimizer family: verdict flips to compatible
+    target.optimizer = dict(saved.optimizer)
+    assert reshard_compat(saved, target)["compatible"]
+
+
+# ---------------------------------------------------------------------------
+# serving + executor plan surfaces
+# ---------------------------------------------------------------------------
+
+def test_server_plan_spec_and_manifest_ladders(tmp_path):
+    srv = mx.serving.ModelServer(max_batch=16)
+    d = srv.plan_spec()
+    assert d["ladder"] == [1, 2, 4, 8, 16]
+    assert d["max_batch"] == 16
+    assert d["manifest_ladders"] == {}
+    spec = PlanSpec.from_server(srv)
+    assert not run_plan_checkers([analyze(spec)])
+
+    from mxnet_tpu.serving.manifest import WarmupManifest
+
+    class _V:
+        name = "m"
+        version = 1
+        symbol_sha = "ab" * 16
+        sample_shapes = {"data": (1, 4)}
+
+    man = WarmupManifest(str(tmp_path / "manifest.json"))
+    for b in (1, 4, 2):
+        man.record(_V(), b, backend="cpu")
+    ladders = man.ladders()
+    (key, buckets), = ladders.items()
+    assert key.startswith("m@") and buckets == [1, 2, 4]
+
+    # a manifest that recorded a SPARSE working set is judged too: the
+    # restarted replica warms exactly those buckets, so their
+    # economics are findings even when the configured ladder is fine
+    bad_man = str(tmp_path / "bad-manifest.json")
+    man2 = WarmupManifest(bad_man)
+    for b in (1, 64):
+        man2.record(_V(), b, backend="cpu")
+    srv2 = mx.serving.ModelServer(max_batch=64, manifest_path=bad_man)
+    spec2 = PlanSpec.from_server(srv2, name="serving/with-manifest")
+    findings = run_plan_checkers([analyze(spec2, fill_min=0.6)])
+    assert any(f.rule == "bucket-plan-waste"
+               and "manifest working set" in f.message
+               for f in findings), [f.message for f in findings]
+
+
+def test_executor_program_plan_feeds_memory_model():
+    sym = mx.sym
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    exe = net.simple_bind(data=(16, 24))
+    d = exe.program_plan()
+    assert d["inputs"]["data"] == (16, 24)
+    assert {p["name"] for p in d["params"]} >= {"fc1_weight",
+                                                "fc2_weight"}
+    spec = PlanSpec.from_executor(exe, name="program/mlp")
+    report = analyze(spec)
+    mem = report["memory"]
+    bound_bytes = sum(4 * int(np.prod(p["shape"]))
+                      for p in d["params"])
+    assert mem["params"] == bound_bytes
+    assert mem["activations"] and mem["activations"] > 0
+    # liveness peak can never exceed the sum of all activations
+    live = activation_liveness(spec.graph, spec.graph_inputs)
+    assert live["peak"] <= live["total"]
+
+
+# ---------------------------------------------------------------------------
+# 4. the tier-1 gate: the in-tree catalog is clean and exact
+# ---------------------------------------------------------------------------
+
+def test_in_tree_catalog_clean_and_predictions_exact():
+    """THE gate: graftplan over the shipping configurations
+    (ParallelTrainer zero0/1/2 on the 8-device mesh, the MULTICHIP
+    zero2+bf16 leg, the serving warmup ladder, a bound program) —
+    no findings beyond the committed baseline, and every static
+    prediction equals its measured counterpart exactly."""
+    configs = in_tree_configs(width=8)
+    assert any(s.name.startswith("trainer/zero2") for s, _m in configs)
+    for spec, measured in configs:
+        assert verify_predictions(spec, measured) == []
+    reports, problems = catalog_reports(width=8)
+    assert problems == []
+    findings = run_plan_checkers(reports)
+    known = baseline_mod.load(baseline_mod.default_path(ROOT))
+    new, _old = baseline_mod.filter_new(findings, known)
+    assert not new, [f.message for f in new]
+
+
+def test_cli_plan_update_baseline_accepts_deliberate_finding(
+        tmp_path, monkeypatch, capsys):
+    """The acceptance path for a deliberate plan finding is the
+    baseline: --plan --update-baseline merges the plan rules'
+    findings, preserves out-of-scope entries, and the next --plan run
+    gates clean."""
+    from mxnet_tpu.analysis.cli import main
+    bl = tmp_path / "baseline.json"
+    # a pre-existing NON-plan entry must survive the plan update
+    bl.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "host-sync", "severity": "warning",
+        "path": "mxnet_tpu/x.py", "line": 1, "symbol": "f",
+        "message": "m", "fingerprint": "deadbeefdeadbeef"}]}))
+    monkeypatch.setenv("MXNET_PLAN_HBM_BYTES", "1000")
+    assert main(["--plan", "--baseline", str(bl)]) == 1   # over budget
+    assert main(["--plan", "--update-baseline",
+                 "--baseline", str(bl)]) == 0
+    doc = json.loads(bl.read_text())
+    rules = {e["rule"] for e in doc["findings"]}
+    assert "oom-risk" in rules and "host-sync" in rules
+    assert main(["--plan", "--baseline", str(bl)]) == 0   # accepted
+    monkeypatch.setenv("MXNET_PLAN_HBM_BYTES", "0")
+    capsys.readouterr()
+    # --rule narrows the mode like everywhere else
+    assert main(["--plan", "--rule", "no-such-rule"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_plan_roundtrip():
+    """tools/lint.py --plan end to end: exit 0 on the clean tree, the
+    JSON report carries every catalog config + empty verify set."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--plan", "--json"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["plan"]["verify_problems"] == []
+    names = {rep["name"] for rep in doc["plan"]["reports"]}
+    assert {"trainer/zero0-dp8", "trainer/zero1-dp8",
+            "trainer/zero2-dp8", "serving/warmup-ladder"} <= names
+    assert doc["summary"]["new"] == 0

@@ -349,8 +349,15 @@ def test_audit_classify_verdicts():
                        "never reached here", False),
         audit_mod.Site("native/c_api.cpp", 40, ["c-api-contract"],
                        "inline", "checked by contract", True),
+        audit_mod.Site("native/c_api.cpp", 50, ["c-api-contract"],
+                       "inline", "audit: unreachable-in-audit (C++ "
+                       "shim; no settrace probe)", True),
+        audit_mod.Site("d.py", 60, ["host-sync"], "inline",
+                       "audit: unreachable-in-audit (copied claim)",
+                       False),
     ]
-    exec_counts = {("a.py", 11): [5, 5], ("b.py", 20): [3, 3]}
+    exec_counts = {("a.py", 11): [5, 5], ("b.py", 20): [3, 3],
+                   ("d.py", 60): [2, 0]}
     site_stats = {("a.py", 10): {"events": 5, "hot_events": 5},
                   ("b.py", 20): {"events": 3, "hot_events": 2}}
     baseline_entries = {
@@ -364,6 +371,12 @@ def test_audit_classify_verdicts():
     assert verdicts[("b.py", 20)] == "contradicted"     # hot + scoped
     assert verdicts[("c.py", 30)] == "never-exercised"
     assert verdicts[("native/c_api.cpp", 40)] == "never-exercised"
+    # the explicit unreachable-in-audit marker OWNS the probe gap — a
+    # distinct verdict so the gate can require never_exercised == 0
+    assert verdicts[("native/c_api.cpp", 50)] == "justified-unreachable"
+    # ...but evidence beats the assertion: a marked site the probe
+    # actually reached is a FALSE justification, not a justified one
+    assert verdicts[("d.py", 60)] == "contradicted"
     b = {r["fingerprint"]: r["verdict"] for r in brows}
     assert b == {"fp1": "runtime-confirmed", "fp2": "never-exercised"}
     contradicted = [r for r in rows if r["verdict"] == "contradicted"]
@@ -415,6 +428,12 @@ def test_audit_end_to_end_gate():
     assert rep["summary"]["contradicted"] == 0, rep["suppressions"]
     assert rep["summary"]["unclaimed_findings"] == 0, rep["findings"]
     assert rep["ok"]
+    # PR 11: every suppression is either exercised by the workload or
+    # carries an explicit unreachable-in-audit justification — the
+    # report never ends with an unverified assertion
+    assert rep["summary"]["never_exercised"] == 0, \
+        [r for r in rep["suppressions"] + rep["baseline"]
+         if r["verdict"] == "never-exercised"]
     # the headline claims are runtime-confirmed, not just asserted
     confirmed = {(r["path"], r["line"]) for r in rep["suppressions"]
                  if r["verdict"] == "runtime-confirmed"}

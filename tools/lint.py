@@ -1,18 +1,23 @@
 #!/usr/bin/env python
 """graftlint launcher — ``tools/lint.py [paths...] [--changed [REF]]
 [--json | --sarif] [--rule R] [--stale] [--update-baseline]
-[--cache PATH | --no-cache] [--audit-suppressions]``.
+[--cache PATH | --no-cache] [--plan] [--audit-suppressions]``.
 
 Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
 by putting the repo root on ``sys.path`` first.  The pre-push habit is
 ``tools/lint.py --changed`` — git-derived file set + the incremental
-cache, so it is near-instant.  ``--audit-suppressions`` is the one
-RUNTIME mode: it executes a built-in workload under the graftsan
-sanitizers and classifies every suppression/baseline entry as
-runtime-confirmed / never-exercised / contradicted (contradictions
-fail).  See ``docs/faq/static_analysis.md`` for the rule catalog, the
-whole-program engine, suppression syntax, the baseline workflow, and
-the sanitizer catalog.
+cache, so it is near-instant (fixture-only edits under
+``tests/fixtures/`` re-lint the analysis package, whose tests consume
+them).  Two modes leave the pure-AST world: ``--plan`` runs graftplan
+(static shape/sharding/memory analysis) over the in-tree
+configuration catalog — it instantiates trainers but never steps or
+XLA-compiles them — and ``--audit-suppressions`` EXECUTES a built-in
+workload under the graftsan sanitizers, classifying every
+suppression/baseline entry as runtime-confirmed / never-exercised /
+contradicted (contradictions fail).  See
+``docs/faq/static_analysis.md`` for the rule catalog, the
+whole-program engine, suppression syntax, the baseline workflow, the
+plan-analysis section, and the sanitizer catalog.
 """
 import os
 import sys
@@ -20,6 +25,16 @@ import sys
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+if "--plan" in sys.argv:
+    # the full catalog wants the virtual 8-device mesh (same trick as
+    # tests/conftest.py); must be set before jax initializes, which the
+    # mxnet_tpu import below triggers.  Explicit env always wins.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 from mxnet_tpu.analysis.cli import main  # noqa: E402
 
