@@ -163,6 +163,13 @@ def main():
         riders = {"baseline_img_per_sec": round(img_s, 2)}
         riders_path = os.path.join(HERE, "BENCH_RIDERS.json")
         for name, env in (
+                # pallas A/B: primary leg runs with the mega-kernel
+                # pass ON (default); this leg turns the whole family
+                # off — fused-vs-unfused is value/pallas_unfused
+                ("pallas_unfused", {"MXNET_PALLAS_FUSED_OPT": "0",
+                                    "MXNET_PALLAS_NORM": "0",
+                                    "MXNET_PALLAS_SOFTMAX": "0",
+                                    "MXNET_PALLAS_BN_RELU": "0"}),
                 ("stem_s2d", {"MXNET_STEM_SPACE_TO_DEPTH": "1"}),
                 ("unfused_metric", {"MXNET_FUSED_METRIC": "0"})):
             to = leg_timeout()

@@ -7,6 +7,7 @@ from . import host_sync          # noqa: F401
 from . import lock_discipline    # noqa: F401
 from . import mesh_contract      # noqa: F401
 from . import missing_donation   # noqa: F401
+from . import pallas_fallback    # noqa: F401
 from . import plan_rules         # noqa: F401
 from . import recompile_hazard   # noqa: F401
 from . import replicated_state   # noqa: F401

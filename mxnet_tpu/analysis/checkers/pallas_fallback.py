@@ -1,0 +1,173 @@
+"""pallas-fallback — Pallas kernels with no interpret-mode test coverage.
+
+Every kernel in ``ops/pallas_kernels.py`` runs natively on TPU and in
+``interpret=True`` mode everywhere else — the WHOLE point of the
+interpret fallback is that CPU tier-1 executes the same kernel code
+paths the TPU compiles.  A kernel (or a call site of one) that no test
+references is a kernel tier-1 never runs: its first execution is on
+hardware, where a shape/tiling bug becomes a Mosaic lowering error in
+a bench run instead of a red unit test.  This rule enforces the
+convention structurally, so every kernel added after the mega-kernel
+pass (ROADMAP item 3) keeps the same guarantee.
+
+Two directions:
+
+- a PUBLIC function defined in the kernels module that no
+  ``tests/test_*.py`` mentions is flagged at its definition;
+- a call site of such an uncovered kernel anywhere in package source
+  is flagged too (the call is live code shipping an untested kernel).
+
+Coverage is judged textually (a word-boundary match of the kernel name
+in any ``tests/test_*.py``): the tests exercise kernels through
+wrappers and parametrized helpers, so AST-level call resolution would
+under-count; a name mention in a test file is the auditable claim.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Checker, Finding, register
+
+__all__ = ["PallasFallbackChecker"]
+
+
+def kernel_defs(path):
+    """{public kernel entry point: line} of the kernels module, by AST.
+
+    A kernel entry point is a top-level function that reaches a
+    ``pallas_call`` transitively through the module's own call graph —
+    plain public helpers (eligibility predicates, layout math) are not
+    kernels and need no interpret-mode test of their own."""
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read())
+        except SyntaxError:
+            return {}
+    funcs = {node.name: node for node in tree.body
+             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    calls = {}
+    direct = set()
+    for name, node in funcs.items():
+        callees = set()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            callee = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if callee == "pallas_call":
+                direct.add(name)
+            elif callee in funcs:
+                callees.add(callee)
+            elif (isinstance(fn, ast.Name) and fn.id == "partial"
+                  or isinstance(fn, ast.Attribute)
+                  and fn.attr == "partial"):
+                # functools.partial(kernel, ...) counts as a call edge
+                for a in sub.args:
+                    if isinstance(a, ast.Name) and a.id in funcs:
+                        callees.add(a.id)
+        calls[name] = callees
+    reaches = set(direct)
+    changed = True
+    while changed:
+        changed = False
+        for name, callees in calls.items():
+            if name not in reaches and callees & reaches:
+                reaches.add(name)
+                changed = True
+    # defvjp-registered rules make custom_vjp wrappers reach the bwd
+    # kernels at runtime; the WRAPPER is the entry point either way
+    return {name: funcs[name].lineno for name in reaches
+            if not name.startswith("_")}
+
+
+def tested_names(root, names):
+    """The subset of ``names`` some tests/test_*.py mentions."""
+    tdir = os.path.join(root, "tests")
+    if not os.path.isdir(tdir) or not names:
+        return set()
+    pattern = re.compile(
+        r"\b(%s)\b" % "|".join(re.escape(n) for n in sorted(names)))
+    found = set()
+    for name in sorted(os.listdir(tdir)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        try:
+            with open(os.path.join(tdir, name), encoding="utf-8",
+                      errors="replace") as f:
+                for m in pattern.finditer(f.read()):
+                    found.add(m.group(1))
+        except OSError:
+            continue
+        if found == names:
+            break
+    return found
+
+
+def _kernels_module(root):
+    """The kernels module path under ``root`` (the package location
+    first, any ``pallas_kernels.py`` for fixture trees), or None."""
+    canonical = os.path.join(root, "mxnet_tpu", "ops", "pallas_kernels.py")
+    if os.path.exists(canonical):
+        return canonical
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        if "pallas_kernels.py" in filenames:
+            return os.path.join(dirpath, "pallas_kernels.py")
+    return None
+
+
+@register
+class PallasFallbackChecker(Checker):
+    rule = "pallas-fallback"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def _uncovered(self, ctx):
+        key = "pallas-fallback-uncovered"
+        if key not in ctx.memo:
+            mod = _kernels_module(ctx.root)
+            if mod is None:
+                ctx.memo[key] = (None, {})
+            else:
+                defs = kernel_defs(mod)
+                covered = tested_names(ctx.root, set(defs))
+                ctx.memo[key] = (
+                    os.path.relpath(mod, ctx.root).replace(os.sep, "/"),
+                    {n: l for n, l in defs.items() if n not in covered})
+        return ctx.memo[key]
+
+    def check(self, path, relpath, text, tree, ctx):
+        mod_rel, uncovered = self._uncovered(ctx)
+        if mod_rel is None or not uncovered or tree is None:
+            return []
+        rel = relpath.replace("\\", "/")
+        if rel.startswith("tests/") or "/tests/" in rel:
+            return []
+        out = []
+        if rel == mod_rel:
+            for name, line in sorted(uncovered.items()):
+                out.append(Finding(
+                    self.rule, self.severity, relpath, line,
+                    "pallas kernel %s has no interpret-mode test "
+                    "coverage (no tests/test_*.py mentions it) — CPU "
+                    "tier-1 never executes this kernel; add a parity "
+                    "test" % name, symbol=name))
+            return out
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name in uncovered:
+                out.append(Finding(
+                    self.rule, self.severity, relpath, node.lineno,
+                    "call site of pallas kernel %s, which no "
+                    "tests/test_*.py exercises in interpret mode — "
+                    "this ships a kernel CPU tier-1 never ran" % name,
+                    symbol=name))
+        return out

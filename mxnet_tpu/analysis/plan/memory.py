@@ -145,8 +145,17 @@ def activation_liveness(graph, inputs, batch_shard=1,
 
 def predict_memory(spec):
     """Per-chip peak-memory breakdown of one configuration:
-    ``{"params", "opt_state", "staging", "activations", "total"}``
-    bytes — ``activations`` is None when the spec carries no graph."""
+    ``{"params", "opt_state", "staging", "update_temp", "activations",
+    "total"}`` bytes — ``activations`` is None when the spec carries no
+    graph.
+
+    ``update_temp`` models the optimizer update's transient HBM
+    footprint: the per-array path materializes a prepped-gradient
+    buffer per update (peak = the largest single update buffer — a
+    bucket under ZeRO, the largest trainable param otherwise); the
+    one-sweep Pallas path (``optimizer["fused_sweep"]``, the
+    ``MXNET_PALLAS_FUSED_OPT`` export) stages its bucket blocks through
+    VMEM only — NO per-param HBM temporaries — so the component is 0."""
     mesh = spec.mesh
     n = mesh.size if mesh is not None else 1
     params = 0
@@ -162,6 +171,23 @@ def predict_memory(spec):
         if spec.codec is not None:
             from .schedule import codec_wire_bytes
             staging += codec_wire_bytes(spec.codec, int(b["padded_n"]))
+    update_temp = 0
+    # trainer specs only: a program/serving spec carries trainable
+    # flags but runs no optimizer update, so charging it an update
+    # transient would be a phantom.  Granularity follows the step that
+    # actually runs: zero>=1 updates flat bucket SHARDS; zero=0 updates
+    # full per-param arrays (buckets exist there too, but only as the
+    # gradient-reduction plan)
+    if spec.kind == "trainer" and not spec.optimizer.get("fused_sweep"):
+        if spec.zero >= 1 and spec.buckets:
+            update_temp = max(4 * int(b["padded_n"]) // n
+                              for b in spec.buckets)
+        else:
+            trainable = [p for p in spec.params
+                         if p.get("trainable", True)]
+            update_temp = max(
+                (_param_bytes(p) // _shard_factor(mesh, p.get("spec"))
+                 for p in trainable), default=0)
     activations = None
     if spec.graph is not None and spec.graph_inputs:
         batch_shard = 1
@@ -171,7 +197,8 @@ def predict_memory(spec):
         activations = activation_liveness(
             spec.graph, spec.graph_inputs,
             batch_shard=batch_shard)["peak"]
-    total = params + opt + staging + (activations or 0)
+    total = params + opt + staging + update_temp + (activations or 0)
     return {"params": int(params), "opt_state": int(opt),
-            "staging": int(staging), "activations": activations,
+            "staging": int(staging), "update_temp": int(update_temp),
+            "activations": activations,
             "total": int(total), "mesh_size": n}

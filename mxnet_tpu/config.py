@@ -258,6 +258,40 @@ register_env("MXNET_PLAN_BUCKET_FILL_MIN", float, 0.6,
              "ladder (uniform-arrival model) before graftplan's "
              "bucket-plan-waste checker flags the rung as padding "
              "waste")
+register_env("MXNET_PALLAS_FUSED_OPT", str, "auto",
+             "one-sweep Pallas optimizer (ParallelTrainer ZeRO sweep, "
+             "executor fused step; fused_sgd_momentum/fused_adam): "
+             "auto = on where the kernels compile natively (TPU), 1 = "
+             "force on anywhere (interpret mode — how CPU tier-1 "
+             "exercises the kernels), 0 = off; the per-array tree_map "
+             "path is the fallback, bit-parity oracle and bench A/B "
+             "leg")
+register_env("MXNET_PALLAS_NORM", str, "auto",
+             "fused Pallas last-axis LayerNorm (fwd + custom_vjp bwd): "
+             "auto = native TPU only, 1 = force (interpret), 0 = off "
+             "(jnp reduction chain)")
+register_env("MXNET_PALLAS_SOFTMAX", str, "auto",
+             "fused Pallas bias+softmax (SoftmaxOutput core, non-flash "
+             "attention probabilities): auto = native TPU only, 1 = "
+             "force (interpret), 0 = off (jax.nn.softmax)")
+register_env("MXNET_PALLAS_BN_RELU", str, "auto",
+             "executor eval-graph peephole: inference BatchNorm(+ReLU) "
+             "as one fused_scale_bias_relu pass: auto = native TPU "
+             "only, 1 = force (interpret), 0 = off (per-op path)")
+register_env("MXNET_PALLAS_OPT_BLOCK_ELEMS", int, 0,
+             "elements per grid step of the fused optimizer sweep "
+             "kernels (rounded to whole (8,128) fp32 tiles); 0 picks "
+             "the 128Ki-element default")
+register_env("MXNET_PALLAS_NORM_BLOCK_ROWS", int, 0,
+             "rows per grid step of the fused layernorm kernels; 0 "
+             "sizes blocks to ~512 KiB of VMEM per operand")
+register_env("MXNET_PALLAS_SOFTMAX_BLOCK_ROWS", int, 0,
+             "rows per grid step of the fused softmax kernels; 0 "
+             "sizes blocks to ~512 KiB of VMEM per operand")
+register_env("MXNET_PALLAS_OPT_BUCKET_BYTES", int, 0,
+             "bucket size cap for the executor fused step's optimizer "
+             "sweep (params flattened into contiguous fp32 buckets); "
+             "<= 0 sweeps everything as one monolithic bucket")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

@@ -214,11 +214,135 @@ class Executor:
         self._fused_resids = None
         self._jit_fbu = None
         self._updates_applied = False
+        # one-sweep Pallas path (MXNET_PALLAS_FUSED_OPT): flatten the
+        # weights into contiguous fp32 buckets and update each bucket in
+        # ONE kernel instead of a per-array kernel stream — the
+        # mega-kernel tail cut (ROADMAP item 3).  None falls back to the
+        # per-array path, which stays the bit-parity oracle.
+        self._sweep = self._plan_sweep(optimizer)
         return True
+
+    def _plan_sweep(self, optimizer):
+        """Bucket plan for the one-sweep fused optimizer, or None.
+
+        Weights are grouped by their static (lr_mult, wd_mult) pair —
+        each group's members share one effective (lr, wd) at every
+        step, so each bucket's hyperparameters stay two scalars riding
+        the kernel's scalar-prefetch operand (per-element lr/wd vectors
+        would double the sweep's HBM traffic).  The reference
+        convention of wd_mult=0 on biases/norms makes two groups the
+        common case.  Eligibility: SGD/Adam (the kernels we have) over
+        all-fp32 weights."""
+        from . import config as _config
+        from .ops.pallas_kernels import family_enabled
+        if not family_enabled("MXNET_PALLAS_FUSED_OPT"):
+            return None
+        kind = type(optimizer).__name__
+        if kind not in ("SGD", "Adam"):
+            return None
+        names = [self.arg_names[i] for i in self._diff_idx]
+        if any(self.arg_dict[n].dtype != np.float32 for n in names):
+            return None
+        from .parallel.collectives import build_bucket_plan
+        groups = {}
+        for j, (i, n) in enumerate(zip(self._diff_idx, names)):
+            key = (float(optimizer._param_mult(n, optimizer.lr_mult,
+                                               "lr_mult")),
+                   float(optimizer._param_mult(n, optimizer.wd_mult,
+                                               "wd_mult")))
+            groups.setdefault(key, []).append(j)
+        cap = _config.get("MXNET_PALLAS_OPT_BUCKET_BYTES")
+        plan = []
+        for key in sorted(groups):
+            idxs = groups[key]
+            buckets = build_bucket_plan(
+                [names[j] for j in idxs],
+                [self.arg_dict[names[j]].shape for j in idxs],
+                cap, pad_multiple=1)
+            pos = {names[j]: j for j in idxs}
+            for b in buckets:
+                plan.append((b, [pos[n] for n in b.names]))
+        # the per-array kernels (optimizer_ops._prep_grad) treat any
+        # NEGATIVE clip as "disabled" — normalize the sentinel to None
+        # so the sweep kernels' is-not-None gate agrees with the oracle
+        clip = optimizer.clip_gradient
+        if clip is not None and clip < 0:
+            clip = None
+        info = {"kind": kind.lower(), "plan": plan,
+                "rescale": float(optimizer.rescale_grad), "clip": clip}
+        if kind == "SGD":
+            info["momentum"] = float(optimizer.momentum)
+        else:
+            info.update(beta1=float(optimizer.beta1),
+                        beta2=float(optimizer.beta2),
+                        epsilon=float(optimizer.epsilon))
+        return info
 
     @property
     def updates_applied(self):
         return self._updates_applied
+
+    def _sweep_update(self, diff, grads, states, lrs, wds):
+        """One-sweep fused optimizer: flatten each bucket's weights and
+        gradients into contiguous fp32 buffers and run ONE Pallas kernel
+        per bucket (ops/pallas_kernels.py) — slots live bucket-major in
+        the fused state.  lrs/wds are per-BUCKET packed scalars.
+        Returns (new_diff, new_states)."""
+        from .ops import pallas_kernels as pk
+        from .parallel.collectives import flatten_bucket, unflatten_bucket
+        sw = self._sweep
+        new_diff = list(diff)
+        new_states = []
+        for bi, (b, idxs) in enumerate(sw["plan"]):
+            wf = flatten_bucket([diff[j] for j in idxs], b)
+            gf = flatten_bucket([grads[j] for j in idxs], b)
+            if sw["kind"] == "sgd":
+                # tuple arity is static at trace time (len, not value)
+                mom = states[bi][0] if len(states[bi]) else None
+                nw, nm = pk.fused_sgd_momentum(
+                    wf, gf, mom, lr=lrs[bi], momentum=sw["momentum"],
+                    wd=wds[bi], rescale=sw["rescale"], clip=sw["clip"])
+                new_states.append((nm,) if nm is not None else ())
+            else:
+                nw, nm, nv = pk.fused_adam(
+                    wf, gf, states[bi][0], states[bi][1], lr_eff=lrs[bi],
+                    beta1=sw["beta1"], beta2=sw["beta2"],
+                    epsilon=sw["epsilon"], wd=wds[bi],
+                    rescale=sw["rescale"], clip=sw["clip"])
+                new_states.append((nm, nv))
+            views = unflatten_bucket(nw, b)
+            for j, name in zip(idxs, b.names):
+                new_diff[j] = views[name].astype(diff[j].dtype)
+        return new_diff, new_states
+
+    def _sweep_init_state(self):
+        """Bucket-major slots for the sweep (host-built zeros: no XLA
+        broadcast compile per bucket, same rationale as the per-array
+        init's _host_zeros_like)."""
+        sw = self._sweep
+        n_slots = (1 if sw["momentum"] != 0.0 else 0) \
+            if sw["kind"] == "sgd" else 2
+        return [tuple(jnp.asarray(np.zeros((b.n,), np.float32))
+                      for _ in range(n_slots))
+                for b, _idxs in sw["plan"]]
+
+    def _demote_sweep(self):
+        """Permanently fall back from the sweep to the per-array path
+        (a runtime multiplier change invalidated the bucket grouping):
+        bucket-major slots are sliced back into per-weight arrays —
+        values bit-identical, only the layout changes — and the fused
+        program rebuilds on the next dispatch."""
+        from .parallel.collectives import unflatten_bucket
+        if self._fused_state is not None:
+            per = [()] * len(self._diff_idx)
+            for bi, (b, idxs) in enumerate(self._sweep["plan"]):
+                views = [unflatten_bucket(s, b)
+                         for s in self._fused_state[bi]]
+                for j, name in zip(idxs, b.names):
+                    per[j] = tuple(v[name] for v in views)
+            self._fused_state = per
+        self._sweep = None
+        self._jit_fbu = None
 
     def _build_fbu(self):
         import jax as _jax
@@ -227,6 +351,7 @@ class Executor:
         fn_train, _cast = self._fn_train, self._cast_fn
         one = self._fused_update[2]
         codec = getattr(self, "_fused_codec", None)
+        sweep = getattr(self, "_sweep", None)
 
         def fbu(diff, rest, aux, key_data, seeds, states, resids, lrs, wds):
             # the key chain crosses the program boundary as RAW uint32
@@ -256,13 +381,18 @@ class Executor:
                     decoded.append(d.astype(g.dtype))
                     new_resids.append(nr)
                 grads = decoded
-            new_diff, new_states = [], []
-            # lrs/wds are ONE packed (n,) array each — per-scalar host
+            # lrs/wds are ONE packed array each (per weight on the
+            # per-array path, per BUCKET on the sweep) — per-scalar host
             # transfers would dominate the step on a tunneled device
-            for j, (w, g, st) in enumerate(zip(diff, grads, states)):
-                nw, nst = one(w, g, st, lrs[j], wds[j])
-                new_diff.append(nw)
-                new_states.append(nst)
+            if sweep is not None:
+                new_diff, new_states = self._sweep_update(
+                    diff, grads, states, lrs, wds)
+            else:
+                new_diff, new_states = [], []
+                for j, (w, g, st) in enumerate(zip(diff, grads, states)):
+                    nw, nst = one(w, g, st, lrs[j], wds[j])
+                    new_diff.append(nw)
+                    new_states.append(nst)
             # grads are consumed in-program (XLA frees them); they are not
             # outputs — saves an HBM round-trip per step.  backward() is a
             # no-op in fused mode (grad_dict intentionally not populated).
@@ -289,8 +419,11 @@ class Executor:
         # None placeholders where diff args go (overwritten inside the
         # program) — the donated weight buffers must not appear twice
         rest = [None if i in diff_set else a for i, a in enumerate(args)]
+        sweep = getattr(self, "_sweep", None)
         if self._fused_state is None:
-            self._fused_state = [init_state(d) for d in diff]
+            self._fused_state = (self._sweep_init_state()
+                                 if sweep is not None
+                                 else [init_state(d) for d in diff])
         if self._fused_resids is None:
             # error-feedback residuals, one per weight when a codec is
             # installed (empty pytree otherwise: ONE program shape)
@@ -302,8 +435,28 @@ class Executor:
             lr, wd = opt_mod.fused_lr_wd(optimizer, self.arg_names[i])
             lrs.append(lr)
             wds.append(wd)
-        lrs = np.asarray(lrs, np.float32)
-        wds = np.asarray(wds, np.float32)
+        if sweep is not None and any(
+                lrs[j] != lrs[idxs[0]] or wds[j] != wds[idxs[0]]
+                for _b, idxs in sweep["plan"] for j in idxs):
+            # a set_lr_mult/set_wd_mult AFTER install broke the
+            # uniform-bucket contract the plan was grouped under —
+            # permanently demote to the per-array path (slot values
+            # carried over bit-for-bit) rather than stepping bucket
+            # members with a stale group lr/wd
+            self._demote_sweep()
+            sweep = None
+        if sweep is not None:
+            # per-BUCKET scalars: every member of a bucket shares its
+            # static (lr_mult, wd_mult), so the first member's effective
+            # values are the bucket's (the per-index loop above still
+            # ran — num_update bookkeeping advances for every weight)
+            lrs = np.asarray([lrs[idxs[0]] for _b, idxs in sweep["plan"]],
+                             np.float32)
+            wds = np.asarray([wds[idxs[0]] for _b, idxs in sweep["plan"]],
+                             np.float32)
+        else:
+            lrs = np.asarray(lrs, np.float32)
+            wds = np.asarray(wds, np.float32)
         # device-resident lr/wd cache, refreshed only when the schedule
         # moves — a fresh host transfer per step would serialize against
         # the in-flight step on the tunnel backend

@@ -24,6 +24,17 @@ from .registry import register, Param as P
 
 
 def _softmax_fwd(data, multi_output):
+    """Forward probabilities of the loss layers.
+
+    Last-axis softmax rides the fused Pallas max/exp/normalize kernel
+    (``MXNET_PALLAS_SOFTMAX``; one VMEM pass instead of XLA's reduce +
+    broadcast chain) — safe here even under autodiff because the loss
+    layers' custom_vjp replaces the backward entirely.  ``multi_output``
+    (axis=1) keeps the jnp path."""
+    from .pallas_kernels import family_enabled, fused_bias_softmax
+    if (not multi_output and data.ndim >= 2
+            and family_enabled("MXNET_PALLAS_SOFTMAX")):
+        return fused_bias_softmax(data)
     return jax.nn.softmax(data, axis=1 if multi_output else -1)
 
 

@@ -461,12 +461,41 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var,
     return out.astype(data.dtype), new_mean, new_var
 
 
+def fused_bn_relu_eval(data, gamma, beta, moving_mean, moving_var,
+                       eps=1e-3, fix_gamma=True, relu=True):
+    """Inference BatchNorm(+ReLU) as ONE Pallas pass: the moving stats
+    fold into per-channel scale/bias and ``fused_scale_bias_relu``
+    applies them (+ the activation) in a single VMEM-resident sweep —
+    the MKL-DNN BN+Activation epilogue fusion, TPU-native.  NCHW; the
+    executor's eval-graph peephole (symbol.py build_graph_fn,
+    ``MXNET_PALLAS_BN_RELU``) is the call site."""
+    from .pallas_kernels import fused_scale_bias_relu
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    scale = g * lax.rsqrt(moving_var + eps)
+    bias = beta - moving_mean * scale
+    b, c, h, w = data.shape
+    flat = jnp.transpose(data, (0, 2, 3, 1)).reshape(-1, c)
+    y = fused_scale_bias_relu(flat, scale, bias, relu=relu)
+    return jnp.transpose(y.reshape(b, h, w, c), (0, 3, 1, 2))
+
+
 @register("LayerNorm", params=[
     P("axis", int, default=-1),
     P("eps", float, default=1e-5, low=0.0),
     P("output_mean_var", bool, default=False)])
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **attrs):
-    """Reference: src/operator/nn/layer_norm-inl.h."""
+    """Reference: src/operator/nn/layer_norm-inl.h.
+
+    Last-axis norms route through the fused Pallas kernel
+    (``ops/pallas_kernels.py`` — mean/var/normalize/affine in one VMEM
+    pass, custom_vjp backward) when ``MXNET_PALLAS_NORM`` is on; other
+    axes and the knob-off A/B keep the jnp reduction chain."""
+    from .pallas_kernels import (family_enabled, fused_layernorm,
+                                 fused_layernorm_eligible)
+    if (axis % data.ndim == data.ndim - 1 and data.ndim >= 2
+            and family_enabled("MXNET_PALLAS_NORM")
+            and fused_layernorm_eligible(data.shape[-1])):
+        return fused_layernorm(data, gamma, beta, float(eps))
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     out = (data - mean) * lax.rsqrt(var + eps)

@@ -1,7 +1,9 @@
 """Pallas TPU kernels for hot paths where XLA fusion is not enough.
 
 SURVEY.md §2.5/§7 names these the north star for the operator library's
-hot paths.  Two kernels live here:
+hot paths — the MPK mega-kernel thesis (PAPERS.md) applied to this
+tree's step function.  The catalog (docs/faq/perf.md has the
+when-does-it-fuse table and the ``MXNET_PALLAS_*`` knobs):
 
 - ``flash_attention`` — blockwise online-softmax attention (forward and
   backward), the kernel behind long-context attention: O(T) memory
@@ -11,10 +13,30 @@ hot paths.  Two kernels live here:
   cuDNN RNN workspace kernels (src/operator/cudnn_rnn-inl.h).
 - ``fused_scale_bias_relu`` — the inference BatchNorm + ReLU epilogue as
   one VMEM-resident pass (reference: the BN+Activation fusion MKL-DNN
-  does on CPU, nn/mkldnn/mkldnn_base-inl.h).
+  does on CPU, nn/mkldnn/mkldnn_base-inl.h).  Call sites: the
+  ``_contrib_fused_bn_relu`` operator and the executor's inference
+  BatchNorm→Activation peephole (symbol.py ``build_graph_fn``).
+- ``fused_sgd_momentum`` / ``fused_adam`` — the one-sweep fused
+  optimizer: an ENTIRE flat 1-D bucket (params, grads and optimizer
+  slots as contiguous same-layout buffers) updated in one VMEM-resident
+  pass, grid over row blocks.  Hyperparameters (lr/momentum/betas/wd/
+  clip) ride ONE scalar-prefetch operand, so an lr-schedule change is a
+  new argument value, not a new XLA program.  The kernel math mirrors
+  ``parallel/optimizer.py`` / ``ops/optimizer_ops.py`` expression by
+  expression — the per-array ``tree_map`` path is the bit-parity oracle
+  (tests/test_pallas.py asserts exact equality, padded tails included).
+- ``fused_layernorm`` — mean/var/normalize/affine in one pass per row
+  block (vs XLA's multi-kernel reduction chain), custom_vjp backward
+  with the dx kernel fused the same way.
+- ``fused_bias_softmax`` — additive-bias (mask) + max + exp + normalize
+  in one pass; forward of the non-flash attention path and the
+  SoftmaxOutput core, custom_vjp backward fused as well.
 
-Both run natively on TPU and in `interpret=True` mode everywhere else
-(CPU tests exercise the same kernel code paths).
+All kernels run natively on TPU and in `interpret=True` mode everywhere
+else (CPU tests exercise the same kernel code paths); every wrapper
+counts into ``mxnet_pallas_kernel_calls_total{kernel=...}`` (counted at
+trace/call time — inside jit a kernel is traced once per program, then
+replayed by XLA with no Python in the loop).
 
 Layout note: per-row softmax stats (m, l, lse, delta) are stored with a
 trailing 128-lane dim, every lane holding the same value — the Mosaic
@@ -33,6 +55,56 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
+
+
+def _count(kernel):
+    """Advance ``mxnet_pallas_kernel_calls_total{kernel=...}``.
+
+    Trace-time accounting: under jit each wrapper runs once per traced
+    program (XLA replays the kernel with no Python after that), eagerly
+    once per call — either way the counter says which kernels a run
+    actually instantiated, the observability leg of the mega-kernel
+    claim (docs/faq/perf.md)."""
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.counter(
+            "mxnet_pallas_kernel_calls_total",
+            "Pallas kernel instantiations by kernel name (trace/call "
+            "time: one per traced program under jit, one per call "
+            "eagerly)").labels(kernel=kernel).inc()
+
+
+def _knob(name):
+    from .. import config as _config
+    return _config.get(name)
+
+
+def family_enabled(knob):
+    """Resolve a tri-state ``MXNET_PALLAS_*`` family knob.
+
+    ``auto`` (the default) enables the family only where the kernels
+    compile natively — on TPU; everywhere else the XLA-fused fallback
+    paths are already the fast form and routing them through the
+    ``interpret=True`` emulation would be a hot-path regression (the
+    same backend gate flash attention's ``impl="auto"`` applies).
+    ``1`` forces the family on anywhere (how CPU tier-1 exercises the
+    kernel code paths in interpret mode); ``0`` disables it."""
+    v = _knob(knob)
+    if v is None or str(v).lower() in ("", "auto"):
+        return _on_tpu()
+    return str(v).lower() not in ("0", "false")
+
+
+def mesh_sweep_safe(mesh_size):
+    """Whether the one-sweep optimizer may run over buffers sharded
+    across ``mesh_size`` devices: in interpret mode the kernel lowers
+    to ordinary partitionable HLO, but the native Mosaic custom call
+    has NO GSPMD partitioning rule — inside a multi-chip pjit step XLA
+    would all-gather every bucket to full size per chip (or fail to
+    lower), forfeiting the ZeRO 1/mesh contract.  Until the sweep is
+    wrapped in shard_map, multi-chip native runs keep the per-array
+    tree_map path."""
+    return _interpret() or int(mesh_size) <= 1
 
 
 def _on_tpu():
@@ -208,6 +280,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    _count("flash_attention_fwd")
     bh, tq, d = q.shape
     tk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -241,6 +314,7 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, do):
+    _count("flash_attention_bwd")
     q, k, v, o, lse = res
     bh, tq, d = q.shape
     tk = k.shape[1]
@@ -301,6 +375,7 @@ def fused_scale_bias_relu(x, scale, bias, relu=True, block=1024):
     (N*H*W, C) layout first).  The inference BatchNorm epilogue:
     scale = gamma/sqrt(var+eps), bias = beta - mean*scale.
     """
+    _count("fused_scale_bias_relu")
     n, c = x.shape
     bn = _pick_block(n, block)
     kernel = functools.partial(_scale_bias_relu_kernel, relu=relu)
@@ -316,3 +391,439 @@ def fused_scale_bias_relu(x, scale, bias, relu=True, block=1024):
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=_interpret(),
     )(x, scale.reshape(1, c), bias.reshape(1, c))
+
+
+# ---------------------------------------------------------------------------
+# One-sweep fused optimizer over flat param buckets
+# ---------------------------------------------------------------------------
+# The trainer's ZeRO path and the executor's fused step hand the update
+# contiguous 1-D fp32 buffers (params / grads / slots in the SAME flat
+# layout — parallel/collectives.py buckets).  One kernel sweeps a whole
+# bucket: each grid step loads a (rows, 128) tile of every buffer into
+# VMEM, applies the exact per-element expressions of the tree_map path,
+# and writes the new tile — no per-parameter kernel launches, no HBM
+# round-trips between the update's elementwise stages.  Hyperparameters
+# arrive as ONE scalar-prefetch vector so schedule changes never retrace.
+
+_OPT_BLOCK_ELEMS = 128 * 1024     # default elems per grid step (auto)
+
+
+def _sweep_layout(n, block_elems):
+    """(padded_rows, block_rows): the (rows, LANES) layout of an
+    ``n``-element flat buffer, rows padded to a whole number of
+    ``block_rows``-row grid steps (block_rows itself a multiple of the
+    fp32 sublane tile, 8)."""
+    be = int(block_elems) if block_elems else 0
+    if be <= 0:
+        be = _OPT_BLOCK_ELEMS
+    block_rows = max(8, (be // LANES) // 8 * 8)
+    rows = -(-n // LANES)
+    block_rows = min(block_rows, -(-rows // 8) * 8)
+    padded_rows = -(-rows // block_rows) * block_rows
+    return padded_rows, block_rows
+
+
+def _to_rows(flat, padded_rows):
+    pad = padded_rows * LANES - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(padded_rows, LANES)
+
+
+def _hyper_vec(vals):
+    """Pack hyperparameters into the ONE scalar-prefetch operand.
+    Python floats and traced scalars mix freely; a changed VALUE is a
+    new argument, not a new program."""
+    return jnp.stack([jnp.asarray(v, jnp.float32) for v in vals])
+
+
+def _prep_sweep_grad(g, w, h_ref, i_wd, i_rescale, i_clip, use_clip):
+    """The shared gradient prologue — same expression (and grouping) as
+    ``PureSGD/PureAdam.apply`` and ``optimizer_ops._prep_grad``:
+    rescale, optional clip, decoupled-into-gradient weight decay."""
+    g = g * h_ref[i_rescale]
+    if use_clip:
+        c = h_ref[i_clip]
+        g = jnp.clip(g, -c, c)
+    return g + h_ref[i_wd] * w
+
+
+def _sgd_kernel(h_ref, w_ref, g_ref, ow_ref, *, use_clip):
+    g = _prep_sweep_grad(g_ref[:], w_ref[:], h_ref, 1, 2, 3, use_clip)
+    ow_ref[:] = w_ref[:] - h_ref[0] * g
+
+
+def _sgd_mom_kernel(h_ref, w_ref, g_ref, m_ref, ow_ref, om_ref, *,
+                    use_clip):
+    g = _prep_sweep_grad(g_ref[:], w_ref[:], h_ref, 2, 3, 4, use_clip)
+    nm = h_ref[1] * m_ref[:] - h_ref[0] * g
+    ow_ref[:] = w_ref[:] + nm
+    om_ref[:] = nm
+
+
+def _adam_kernel(h_ref, w_ref, g_ref, m_ref, v_ref, ow_ref, om_ref,
+                 ov_ref, *, use_clip):
+    # h = [lr_eff, beta1, beta2, 1-beta1, 1-beta2, eps, wd, rescale, clip]
+    g = _prep_sweep_grad(g_ref[:], w_ref[:], h_ref, 6, 7, 8, use_clip)
+    nm = h_ref[1] * m_ref[:] + h_ref[3] * g
+    nv = h_ref[2] * v_ref[:] + h_ref[4] * jnp.square(g)
+    ow_ref[:] = w_ref[:] - h_ref[0] * nm / (jnp.sqrt(nv) + h_ref[5])
+    om_ref[:] = nm
+    ov_ref[:] = nv
+
+
+def _sweep_call(kernel, hyper, flats, n_outs, block_elems):
+    """Dispatch one optimizer-sweep kernel over flat fp32 buffers."""
+    n = flats[0].shape[0]
+    padded_rows, block_rows = _sweep_layout(n, block_elems)
+    grid = (padded_rows // block_rows,)
+    spec = pl.BlockSpec((block_rows, LANES), lambda i, h: (i, 0))
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=grid,
+            in_specs=[spec] * len(flats), out_specs=[spec] * n_outs),
+        out_shape=[jax.ShapeDtypeStruct((padded_rows, LANES),
+                                        jnp.float32)] * n_outs,
+        interpret=_interpret(),
+    )(hyper, *[_to_rows(f, padded_rows) for f in flats])
+    return [o.reshape(-1)[:n] for o in outs]
+
+
+def fused_sgd_momentum(w, g, mom=None, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale=1.0, clip=None, block_elems=None):
+    """One-sweep SGD(+momentum) over a flat fp32 bucket.
+
+    ``w``/``g``/``mom`` are contiguous 1-D same-layout buffers; returns
+    ``(new_w, new_mom)`` (``new_mom`` is None when ``mom`` is None —
+    plain SGD carries no slot).  Scalars may be Python floats or traced
+    values; all ride the scalar-prefetch operand.  Bit-identical to the
+    per-array ``tree_map``/``optimizer_ops`` path by construction (same
+    expressions, same grouping); a zero-padded tail stays exactly zero
+    (0 - lr*(0 + wd*0) == 0), so bucket padding never perturbs real
+    params."""
+    if block_elems is None:
+        block_elems = _knob("MXNET_PALLAS_OPT_BLOCK_ELEMS")
+    use_clip = clip is not None
+    if mom is None:
+        _count("fused_sgd")
+        hyper = _hyper_vec([lr, wd, rescale] + ([clip] if use_clip else []))
+        kernel = functools.partial(_sgd_kernel, use_clip=use_clip)
+        (nw,) = _sweep_call(kernel, hyper, [w, g], 1, block_elems)
+        return nw, None
+    _count("fused_sgd_momentum")
+    hyper = _hyper_vec([lr, momentum, wd, rescale]
+                       + ([clip] if use_clip else []))
+    kernel = functools.partial(_sgd_mom_kernel, use_clip=use_clip)
+    nw, nm = _sweep_call(kernel, hyper, [w, g, mom], 2, block_elems)
+    return nw, nm
+
+
+def fused_adam(w, g, mean, var, lr_eff=0.001, beta1=0.9, beta2=0.999,
+               epsilon=1e-8, wd=0.0, rescale=1.0, clip=None,
+               block_elems=None):
+    """One-sweep Adam over a flat fp32 bucket.
+
+    ``lr_eff`` is the EFFECTIVE learning rate — the caller folds in the
+    bias-correction factor (``lr * sqrt(1-b2^t)/(1-b1^t)``, computed
+    outside so `t` bookkeeping stays wherever the caller keeps it).
+    ``beta1``/``beta2`` must be concrete floats: the ``1-beta`` moment
+    coefficients are computed HOST-side in double precision, matching
+    the per-array path's ``(1 - beta1) * g`` exactly (computing ``1-b``
+    from an f32 scalar on device would differ by one ulp and break bit
+    parity).  Zero-padded tails: mean/var stay 0 and the weight update
+    is -lr*0/(sqrt(0)+eps) == 0."""
+    if block_elems is None:
+        block_elems = _knob("MXNET_PALLAS_OPT_BLOCK_ELEMS")
+    _count("fused_adam")
+    use_clip = clip is not None
+    hyper = _hyper_vec(
+        [lr_eff, beta1, beta2, 1.0 - float(beta1), 1.0 - float(beta2),
+         epsilon, wd, rescale] + ([clip] if use_clip else []))
+    kernel = functools.partial(_adam_kernel, use_clip=use_clip)
+    nw, nm, nv = _sweep_call(kernel, hyper, [w, g, mean, var], 3,
+                             block_elems)
+    return nw, nm, nv
+
+
+# ---------------------------------------------------------------------------
+# Fused layernorm (fwd + custom_vjp bwd)
+# ---------------------------------------------------------------------------
+def _pad_rows(x2, br):
+    r = x2.shape[0]
+    pad = (-r) % br
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)])
+    return x2
+
+
+def _norm_block_rows(r, c, knob):
+    br = _knob(knob)
+    if not br or br <= 0:
+        br = max(8, min(256, (512 * 1024 // max(4 * c, 1)) // 8 * 8))
+    return max(8, min(int(br), -(-r // 8) * 8))
+
+
+def fused_layernorm_eligible(c):
+    """Whether the fused layernorm can run over a ``c``-wide last axis:
+    Mosaic wants whole 128-lane minor-dim tiles on real TPU (padding is
+    not an option here — pad columns would perturb the row stats);
+    interpret mode has no such constraint, so CPU tests cover ragged C."""
+    return _interpret() or c % LANES == 0
+
+
+def _layernorm_fwd_kernel(x_ref, g_ref, b_ref, o_ref, mu_ref, rs_ref, *,
+                          eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    o_ref[:] = ((xc * rstd) * g_ref[:] + b_ref[:]).astype(o_ref.dtype)
+    mu_ref[:] = jnp.broadcast_to(mu, mu_ref.shape)
+    rs_ref[:] = jnp.broadcast_to(rstd, rs_ref.shape)
+
+
+def _layernorm_bwd_kernel(x_ref, do_ref, g_ref, mu_ref, rs_ref, dx_ref):
+    x = x_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    rstd = rs_ref[:, :1]
+    xhat = (x - mu_ref[:, :1]) * rstd
+    dxh = do * g_ref[:]
+    c1 = jnp.mean(dxh, axis=1, keepdims=True)
+    c2 = jnp.mean(dxh * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (dxh - c1 - xhat * c2)).astype(dx_ref.dtype)
+
+
+def _layernorm_fwd(x, gamma, beta, eps):
+    _count("fused_layernorm_fwd")
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    r = x2.shape[0]
+    br = _norm_block_rows(r, c, "MXNET_PALLAS_NORM_BLOCK_ROWS")
+    x2p = _pad_rows(x2, br)
+    rp = x2p.shape[0]
+    spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    vspec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    sspec = pl.BlockSpec((br, LANES), lambda i: (i, 0))
+    out, mu, rstd = pl.pallas_call(
+        functools.partial(_layernorm_fwd_kernel, eps=eps),
+        grid=(rp // br,),
+        in_specs=[spec, vspec, vspec],
+        out_specs=[spec, sspec, sspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rp, c), x.dtype),
+            jax.ShapeDtypeStruct((rp, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((rp, LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x2p, gamma.reshape(1, c), beta.reshape(1, c))
+    return out[:r].reshape(x.shape), (x, gamma, mu[:r], rstd[:r])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layernorm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the LAST axis: mean/var/normalize/affine in one
+    VMEM pass per row block (stats in fp32 whatever the input dtype).
+    Backward is a fused dx kernel; dgamma/dbeta are plain row
+    reductions XLA already does in one pass each."""
+    out, _ = _layernorm_fwd(x, gamma, beta, eps)
+    return out
+
+
+def _fused_layernorm_fwd_rule(x, gamma, beta, eps):
+    return _layernorm_fwd(x, gamma, beta, eps)
+
+
+def _fused_layernorm_bwd_rule(eps, res, do):
+    x, gamma, mu, rstd = res
+    _count("fused_layernorm_bwd")
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    do2 = do.reshape(-1, c)
+    r = x2.shape[0]
+    br = _norm_block_rows(r, c, "MXNET_PALLAS_NORM_BLOCK_ROWS")
+    x2p = _pad_rows(x2, br)
+    do2p = _pad_rows(do2, br)
+    mup = _pad_rows(mu, br)
+    rsp = _pad_rows(rstd, br)
+    rp = x2p.shape[0]
+    spec = pl.BlockSpec((br, c), lambda i: (i, 0))
+    vspec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    sspec = pl.BlockSpec((br, LANES), lambda i: (i, 0))
+    dx = pl.pallas_call(
+        _layernorm_bwd_kernel,
+        grid=(rp // br,),
+        in_specs=[spec, spec, vspec, sspec, sspec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((rp, c), x.dtype),
+        interpret=_interpret(),
+    )(x2p, do2p, gamma.reshape(1, c), mup, rsp)
+    xhat = (x2.astype(jnp.float32) - mu[:, :1]) * rstd[:, :1]
+    do32 = do2.astype(jnp.float32)
+    dgamma = jnp.sum(do32 * xhat, axis=0).astype(gamma.dtype)
+    dbeta = jnp.sum(do32, axis=0).astype(gamma.dtype)
+    return dx[:r].reshape(x.shape), dgamma, dbeta
+
+
+fused_layernorm.defvjp(_fused_layernorm_fwd_rule, _fused_layernorm_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# Fused bias+softmax(+mask) (fwd + custom_vjp bwd)
+# ---------------------------------------------------------------------------
+def _softmax_fwd_kernel(x_ref, o_ref):
+    s = x_ref[:].astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _softmax_bias_fwd_kernel(x_ref, b_ref, o_ref):
+    s = x_ref[:].astype(jnp.float32) + b_ref[:]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+def _softmax_bwd_kernel(p_ref, do_ref, dx_ref):
+    p = p_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    dot = jnp.sum(p * do, axis=-1, keepdims=True)
+    dx_ref[:] = (p * (do - dot)).astype(dx_ref.dtype)
+
+
+def _softmax_call(kernel3, ops, col_fill, bias=None):
+    """Shared scaffolding of every fused-softmax pass: dispatch
+    ``kernel3`` over (B, R, C) operands (+ an optional (R, C) bias
+    shared across B, appended last, matching the kernels' ref order).
+
+    The last dim pads to whole 128-lane tiles so the Mosaic minor-dim
+    constraint holds for ragged C (e.g. 1000-class logits) on real
+    TPU; each operand pads with its own exact-identity ``col_fill``
+    value — NEG_INF for logits (their exp underflows to exactly 0, row
+    max and sum untouched), 0 for probabilities/cotangents (adds 0 to
+    the p·do row dot, dx pad comes out 0).  Rows pad with zeros; pad
+    rows and columns are sliced away before returning."""
+    b, r, c0 = ops[0].shape
+    cpad = (-c0) % LANES
+    if cpad:
+        ops = [jnp.concatenate(
+            [a, jnp.full((b, r, cpad), fill, a.dtype)], axis=2)
+            for a, fill in zip(ops, col_fill)]
+        if bias is not None:
+            bias = jnp.concatenate(
+                [bias, jnp.zeros((bias.shape[0], cpad), bias.dtype)],
+                axis=1)
+    c = c0 + cpad
+    br = _norm_block_rows(r, c, "MXNET_PALLAS_SOFTMAX_BLOCK_ROWS")
+    rpad = (-r) % br
+    if rpad:
+        ops = [jnp.concatenate([a, jnp.zeros((b, rpad, c), a.dtype)],
+                               axis=1) for a in ops]
+        if bias is not None:
+            bias = _pad_rows(bias, br)
+    rp = r + rpad
+    spec = pl.BlockSpec((None, br, c), lambda bi, i: (bi, i, 0))
+    ins = [spec] * len(ops)
+    args = list(ops)
+    if bias is not None:
+        ins.append(pl.BlockSpec((br, c), lambda bi, i: (i, 0)))
+        args.append(bias)
+    out = pl.pallas_call(
+        kernel3,
+        grid=(b, rp // br),
+        in_specs=ins,
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((b, rp, c), ops[0].dtype),
+        interpret=_interpret(),
+    )(*args)
+    return out[:, :r, :c0]
+
+
+def _softmax_fwd(x, bias):
+    _count("fused_softmax_fwd")
+    c = x.shape[-1]
+    if bias is None:
+        p = _softmax_call(_softmax_fwd_kernel, [x.reshape(1, -1, c)],
+                          [NEG_INF])
+    else:
+        if x.ndim < 2 or x.shape[-2] != bias.shape[0]:
+            raise ValueError(
+                "fused_bias_softmax: bias rows (%d) must equal x's "
+                "second-to-last dim (%s)" % (bias.shape[0], x.shape))
+        p = _softmax_call(_softmax_bias_fwd_kernel,
+                          [x.reshape(-1, bias.shape[0], c)],
+                          [NEG_INF], bias=bias.astype(jnp.float32))
+    return p.reshape(x.shape)
+
+
+def _softmax_bwd_dx(p, do):
+    _count("fused_softmax_bwd")
+    c = p.shape[-1]
+    dx = _softmax_call(_softmax_bwd_kernel,
+                       [p.reshape(1, -1, c), do.reshape(1, -1, c)],
+                       [0.0, 0.0])
+    return dx.reshape(p.shape)
+
+
+@jax.custom_vjp
+def _fused_softmax_nobias(x):
+    return _softmax_fwd(x, None)
+
+
+def _fused_softmax_nobias_fwd(x):
+    p = _softmax_fwd(x, None)
+    return p, p
+
+
+def _fused_softmax_nobias_bwd(p, do):
+    return (_softmax_bwd_dx(p, do),)
+
+
+_fused_softmax_nobias.defvjp(_fused_softmax_nobias_fwd,
+                             _fused_softmax_nobias_bwd)
+
+
+@jax.custom_vjp
+def _fused_softmax_bias(x, bias):
+    return _softmax_fwd(x, bias)
+
+
+def _fused_softmax_bias_fwd(x, bias):
+    # zero-size prototype: carries the bias's rows/dtype through the
+    # residual pytree as a REAL array (a dtype object leaf would break
+    # under jit, same constraint ops/loss.py documents)
+    p = _softmax_fwd(x, bias)
+    return p, (p, jnp.zeros((bias.shape[0], 0), bias.dtype))
+
+
+def _fused_softmax_bias_bwd(res, do):
+    p, proto = res
+    dx = _softmax_bwd_dx(p, do)
+    # softmax(x + bias): d/dbias == d/dx summed over the broadcasted
+    # leading dims (the bias is shared across them); the cotangent
+    # must come back in the bias's own dtype for the vjp aval check
+    c = p.shape[-1]
+    dbias = jnp.sum(dx.reshape(-1, proto.shape[0], c), axis=0)
+    return dx, dbias.astype(proto.dtype)
+
+
+_fused_softmax_bias.defvjp(_fused_softmax_bias_fwd,
+                           _fused_softmax_bias_bwd)
+
+
+def fused_bias_softmax(x, bias=None):
+    """softmax(x + bias) over the LAST axis in one VMEM pass per row
+    block (max/exp/normalize fused; stats in fp32).
+
+    ``bias`` is an optional additive (rows, C) mask/bias shared across
+    ``x``'s remaining leading dims — the attention-mask form: the
+    caller encodes masked positions as a large negative value (use
+    ``NEG_INF``, finite, so fully-masked tails underflow to exactly 0
+    instead of NaN).  Differentiable via a fused backward kernel; the
+    bias cotangent is the dx row-sum over the broadcast dims."""
+    if bias is None:
+        return _fused_softmax_nobias(x)
+    return _fused_softmax_bias(x, bias)

@@ -88,6 +88,31 @@ def local_attention(q, k, v, causal=False, q_offset=0, kv_offset=0,
     if kv_len is not None:
         valid = (kpos < kv_len)[None, :]
         mask = valid if mask is None else mask & valid
+    from ..ops.pallas_kernels import family_enabled
+    # fused-path gate: kv_len padding keeps the einsum form (its
+    # all-masked padded-query rows are DEFINED to come out zero via the
+    # NaN fixup), and a causal mask is only safe when every query row
+    # keeps at least one valid key (q_offset >= kv_offset ⇒ key 0 is
+    # visible to every row) — a fully-masked row under the kernel's
+    # finite NEG_INF bias would silently softmax to uniform instead of
+    # surfacing the misuse as NaN
+    if (kv_len is None and (not causal or q_offset >= kv_offset)
+            and family_enabled("MXNET_PALLAS_SOFTMAX")):
+        # fused bias+softmax(+mask) kernel: the (Tq, Tk) mask becomes an
+        # additive bias (finite NEG_INF so masked columns underflow to
+        # exactly 0), max/exp/normalize fuse into one VMEM pass per row
+        # block, backward rides the kernel's custom_vjp.  The kv_len
+        # (padded-tail) path keeps the einsum form: its all-masked
+        # padded-query rows are DEFINED to come out zero, which the
+        # -inf + NaN fixup below encodes.
+        from ..ops.pallas_kernels import NEG_INF, fused_bias_softmax
+        b, h, tq, tk = logits.shape
+        bias = None
+        if mask is not None:
+            bias = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+        probs = fused_bias_softmax(
+            logits.reshape(b * h, tq, tk), bias).reshape(b, h, tq, tk)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     if mask is not None:
         logits = jnp.where(mask[None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)

@@ -7,6 +7,18 @@ pure pytree transforms: ``init(params) -> state``,
 ``apply(params, grads, state, lr) -> (params, state)``.  The whole
 update fuses into the train-step XLA program — the reference's
 update-on-kvstore collapses into the compiled step.
+
+One-sweep fused path (the MPK mega-kernel leg, ROADMAP item 3): when
+the trainer hands ``apply`` bucketed FLAT views (``flat=True`` — 1-D
+fp32 buffers with slots allocated bucket-major, still ZeRO-sharded
+1/mesh) and ``MXNET_PALLAS_FUSED_OPT`` is on, each bucket updates in
+ONE Pallas kernel (``ops/pallas_kernels.py`` ``fused_sgd_momentum`` /
+``fused_adam``): params, grads and slots stream through VMEM once
+instead of XLA's per-stage elementwise kernels, and lr/betas/wd ride a
+scalar-prefetch operand so schedule changes never retrace.  The
+``tree_map`` path below stays byte-for-byte as the fallback AND the
+bit-parity oracle (tests/test_pallas.py / test_parallel_zero.py assert
+exact equality, padded tails and checkpoint cycles included).
 """
 from __future__ import annotations
 
@@ -16,6 +28,11 @@ import jax.numpy as jnp
 from ..base import MXNetError
 
 __all__ = ["PureSGD", "PureAdam", "make_optimizer", "sharded_zeros_like"]
+
+
+def _fused_sweep_on(flat):
+    from ..ops.pallas_kernels import family_enabled
+    return flat and family_enabled("MXNET_PALLAS_FUSED_OPT")
 
 
 def sharded_zeros_like(params, shardings):
@@ -63,11 +80,35 @@ class PureSGD:
         lockstep with :meth:`init` (tests/test_plan.py asserts the two
         agree byte-for-byte against real shardings)."""
         return {"slots": [] if self.momentum == 0.0 else ["mom"],
-                "scalar_slots": []}
+                "scalar_slots": [],
+                "fused_sweep": _fused_sweep_on(True)}
 
-    def apply(self, params, grads, state, lr=None):
+    def apply(self, params, grads, state, lr=None, flat=False):
+        """``flat=True`` marks the leaves as bucketed flat views (1-D
+        fp32 buffers, slots bucket-major) — the contract under which
+        the one-sweep Pallas path may take over; the per-array
+        ``tree_map`` below is its bit-parity oracle."""
         lr = self.lr if lr is None else lr
         clip = self.clip_gradient
+
+        if _fused_sweep_on(flat):
+            # flat contract: params is a plain {bucket_key: 1-D fp32
+            # buffer} dict and slots share its keys — sweep each bucket
+            # in one kernel
+            from ..ops import pallas_kernels as pk
+            new_params, new_mom = {}, {}
+            for k in params:
+                nw, nm = pk.fused_sgd_momentum(
+                    params[k], grads[k],
+                    None if self.momentum == 0.0 else state["mom"][k],
+                    lr=lr, momentum=self.momentum, wd=self.wd,
+                    rescale=self.rescale_grad, clip=clip)
+                new_params[k] = nw
+                if nm is not None:
+                    new_mom[k] = nm
+            if self.momentum == 0.0:
+                return new_params, state
+            return new_params, {"mom": new_mom}
 
         def prep(g, w):
             g = g * self.rescale_grad
@@ -113,15 +154,35 @@ class PureAdam:
         :meth:`init` returns it unconditionally, so under ZeRO it
         exists once per state subtree (fused AND perparam) — the
         predictor models exactly that."""
-        return {"slots": ["mean", "var"], "scalar_slots": [["t", 4]]}
+        return {"slots": ["mean", "var"], "scalar_slots": [["t", 4]],
+                "fused_sweep": _fused_sweep_on(True)}
 
-    def apply(self, params, grads, state, lr=None):
+    def apply(self, params, grads, state, lr=None, flat=False):
+        """See :meth:`PureSGD.apply` for the ``flat`` contract."""
         lr = self.lr if lr is None else lr
         t = state["t"] + 1
         b1, b2 = self.beta1, self.beta2
         coef = jnp.sqrt(1 - b2 ** t.astype(jnp.float32)) / \
             (1 - b1 ** t.astype(jnp.float32))
         clip = self.clip_gradient
+
+        if _fused_sweep_on(flat):
+            from ..ops import pallas_kernels as pk
+            # lr * coef FIRST — the same grouping the tree_map update
+            # evaluates (w - ((lr*coef)*m)/(sqrt(v)+eps)), so the fused
+            # sweep is bit-identical; t bookkeeping stays out here
+            lr_eff = lr * coef
+            new_params, new_mean, new_var = {}, {}, {}
+            for k in params:
+                nw, nm, nv = pk.fused_adam(
+                    params[k], grads[k], state["mean"][k],
+                    state["var"][k], lr_eff=lr_eff, beta1=b1, beta2=b2,
+                    epsilon=self.epsilon, wd=self.wd,
+                    rescale=self.rescale_grad, clip=clip)
+                new_params[k] = nw
+                new_mean[k] = nm
+                new_var[k] = nv
+            return new_params, {"mean": new_mean, "var": new_var, "t": t}
 
         def prep(g, w):
             g = g * self.rescale_grad

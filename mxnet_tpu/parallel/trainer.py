@@ -370,11 +370,21 @@ class ParallelTrainer:
                 "trainable": bool(t),
                 "spec": normalize_pspec(self._pspecs[name], arr.ndim),
                 "fused": name in fused})
+        from ..ops.pallas_kernels import mesh_sweep_safe
+        opt_spec = self._opt.slot_spec()
+        # the sweep engages only where the step hands the optimizer
+        # flat bucket views (zero>=1) AND the mesh supports the kernel
+        # (mesh_sweep_safe); a zero=0 or native-multi-chip trainer runs
+        # the per-array path whatever the knob says, and the memory
+        # model's update_temp component must reflect the path that
+        # actually runs
+        opt_spec["fused_sweep"] = bool(opt_spec.get("fused_sweep")) \
+            and self._zero >= 1 and mesh_sweep_safe(mesh.size)
         return {
             "mesh": [[a, int(mesh.shape[a])] for a in mesh.axis_names],
             "params": params,
             "zero": self._zero,
-            "optimizer": self._opt.slot_spec(),
+            "optimizer": opt_spec,
             "buckets": [b.to_dict() for b in self._plan],
             "codec": ({"name": self._codec.name}
                       if self._codec is not None else None),
@@ -527,6 +537,8 @@ class ParallelTrainer:
         back into the replicated master params."""
         mesh = self._mesh
         plan, codec, zero = self._plan, self._codec, self._zero
+        from ..ops.pallas_kernels import mesh_sweep_safe
+        flat_sweep_ok = mesh_sweep_safe(mesh.size)
         perparam_names = list(self._perparam_names)
         zero_ns = NamedSharding(mesh, self._zero_spec)
         rep_ns = NamedSharding(mesh, P())
@@ -589,8 +601,15 @@ class ParallelTrainer:
                 p_shards["b%d" % b.index] = \
                     jax.lax.with_sharding_constraint(fl, zero_ns)
                 g_shards["b%d" % b.index] = gshard
+            # flat buckets (1-D fp32 views, bucket-major slots) let the
+            # optimizer take the one-sweep Pallas path
+            # (MXNET_PALLAS_FUSED_OPT; tree_map stays the parity
+            # oracle) — gated off on native multi-chip meshes where the
+            # Mosaic call has no GSPMD partitioning rule
+            # (pallas_kernels.mesh_sweep_safe)
             new_shards, new_fused_state = opt.apply(
-                p_shards, g_shards, opt_state["fused"])
+                p_shards, g_shards, opt_state["fused"],
+                flat=flat_sweep_ok)
             new_fused = {}
             for b in plan:
                 # the all-gather: shard-updated flat buffer back to the

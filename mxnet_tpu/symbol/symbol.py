@@ -492,12 +492,60 @@ def _head_key(head):
 # ---------------------------------------------------------------------------
 # graph lowering + inference (the executor uses these too)
 # ---------------------------------------------------------------------------
+def _bn_relu_peephole(symbol, nodes, is_train):
+    """Eval-graph BatchNorm→Activation(relu) fusion plan.
+
+    In inference the BatchNorm is a pure per-channel affine; when its
+    only consumer is a relu Activation (and neither output is a graph
+    head), the pair runs as ONE ``fused_scale_bias_relu`` Pallas pass
+    (ops/nn.py ``fused_bn_relu_eval`` — the MKL-DNN BN+Activation
+    epilogue, TPU-native).  Returns ``(skip, fuse)``: BatchNorm node
+    ids to defer, and {activation node id: its BatchNorm node}.  Empty
+    in training (batch stats + aux writeback must run) and when
+    ``MXNET_PALLAS_BN_RELU`` is off."""
+    from ..ops.pallas_kernels import family_enabled
+    if is_train or not family_enabled("MXNET_PALLAS_BN_RELU"):
+        return frozenset(), {}
+    consumers = {}
+    for n in nodes:
+        if n.is_variable:
+            continue
+        for (src, oi) in n.inputs:
+            consumers.setdefault((id(src), oi), []).append(n)
+    heads = {(id(n), i) for (n, i) in symbol._flat_outputs()}
+    skip, fuse = set(), {}
+    for node in nodes:
+        if node.is_variable or node.op.name != "Activation":
+            continue
+        if coerce_attrs(node.attrs).get("act_type") != "relu":
+            continue
+        src, oi = node.inputs[0]
+        if oi != 0 or src.is_variable or src.op.name != "BatchNorm":
+            continue
+        battrs = coerce_attrs(src.attrs)
+        if int(battrs.get("axis", 1)) != 1 or battrs.get("output_mean_var"):
+            continue
+        if (id(src), 0) in heads or (id(src), 1) in heads \
+                or (id(src), 2) in heads:
+            continue
+        if len(consumers.get((id(src), 0), ())) != 1:
+            continue
+        # outputs 1/2 (mean/var) must be entirely unused: skipping the
+        # BN node leaves their env slots unpopulated
+        if consumers.get((id(src), 1)) or consumers.get((id(src), 2)):
+            continue
+        skip.add(id(src))
+        fuse[id(node)] = src
+    return frozenset(skip), fuse
+
+
 def build_graph_fn(symbol, arg_names, aux_names, is_train):
     """Lower the symbol DAG to one pure function
     fn(arg_list, aux_list, rng_key) -> (outputs, new_aux_list)."""
     nodes = symbol._topo()
     aux_index = {name: i for i, name in enumerate(aux_names)}
     arg_index = {name: i for i, name in enumerate(arg_names)}
+    bn_skip, bn_fuse = _bn_relu_peephole(symbol, nodes, is_train)
 
     def fn(args, aux, rng_key):
         env = {}
@@ -510,6 +558,30 @@ def build_graph_fn(symbol, arg_names, aux_names, is_train):
                     env[(id(node), 0)] = args[arg_index[node.name]]
                 else:
                     raise MXNetError("unbound variable %s" % node.name)
+                continue
+            if id(node) in bn_skip:
+                # deferred into the fused Activation below; in eval the
+                # moving stats are untouched, so skipping the aux
+                # writeback changes nothing
+                continue
+            if id(node) in bn_fuse:
+                bn = bn_fuse[id(node)]
+                ins = [env[(id(s), i)] for (s, i) in bn.inputs]
+                battrs = coerce_attrs(bn.attrs)
+                if ins[0].ndim == 4:
+                    from ..ops.nn import fused_bn_relu_eval
+                    env[(id(node), 0)] = fused_bn_relu_eval(
+                        *ins, eps=float(battrs.get("eps", 1e-3)),
+                        fix_gamma=bool(battrs.get("fix_gamma", True)))
+                else:
+                    # non-4D data: run the pair unfused
+                    kw = dict(bn.op.attr_defaults)
+                    kw.update({k: v for k, v in battrs.items()
+                               if k not in ("__layout__",)
+                               and not k.startswith("__")})
+                    kw["__is_train__"] = False
+                    env[(id(node), 0)] = jnp.maximum(
+                        bn.op.fn(*ins, **kw)[0], 0)
                 continue
             op = node.op
             attrs = coerce_attrs(node.attrs)

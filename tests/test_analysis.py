@@ -1507,3 +1507,76 @@ def test_missing_donation_each_cross_module_bind_judged_alone(tmp_path):
     }, rule="missing-donation")
     assert len(findings) == 1
     assert findings[0].path.endswith("bad_bind.py")
+
+
+# -- pallas-fallback ----------------------------------------------------------
+
+_KERNELS_SRC = """
+    from jax.experimental import pallas as pl
+
+    def _dispatch(x):
+        return pl.pallas_call(None)(x)
+
+    def covered_kernel(x):
+        return _dispatch(x)
+
+    def orphan_kernel(x):
+        return pl.pallas_call(None)(x)
+
+    def not_a_kernel(x):
+        # public helper with no pallas_call in reach: never flagged
+        return x + 1
+"""
+
+
+def test_pallas_fallback_flags_untested_kernel_and_call_site(tmp_path):
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_k.py").write_text(
+        "from pkg.pallas_kernels import covered_kernel\n")
+    findings = _pkg(tmp_path, {
+        "pallas_kernels.py": _KERNELS_SRC,
+        "caller.py": """
+            from .pallas_kernels import orphan_kernel, covered_kernel
+
+            def use(x):
+                return orphan_kernel(covered_kernel(x))
+        """,
+    }, rule="pallas-fallback")
+    # orphan_kernel: flagged at its def AND its call site; covered_kernel
+    # is mentioned by a test file and stays silent
+    assert sorted((f.path.split("/")[-1], f.symbol) for f in findings) == [
+        ("caller.py", "orphan_kernel"),
+        ("pallas_kernels.py", "orphan_kernel")]
+    assert all("orphan_kernel" in f.message for f in findings)
+
+
+def test_pallas_fallback_tested_kernels_stay_silent(tmp_path):
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "test_k.py").write_text(
+        "import pkg.pallas_kernels as pk\n"
+        "def test_all():\n"
+        "    pk.covered_kernel(1)\n"
+        "    pk.orphan_kernel(2)\n")
+    findings = _pkg(tmp_path, {
+        "pallas_kernels.py": _KERNELS_SRC,
+        "caller.py": """
+            from .pallas_kernels import orphan_kernel
+
+            def use(x):
+                return orphan_kernel(x)
+        """,
+    }, rule="pallas-fallback")
+    assert findings == []
+
+
+def test_pallas_fallback_suppression_wins(tmp_path):
+    (tmp_path / "tests").mkdir()
+    findings = _pkg(tmp_path, {
+        "pallas_kernels.py": """
+            from jax.experimental import pallas as pl
+
+            def quiet_kernel(x):  # graftlint: disable=pallas-fallback
+                return pl.pallas_call(None)(x)
+        """,
+    }, rule="pallas-fallback")
+    assert findings == []

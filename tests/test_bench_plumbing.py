@@ -45,12 +45,17 @@ def test_all_legs_run_within_budget(bench_mod, tmp_path, capsys,
     ab = json.loads((tmp_path / "BENCH_NHWC.json").read_text())
     rd = json.loads((tmp_path / "BENCH_RIDERS.json").read_text())
     assert ab["nhwc_vs_nchw"] == 1.0
+    assert rd["pallas_unfused_vs_baseline"] == 1.0
     assert rd["stem_s2d_vs_baseline"] == 1.0
     assert rd["unfused_metric_vs_baseline"] == 1.0
-    # primary + nhwc + 2 riders
-    assert len(bench_mod._test_calls) == 4
+    # primary + nhwc + 3 riders
+    assert len(bench_mod._test_calls) == 5
     assert {"MXNET_STEM_SPACE_TO_DEPTH": "1"} in bench_mod._test_calls
     assert {"MXNET_FUSED_METRIC": "0"} in bench_mod._test_calls
+    # the pallas A/B rider turns the WHOLE mega-kernel family off
+    assert {"MXNET_PALLAS_FUSED_OPT": "0", "MXNET_PALLAS_NORM": "0",
+            "MXNET_PALLAS_SOFTMAX": "0",
+            "MXNET_PALLAS_BN_RELU": "0"} in bench_mod._test_calls
 
 
 def test_exhausted_budget_skips_secondary_legs(bench_mod, tmp_path,
@@ -63,6 +68,7 @@ def test_exhausted_budget_skips_secondary_legs(bench_mod, tmp_path,
     rd = json.loads((tmp_path / "BENCH_RIDERS.json").read_text())
     assert "nhwc_skipped" in ab
     assert "stem_s2d_skipped" in rd and "unfused_metric_skipped" in rd
+    assert "pallas_unfused_skipped" in rd
     assert len(bench_mod._test_calls) == 1  # primary only
 
 

@@ -240,3 +240,139 @@ def test_accuracy_device_accumulation():
     m2 = mx.metric.Accuracy()
     m2.update([np.array([1, 0])], [np.array([[0.1, 0.9], [0.2, 0.8]])])
     assert abs(m2.get()[1] - 0.5) < 1e-6
+
+
+def test_module_pallas_sweep_matches_per_array(monkeypatch):
+    """The executor's one-sweep Pallas update (MXNET_PALLAS_FUSED_OPT,
+    default on) must train to EXACTLY the per-array kernel stream's
+    weights — same expressions, same grouping, flatten/slice is
+    value-preserving.  Weights group by static (lr_mult, wd_mult):
+    biases/norms ride a wd=0 bucket (reference wd_mult convention)."""
+    sym = _toy_symbol()
+    x, y = _toy_data()
+
+    def train(knob, opt, opt_params):
+        monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", knob)
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, num_epoch=2, kvstore="tpu", optimizer=opt,
+                optimizer_params=opt_params,
+                initializer=mx.init.Xavier(), force_init=True,
+                force_rebind=True)
+        exe = mod._exec_group.execs[0]
+        args, _ = mod.get_params()
+        return ({k: v.asnumpy() for k, v in args.items()},
+                getattr(exe, "_sweep", None))
+
+    for opt, params in (("sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                                 "wd": 0.01}),
+                        ("adam", {"learning_rate": 0.01, "wd": 0.001})):
+        w_sweep, sweep = train("1", opt, params)
+        w_array, off = train("0", opt, params)
+        assert sweep is not None, "sweep did not engage"
+        assert off is None, "knob=0 must fall back to the per-array path"
+        assert len(sweep["plan"]) >= 2   # wd_mult split biases out
+        for k in w_sweep:
+            np.testing.assert_array_equal(w_sweep[k], w_array[k],
+                                          err_msg="%s/%s" % (opt, k))
+
+
+def test_fused_sweep_lr_schedule_no_recompile(monkeypatch):
+    """ACCEPTANCE: lr/wd ride the sweep kernel's scalar-prefetch
+    operand — an lr-schedule change is a new argument VALUE, so the
+    fused step's jit cache must not grow across a sweep of lr values
+    (mxnet_xla_compiles_total stays flat in steady state)."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    sym = _toy_symbol()
+    x, y = _toy_data(32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    exe = mod._exec_group.execs[0]
+    assert getattr(exe, "_sweep", None) is not None
+    batch = next(iter(it))
+    it.reset()
+    # two warm steps: the first dispatch seeds the key from the host
+    # chain, the second consumes the device-resident key the step
+    # emits — a one-time (pre-existing) retrace unrelated to lr
+    for _ in range(2):
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert exe._jit_fbu is not None
+    before = exe._jit_fbu._cache_size()
+    for lr in (0.05, 0.02, 0.01, 0.004):
+        mod._optimizer.set_learning_rate(lr)
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert exe._jit_fbu._cache_size() == before, \
+        "lr change retraced the fused step"
+
+
+def test_sweep_negative_clip_sentinel_means_disabled(monkeypatch):
+    """clip_gradient=-1.0 is the per-array kernels' 'disabled' sentinel
+    (_prep_grad gates on clip >= 0) — the sweep plan must normalize it
+    to None, not clip every gradient into [1, -1]."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    sym = _toy_symbol()
+    x, y = _toy_data(32)
+    it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                           label_name="softmax_label")
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "clip_gradient": -1.0})
+    exe = mod._exec_group.execs[0]
+    assert exe._sweep is not None
+    assert exe._sweep["clip"] is None
+
+
+def test_sweep_demotes_on_runtime_mult_change(monkeypatch):
+    """set_lr_mult AFTER install breaks the uniform-bucket contract:
+    the executor must demote to the per-array path (slot values carried
+    over) instead of stepping with a stale group lr — final weights
+    must match a run that was per-array throughout."""
+    sym = _toy_symbol()
+    x, y = _toy_data(32)
+
+    def train(knob):
+        monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", knob)
+        mx.random.seed(7)
+        np.random.seed(7)
+        it = mx.io.NDArrayIter(x, y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label, force_rebind=True)
+        mod.init_params(mx.init.Xavier(), force_init=True)
+        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "momentum": 0.9},
+                           force_init=True)
+        batch = next(iter(it))
+        for step in range(4):
+            if step == 2:
+                mod._optimizer.set_lr_mult({"fc_weight": 0.1})
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+        exe = mod._exec_group.execs[0]
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}, exe
+
+    w_sweep, exe = train("1")
+    assert exe._sweep is None, "mult change must demote the sweep"
+    w_array, _ = train("0")
+    for k in w_sweep:
+        np.testing.assert_array_equal(w_sweep[k], w_array[k], err_msg=k)

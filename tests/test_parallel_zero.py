@@ -370,3 +370,85 @@ def test_step_logger_carries_collective_column(tmp_path):
     from mxnet_tpu.telemetry.step_logger import _DELTA_METRICS
     assert "mxnet_collective_bytes_total" in _DELTA_METRICS
     assert "mxnet_collective_ops_total" in _DELTA_METRICS
+
+
+# -- one-sweep fused optimizer (PR 12, MXNET_PALLAS_FUSED_OPT) ---------------
+
+def _slots_np(trainer):
+    sd = trainer.state_dict()
+    return {(s, k): np.asarray(v) for s in sorted(sd["slots"])
+            for k, v in sorted(sd["slots"][s].items())}
+
+
+@pytest.mark.parametrize("zero", [0, 1, 2])
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_trainer_fused_sweep_matches_treemap(zero, optimizer, monkeypatch):
+    """End-to-end trainer: the Pallas one-sweep update vs the per-array
+    tree_map oracle, zero ∈ {0, 1, 2}.
+
+    Tolerance note: the UPDATE itself is bit-identical on identical
+    inputs — tests/test_pallas.py asserts exact equality including
+    these ZeRO layouts and over multi-step sequences.  Here the two
+    runs are differently-composed WHOLE-STEP XLA CPU programs, whose
+    FMA-contraction choices (e.g. around `momentum*m - lr*g` or the
+    backward's reductions) legitimately differ by 1-3 ulps per step
+    (measured; docs/faq/perf.md) — so end-to-end asserts a 1e-6
+    absolute band, not bits."""
+    def run(knob, steps):
+        monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", knob)
+        tr = _trainer(_make_net(), zero=zero, optimizer=optimizer)
+        losses = _train(tr, steps=steps)
+        return tr, losses
+    tf, lf = run("1", 4)
+    tu, lu = run("0", 4)
+    np.testing.assert_allclose(lf, lu, rtol=0, atol=1e-5)
+    # separately-built nets get fresh gluon name suffixes; sorted
+    # order still pairs the same parameters
+    for (n, a), (_, b) in zip(sorted(_params_np(tf).items()),
+                              sorted(_params_np(tu).items())):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6,
+                                   err_msg="%s/%s/%s" % (zero, optimizer, n))
+    for (k, a), (_, b) in zip(sorted(_slots_np(tf).items()),
+                              sorted(_slots_np(tu).items())):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6,
+                                   err_msg="%s/%s/%s" % (zero, optimizer, k))
+
+
+def test_trainer_fused_sweep_checkpoint_cycle_bit_identical(monkeypatch):
+    """ACCEPTANCE: fused sweep + checkpoint save/restore cycle is
+    bit-identical to the uninterrupted fused run — the bucket-major
+    slot layout survives the per-param slicing of state_dict and the
+    re-flattening of load_state_dict exactly."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    net = _make_net()          # ONE net: checkpoint restore pairs by name
+    oracle = _trainer(net, zero=2, optimizer="sgd")
+    _train(oracle, steps=4)
+
+    first = _trainer(net, zero=2, optimizer="sgd")
+    _train(first, steps=2)
+    snap = first.state_dict()
+    resumed = _trainer(net, zero=2, optimizer="sgd")
+    resumed.load_state_dict(snap)
+    _train(resumed, steps=2)
+
+    for (n, a), (_, b) in zip(sorted(_params_np(oracle).items()),
+                              sorted(_params_np(resumed).items())):
+        assert np.array_equal(a, b), n
+    for (k, a), (_, b) in zip(sorted(_slots_np(oracle).items()),
+                              sorted(_slots_np(resumed).items())):
+        assert np.array_equal(a, b), k
+
+
+def test_trainer_fused_sweep_plan_predictions_stay_exact(monkeypatch):
+    """graftplan closed loop with the fused sweep ON: bucket-major slot
+    layout is unchanged, so predicted optimizer-state bytes (and comm)
+    must still equal the measured values byte-for-byte."""
+    from mxnet_tpu.analysis.plan import (PlanSpec, predict_comm,
+                                         predict_opt_state)
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    for zero in (1, 2):
+        tr = _trainer(_make_net(), zero=zero)
+        spec = PlanSpec.from_trainer(tr)
+        assert spec.optimizer.get("fused_sweep") is True
+        assert predict_opt_state(spec) == tr.optimizer_state_bytes()
+        assert predict_comm(spec) == tr.comm_stats()

@@ -514,3 +514,36 @@ def test_cli_plan_roundtrip():
     assert {"trainer/zero0-dp8", "trainer/zero1-dp8",
             "trainer/zero2-dp8", "serving/warmup-ladder"} <= names
     assert doc["summary"]["new"] == 0
+
+
+def test_predict_memory_update_temp_models_fused_sweep(monkeypatch):
+    """The fused one-sweep update stages bucket blocks through VMEM
+    only — no per-param HBM temporaries — so predict_memory's
+    ``update_temp`` is 0 with the sweep on and the largest update
+    buffer with it off (the per-array path's transient)."""
+    from mxnet_tpu.analysis.plan.memory import predict_memory
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    tr = _trainer(2)
+    spec = PlanSpec.from_trainer(tr)
+    fused = predict_memory(spec)
+    assert spec.optimizer.get("fused_sweep") is True
+    assert fused["update_temp"] == 0
+    spec.optimizer["fused_sweep"] = False
+    unfused = predict_memory(spec)
+    n = spec.mesh.size
+    assert unfused["update_temp"] == max(
+        4 * b["padded_n"] // n for b in spec.buckets)
+    assert unfused["total"] == fused["total"] + unfused["update_temp"]
+    # zero=0 runs the per-array path whatever the knob says: the
+    # exported spec must NOT claim the sweep (update_temp stays real)
+    z0 = PlanSpec.from_trainer(_trainer(0))
+    assert not z0.optimizer.get("fused_sweep")
+    assert predict_memory(z0)["update_temp"] > 0
+    # program/serving specs run no optimizer update at all — no
+    # phantom transient even though they carry trainable params
+    import mxnet_tpu as mx
+    d = mx.sym.var("data")
+    sym = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="write", data=(2, 8))
+    prog = PlanSpec.from_executor(exe)
+    assert predict_memory(prog)["update_temp"] == 0
