@@ -107,6 +107,41 @@ def _state_cols(trainer):
     }
 
 
+def _ir_witness_cols(trainer, batch, image_size):
+    """THIRD witness for the collective schedule, at the jaxpr level
+    (graftir, analysis/ir/): _state_cols proved the plan's schedule
+    equals comm_stats (and PR 11 closed comm_stats against the live
+    ``mxnet_collective_bytes_total`` counters); this abstractly traces
+    the trainer's ACTUAL compiled step and asserts its collective
+    multiset equals the same schedule — so the plan, the counters and
+    the emitted program all agree.  Tracing only, nothing compiles;
+    honors MXNET_IR."""
+    from mxnet_tpu import config as _config
+    if not _config.get("MXNET_IR"):
+        return {"ir_collective_match": None}
+    try:
+        from mxnet_tpu.analysis.ir.catalog import trainer_report
+        from mxnet_tpu.analysis.plan import PlanSpec
+        spec = PlanSpec.from_trainer(trainer)
+        rep = trainer_report(
+            trainer, spec,
+            data_shape=(batch, 3, image_size, image_size))
+    except Exception as exc:
+        # an incidental trace failure must not void a multi-minute
+        # hardware sweep; a MISMATCH below still fails hard, exactly
+        # like _state_cols' prediction assert
+        return {"ir_collective_match": None,
+                "ir_error": "trace failed: %s" % (exc,)}
+    assert sorted(rep["schedule_expect"]) == \
+        sorted(rep["schedule_actual"]), \
+        "graftir: jaxpr collective multiset != plan schedule " \
+        "(expect %s, traced %s)" % (rep["schedule_expect"],
+                                    rep["schedule_actual"])
+    return {"ir_collective_match": True,
+            "ir_predicted_flops": rep["cost"]["flops"],
+            "ir_predicted_bytes": rep["cost"]["bytes"]}
+
+
 def reduction_ab_leg(width, image_size, compression, optimizer):
     """zero=0 monolithic all-reduce vs zero=2 reduce-scatter + sharded
     update at the widest mesh — the ISSUE 7 acceptance comparison,
@@ -194,6 +229,10 @@ def main():
                else "throughput_vs_%ddev_base" % base_w)
         row[key] = round(sps / base, 3)
         row.update(_state_cols(trainer))
+        if w == max(x for x in widths if x <= n):
+            # the live 8-device leg carries the jaxpr witness (tracing
+            # the step once per sweep keeps the harness fast)
+            row.update(_ir_witness_cols(trainer, batch, args.image_size))
         rows.append(row)
         print("%6d %12.1f %9.0f%% %14d %14d" % (
             w, sps, 100 * eff, row["collective_bytes_per_step"],

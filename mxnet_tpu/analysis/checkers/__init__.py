@@ -4,6 +4,7 @@ from . import c_api_contract     # noqa: F401
 from . import env_knobs          # noqa: F401
 from . import global_mutation    # noqa: F401
 from . import host_sync          # noqa: F401
+from . import ir_rules           # noqa: F401
 from . import lock_discipline    # noqa: F401
 from . import mesh_contract      # noqa: F401
 from . import missing_donation   # noqa: F401

@@ -1,0 +1,210 @@
+"""IR checkers — graftir verdicts as graftlint rules.
+
+Five rules consuming :mod:`mxnet_tpu.analysis.ir` trace reports (pure
+data) instead of source files: ``check()`` is inert in the file-walk
+pass (``suffixes = ()``), and ``check_ir(report, ctx)`` runs under
+``tools/lint.py --ir`` / ``--all`` (and the tier-1 gate in
+``tests/test_ir.py``) over the traced in-tree program catalog.  Same
+:class:`~..core.Finding` machinery — fingerprints, SARIF, committed
+baseline (``--ir --update-baseline`` is the acceptance path for a
+deliberate finding); findings anchor to the source file that owns the
+traced program with the program name as the enclosing symbol.
+
+| rule | catches |
+|---|---|
+| ``ir-donation-lost``       | a declared ``donate_argnums`` input the lowering did not alias to any output (silently un-donated buffer: the step pays a copy every dispatch) |
+| ``ir-dtype-drift``         | f64 values in the traced program (visible because graftir traces under ``enable_x64``) and unintended forward bf16→f32 promotions |
+| ``ir-dead-output``         | flop-bearing equations whose results reach no program output (dropped residuals / computed-but-unused outputs) |
+| ``ir-collective-schedule`` | the traced program's collective multiset differing from ``plan/schedule.py``'s static schedule |
+| ``ir-pallas-presence``     | an enabled ``MXNET_PALLAS_*`` family whose kernels are missing from the traced step (silent fallback), or kernels present while the family resolves off |
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["IrDonationLostChecker", "IrDtypeDriftChecker",
+           "IrDeadOutputChecker", "IrCollectiveScheduleChecker",
+           "IrPallasPresenceChecker", "ir_checkers",
+           "run_ir_checkers", "IR_RULES"]
+
+IR_RULES = frozenset((
+    "ir-donation-lost", "ir-dtype-drift", "ir-dead-output",
+    "ir-collective-schedule", "ir-pallas-presence"))
+
+
+class _IrChecker(Checker):
+    """Base: inert in the file walk, active in the IR pass."""
+
+    suffixes = ()
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []
+
+    def _finding(self, report, message):
+        return Finding(self.rule, self.severity, report["origin"], 1,
+                       message, symbol=report["name"])
+
+    def check_ir(self, report, ctx):
+        raise NotImplementedError
+
+
+@register
+class IrDonationLostChecker(_IrChecker):
+    rule = "ir-donation-lost"
+    severity = "error"
+
+    def check_ir(self, report, ctx):
+        don = report.get("donation") or {}
+        if not don.get("checked"):
+            return []
+        return [self._finding(
+            report,
+            "declared donation of %s is not aliased in the lowered "
+            "program — %s; the buffer is copied every dispatch "
+            "(declared %d, aliased %d)"
+            % (lost["path"], lost["reason"], don["declared"],
+               don["aliased"]))
+            for lost in don.get("lost", ())]
+
+
+@register
+class IrDtypeDriftChecker(_IrChecker):
+    rule = "ir-dtype-drift"
+    severity = "error"
+
+    def check_ir(self, report, ctx):
+        out = []
+        for site in report.get("f64", ()):
+            out.append(self._finding(
+                report,
+                "%s value %s produced by %s at %s — an f64 leak "
+                "doubles bytes and falls off the TPU fast path; cast "
+                "explicitly or allowlist via MXNET_IR_F64_ALLOWLIST"
+                % (site["dtype"], tuple(site["shape"]), site["prim"],
+                   site["site"] or "<top level>")))
+        for site in report.get("promotions", ()):
+            out.append(self._finding(
+                report,
+                "forward bf16->f32 promotion of %s at %s — an "
+                "accumulation upcast the amp policy did not declare; "
+                "scope it mx_decode_fp32/mx_master_fp32 if deliberate"
+                % (tuple(site["shape"]), site["site"] or "<top level>")))
+        return out
+
+
+@register
+class IrDeadOutputChecker(_IrChecker):
+    rule = "ir-dead-output"
+    severity = "warning"
+
+    # dead-flop floor per source site: traced jaxprs carry a few tiny
+    # dead eqns from jax's own AD/library expansions (e.g. the
+    # where-masks of log_softmax's jvp — XLA DCEs them for free); the
+    # rule is after dropped WORK — residuals and outputs — which is
+    # orders of magnitude above this
+    MIN_FLOPS = 512
+
+    def check_ir(self, report, ctx):
+        return [self._finding(
+            report,
+            "dead computation at %s: %d eqn%s (%s) totaling %d flops "
+            "reach no program output — a dropped residual/output; "
+            "delete it or return it"
+            % (site["site"] or "<top level>", site["eqns"],
+               "s" if site["eqns"] != 1 else "",
+               ", ".join(site["prims"]), site["flops"]))
+            for site in report.get("dead", ())
+            if site["flops"] >= self.MIN_FLOPS]
+
+
+@register
+class IrCollectiveScheduleChecker(_IrChecker):
+    rule = "ir-collective-schedule"
+    severity = "error"
+
+    def check_ir(self, report, ctx):
+        expect = report.get("schedule_expect")
+        actual = report.get("schedule_actual")
+        if expect is None or actual is None:
+            return []
+
+        def _multiset(entries):
+            out = {}
+            for e in entries:
+                key = (e[0], tuple(e[1]), int(e[2]))
+                out[key] = out.get(key, 0) + 1
+            return out
+
+        want, have = _multiset(expect), _multiset(actual)
+        if want == have:
+            return []
+        missing = sorted(k for k in want
+                         if want[k] > have.get(k, 0))
+        extra = sorted(k for k in have
+                       if have[k] > want.get(k, 0))
+
+        def _fmt(keys):
+            return ", ".join("%s over %s (%d B)"
+                             % (k, "x".join(a) or "-", b)
+                             for k, a, b in keys) or "none"
+
+        return [self._finding(
+            report,
+            "collective multiset of the traced program does not equal "
+            "plan/schedule.py's prediction — missing from IR: %s; "
+            "unpredicted in IR: %s" % (_fmt(missing), _fmt(extra)))]
+
+
+@register
+class IrPallasPresenceChecker(_IrChecker):
+    rule = "ir-pallas-presence"
+    severity = "error"
+
+    def check_ir(self, report, ctx):
+        pallas = report.get("pallas") or {}
+        found = set(pallas.get("found", ()))
+        out = []
+        for knob, fam in sorted((pallas.get("families") or {}).items()):
+            hits = found & set(fam["kernels"])
+            if fam.get("expected") is True and not hits:
+                out.append(self._finding(
+                    report,
+                    "%s resolves ON but no %s pallas_call is in the "
+                    "traced program (expected one of %s) — the fused "
+                    "kernel silently fell back to the unfused path"
+                    % (knob, fam["family"],
+                       ", ".join(fam["kernels"]))))
+            elif hits and (fam.get("expected") is False
+                           or not fam.get("enabled", True)):
+                why = ("resolves OFF" if not fam.get("enabled", True)
+                       else "is not expected in this program")
+                out.append(self._finding(
+                    report,
+                    "%s pallas_call %s present though %s %s — the "
+                    "program and the knob/plan disagree about which "
+                    "path runs"
+                    % (fam["family"], ", ".join(sorted(hits)), knob,
+                       why)))
+        return out
+
+
+def ir_checkers():
+    """The registered checkers that implement an IR pass."""
+    from ..core import checkers
+    return [cls() for cls in checkers() if issubclass(cls, _IrChecker)]
+
+
+def run_ir_checkers(reports, ctx=None):
+    """All IR findings over ``reports``, sorted and fingerprint-
+    deduplicated the same way ``core.run`` does."""
+    findings = []
+    for checker in ir_checkers():
+        for report in reports:
+            findings.extend(checker.check_ir(report, ctx))
+    findings.sort(key=Finding.sort_key)
+    counts = {}
+    for f in findings:
+        key = (f.rule, f.path, f.symbol, f.message)
+        f._dup = counts.get(key, 0)
+        counts[key] = f._dup + 1
+    return findings

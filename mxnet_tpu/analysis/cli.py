@@ -72,6 +72,32 @@ def _changed_paths(root, ref):
     return picked
 
 
+def _bad_rules(rules):
+    """True (after printing the usage error) when --rule names an
+    unregistered id — shared by the --plan/--ir/--all modes."""
+    unknown = set(rules or ()) - set(rule_ids())
+    if unknown:
+        print("graftlint: unknown rule ids: %s" % sorted(unknown),
+              file=sys.stderr)
+    return bool(unknown)
+
+
+def _load_plan(configs=None):
+    """Analyze the plan catalog with the configured knobs applied;
+    ``configs`` reuses an already-built live catalog (``--all``)."""
+    from mxnet_tpu import config as _config
+
+    from .plan.configs import catalog_reports
+    budget = int(_config.get("MXNET_PLAN_HBM_BYTES") or 0) or None
+    fill_min = float(_config.get("MXNET_PLAN_BUCKET_FILL_MIN"))
+    reports, verify_problems = catalog_reports(fill_min=fill_min,
+                                               configs=configs)
+    for r in reports:
+        if r.get("hbm_budget") is None:
+            r["hbm_budget"] = budget
+    return reports, verify_problems
+
+
 def _plan(args):
     """``--plan``: run graftplan over the in-tree configuration
     catalog (analysis/plan/configs.py) — like ``--audit-suppressions``
@@ -84,26 +110,10 @@ def _plan(args):
 
     from .checkers.plan_rules import run_plan_checkers
 
-    def _load_plan():
-        from mxnet_tpu import config as _config
-        from .plan.configs import catalog_reports
-        budget = int(_config.get("MXNET_PLAN_HBM_BYTES") or 0) or None
-        fill_min = float(_config.get("MXNET_PLAN_BUCKET_FILL_MIN"))
-        reports, verify_problems = catalog_reports(fill_min=fill_min)
-        for r in reports:
-            if r.get("hbm_budget") is None:
-                r["hbm_budget"] = budget
-        return reports, verify_problems
-
-    from .core import rule_ids as _rule_ids
     plan_rules = {"spmd-divisibility", "collective-mismatch",
                   "oom-risk", "bucket-plan-waste"}
-    if args.rules:
-        unknown = set(args.rules) - set(_rule_ids())
-        if unknown:
-            print("graftlint: unknown rule ids: %s" % sorted(unknown),
-                  file=sys.stderr)
-            return 2
+    if _bad_rules(args.rules):
+        return 2
     reports, verify_problems = _load_plan()
     findings = run_plan_checkers(reports)
     if args.rules:
@@ -115,24 +125,8 @@ def _plan(args):
         # further by --rule), so every other entry — and any plan entry
         # outside the --rule scope — is preserved, with audit
         # annotations carried over for unchanged fingerprints
-        scope = set(args.rules) & plan_rules if args.rules else plan_rules
-        entries = {f.fingerprint: f.to_dict() for f in findings}
-        kept = 0
-        for fp, e in baseline_mod.load(baseline_path).items():
-            if fp in entries:
-                if "audit" in e:
-                    entries[fp]["audit"] = e["audit"]
-                continue
-            if e.get("rule") not in scope:
-                entries[fp] = e
-                kept += 1
-        baseline_mod.save_entries(list(entries.values()), baseline_path)
-        print("graftlint: wrote %d finding%s to %s"
-              % (len(entries), "s" if len(entries) != 1 else "",
-                 baseline_path)
-              + (" (%d out-of-scope entr%s preserved)"
-                 % (kept, "ies" if kept != 1 else "y") if kept else ""))
-        return 0
+        return _restricted_update(findings, baseline_path, plan_rules,
+                                  narrowed=args.rules)
     known = {} if args.no_baseline else baseline_mod.load(baseline_path)
     new, old = baseline_mod.filter_new(findings, known)
     if args.sarif:
@@ -171,6 +165,199 @@ def _plan(args):
               "match measurements on %d"
               % (len(reports), "s" if len(reports) != 1 else "",
                  agreed))
+    return 1 if (new or verify_problems) else 0
+
+
+def _ir_cost_line(report):
+    cost = report.get("cost") or {}
+    return ("ir %-36s %d eqns, %d flops, %d traffic B%s"
+            % (report["name"], cost.get("eqns", 0),
+               cost.get("flops", 0), cost.get("bytes", 0),
+               " (est)" if cost.get("estimated") else ""))
+
+
+def _load_ir(live_configs=None):
+    """Trace the catalog (jax required; tracing/lowering only, nothing
+    compiles or dispatches) and run the IR checkers."""
+    from .checkers.ir_rules import run_ir_checkers
+    from .ir.catalog import catalog_reports
+    reports = catalog_reports(live_configs=live_configs)
+    return reports, run_ir_checkers(reports)
+
+
+def _write_cost_report(reports):
+    """Honor MXNET_IR_COST_REPORT: the per-program CostReports as one
+    JSON file next to graftplan's memory numbers."""
+    import json
+
+    from mxnet_tpu import config as _config
+    path = _config.get("MXNET_IR_COST_REPORT")
+    if not path:
+        return None
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"programs": [
+            {"name": r["name"], "kind": r["kind"],
+             "origin": r["origin"], "cost": r["cost"]}
+            for r in reports]}, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def _restricted_update(findings, baseline_path, scope, narrowed=None):
+    """The --plan/--ir baseline refresh: re-derive only ``scope``'s
+    rules (narrowed further by --rule), preserve every other entry,
+    carry audit annotations for unchanged fingerprints."""
+    scope = set(narrowed) & set(scope) if narrowed else set(scope)
+    entries = {f.fingerprint: f.to_dict() for f in findings}
+    kept = 0
+    for fp, e in baseline_mod.load(baseline_path).items():
+        if fp in entries:
+            if "audit" in e:
+                entries[fp]["audit"] = e["audit"]
+            continue
+        if e.get("rule") not in scope:
+            entries[fp] = e
+            kept += 1
+    baseline_mod.save_entries(list(entries.values()), baseline_path)
+    print("graftlint: wrote %d finding%s to %s"
+          % (len(entries), "s" if len(entries) != 1 else "",
+             baseline_path)
+          + (" (%d out-of-scope entr%s preserved)"
+             % (kept, "ies" if kept != 1 else "y") if kept else ""))
+    return 0
+
+
+def _ir(args):
+    """``--ir``: graftir over the traced in-tree program catalog —
+    donation aliasing, dtype drift, dead outputs, the collective
+    schedule vs plan/schedule.py, Pallas presence, and the static cost
+    model — gated through the same committed baseline as every other
+    rule.  Like ``--plan`` this imports and instantiates the package
+    (jax required) but NOTHING compiles: abstract tracing + lowering
+    only."""
+    import json
+
+    from .checkers.ir_rules import IR_RULES
+
+    if _bad_rules(args.rules):
+        return 2
+    reports, findings = _load_ir()
+    if args.rules:
+        findings = [f for f in findings if f.rule in set(args.rules)]
+    cost_path = _write_cost_report(reports)
+    baseline_path = args.baseline or baseline_mod.default_path(repo_root())
+    if args.update_baseline:
+        return _restricted_update(findings, baseline_path, IR_RULES,
+                                  narrowed=args.rules)
+    known = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old = baseline_mod.filter_new(findings, known)
+    if args.sarif:
+        doc = json.loads(sarif_report(new, old))
+        doc["runs"][0]["properties"] = {
+            "graftir": {"programs": [r["name"] for r in reports]}}
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        doc = json.loads(json_report(new, old))
+        doc["ir"] = {"reports": reports}
+        print(json.dumps(doc, indent=1))
+    else:
+        for r in reports:
+            print(_ir_cost_line(r))
+        if cost_path:
+            print("graftir: cost report written to %s" % cost_path)
+        print(human_report(new, old, show_baselined=args.show_baselined))
+        exact = sum(1 for r in reports
+                    if sorted(map(tuple, r.get("schedule_expect") or []))
+                    == sorted(map(tuple, r.get("schedule_actual") or [])))
+        print("graftir: %d program%s traced, collective schedule "
+              "matches the plan on %d"
+              % (len(reports), "s" if len(reports) != 1 else "", exact))
+    return 1 if new else 0
+
+
+def _all(args):
+    """``--all``: lint + plan + ir in ONE process with one merged
+    baseline pass and one exit code — the single entry point tier-1
+    and CI call instead of three.  The plan's closed-loop verification
+    still fails the run even when its findings are baselined; the IR
+    leg honors the MXNET_IR master switch."""
+    import json
+
+    from mxnet_tpu import config as _config
+
+    from .checkers.plan_rules import run_plan_checkers
+
+    if _bad_rules(args.rules):
+        return 2
+    root = repo_root()
+    cache = None
+    if not args.no_cache:
+        from . import cache as cache_mod
+        cache = args.cache or cache_mod.default_path(root)
+    static = run([os.path.join(root, "mxnet_tpu")], rules=args.rules,
+                 cache=cache)
+
+    # ONE live catalog (4 trainers + serving + bound program on the
+    # virtual mesh) shared by the plan and IR legs
+    from .plan.configs import in_tree_live
+    live = in_tree_live()
+    plan_reports, verify_problems = _load_plan(
+        configs=[(s, m) for s, m, _l in live])
+    plan_findings = run_plan_checkers(plan_reports)
+
+    ir_reports, ir_findings = [], []
+    ir_on = bool(_config.get("MXNET_IR"))
+    if ir_on:
+        ir_reports, ir_findings = _load_ir(live_configs=live)
+        _write_cost_report(ir_reports)
+
+    findings = list(static) + list(plan_findings) + list(ir_findings)
+    if args.rules:
+        wanted = set(args.rules)
+        findings = [f for f in findings
+                    if f.rule in wanted or f.rule == "parse-error"]
+    baseline_path = args.baseline or baseline_mod.default_path(root)
+    if args.update_baseline:
+        # full-scope merge: every leg re-derived in this run, so only
+        # audit annotations need carrying over (narrowed --rule runs
+        # still preserve out-of-scope entries).  A skipped IR leg
+        # (MXNET_IR=0) re-derived nothing — its rules leave the scope
+        # so accepted ir-* entries are preserved, not silently dropped
+        from .checkers.ir_rules import IR_RULES
+        scope = set(rule_ids()) | {"parse-error", "stale-suppression"}
+        if not ir_on:
+            scope -= set(IR_RULES)
+        return _restricted_update(findings, baseline_path, scope,
+                                  narrowed=args.rules)
+    known = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old = baseline_mod.filter_new(findings, known)
+    if args.sarif:
+        doc = json.loads(sarif_report(new, old))
+        doc["runs"][0]["properties"] = {
+            "graftlintAll": {
+                "plan_configs": [r["name"] for r in plan_reports],
+                "verify_problems": verify_problems,
+                "ir_programs": [r["name"] for r in ir_reports],
+                "ir_enabled": ir_on}}
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        doc = json.loads(json_report(new, old))
+        doc["plan"] = {"reports": plan_reports,
+                       "verify_problems": verify_problems}
+        doc["ir"] = {"enabled": ir_on, "reports": ir_reports}
+        print(json.dumps(doc, indent=1))
+    else:
+        for p in verify_problems:
+            print("PREDICTION MISMATCH: %s" % p)
+        if not ir_on:
+            print("graftir: skipped (MXNET_IR=0)")
+        print(human_report(new, old, show_baselined=args.show_baselined))
+        print("graftlint --all: %d static + %d plan + %d ir findings "
+              "before baseline; %d plan config%s, %d traced program%s"
+              % (len(static), len(plan_findings), len(ir_findings),
+                 len(plan_reports),
+                 "s" if len(plan_reports) != 1 else "",
+                 len(ir_reports), "s" if len(ir_reports) != 1 else ""))
     return 1 if (new or verify_problems) else 0
 
 
@@ -262,6 +449,20 @@ def main(argv=None):
              "measurements.  NOTE: imports and instantiates the "
              "package (jax required), but nothing XLA-compiles")
     parser.add_argument(
+        "--ir", action="store_true",
+        help="run graftir (jaxpr-level verification of the compiled "
+             "step: donation aliasing, dtype drift, dead outputs, "
+             "collective schedule vs plan/schedule.py, Pallas "
+             "presence, static cost model) over the traced in-tree "
+             "program catalog and gate the ir-* findings.  NOTE: "
+             "imports and instantiates the package (jax required), "
+             "but only traces/lowers — nothing XLA-compiles")
+    parser.add_argument(
+        "--all", action="store_true", dest="all_modes",
+        help="lint + plan + ir in one process with one merged "
+             "baseline pass and one exit code (the tier-1/CI entry "
+             "point); the ir leg honors MXNET_IR")
+    parser.add_argument(
         "--audit-suppressions", action="store_true",
         help="run the graftsan workload (runtime sanitizers + line "
              "probe) and classify every inline suppression and "
@@ -298,8 +499,39 @@ def main(argv=None):
     if args.audit_suppressions:
         return _audit_suppressions(args)
 
+    if args.changed is not None and (args.plan or args.ir
+                                     or args.all_modes):
+        # the catalog analyses are whole-program (IR facts and plan
+        # predictions don't decompose per file), so --changed acts as
+        # the pre-push fast path: nothing relevant changed -> skip the
+        # catalog entirely; anything changed -> full run
+        if args.paths:
+            print("graftlint: --changed derives the path set from git; "
+                  "drop the explicit paths", file=sys.stderr)
+            return 2
+        try:
+            changed = _changed_paths(
+                repo_root(),
+                None if args.changed == "WORKTREE" else args.changed)
+        except RuntimeError as exc:
+            print("graftlint: %s" % exc, file=sys.stderr)
+            return 2
+        if not changed:
+            print("graftlint: no changed lintable files")
+            return 0
+
+    if args.all_modes:
+        if args.plan or args.ir:
+            print("graftlint: --all already includes --plan and --ir",
+                  file=sys.stderr)
+            return 2
+        return _all(args)
+
     if args.plan:
         return _plan(args)
+
+    if args.ir:
+        return _ir(args)
 
     root = repo_root()
     if args.changed is not None:

@@ -1,0 +1,47 @@
+"""graftir — jaxpr-level verification of the compiled step.
+
+The fourth analysis leg.  graftlint reads Python source, graftsan
+watches the runtime, graftplan symbolically evaluates the declarative
+plan — graftir inspects the program the compiler actually sees: the
+closed jaxpr and lowered StableHLO of the in-tree step/serving
+programs, captured by ABSTRACT tracing (``jax.jit(...).trace`` over
+``ShapeDtypeStruct`` args + aot ``.lower()``) — no compile, no step,
+no devices beyond the virtual mesh graftplan already uses.
+
+This is where optimization claims become checkable facts (the TVM
+thesis, PAPERS.md): a ``donate_argnums`` the lowering silently dropped,
+an f32→f64 promotion, a Pallas knob that quietly fell back to the
+``tree_map`` path, a reduce-scatter a refactor un-attached from the
+backward stream — all invisible to source lint, runtime counters and
+the plan model, all visible in the IR.  Five rules ride the existing
+Finding/fingerprint/SARIF/baseline machinery (catalog in
+``docs/faq/static_analysis.md``):
+
+- ``ir-donation-lost``     — declared donations not aliased in the
+  lowered program (the IR-level completion of ``missing-donation`` /
+  ``san-donation``);
+- ``ir-dtype-drift``       — f64 leaks (traced under ``enable_x64`` so
+  they are representable) and unintended bf16→f32 forward promotions;
+- ``ir-dead-output``       — computed-but-unused eqns (dropped
+  residuals/outputs that survive until XLA DCE deletes the work you
+  paid tracing for — or worse, doesn't);
+- ``ir-collective-schedule`` — the collective multiset in the jaxpr
+  must equal ``plan/schedule.py``'s static schedule per config;
+- ``ir-pallas-presence``   — ``MXNET_PALLAS_*`` on ⇒ the named
+  ``pallas_call``s are in the traced step; off ⇒ they are not.
+
+On the same walk a static cost model (``cost.py``) folds flops/bytes/
+op-mix into a :data:`CostReport` recorded next to graftplan's memory
+numbers (``tools/lint.py --ir`` / ``--all``; ``MXNET_IR_*`` knobs in
+``docs/faq/env_var.md``).
+"""
+from __future__ import annotations
+
+from .cost import cost_report
+from .trace import (COLLECTIVE_SCOPE_PREFIX, DELIBERATE_CAST_SCOPES,
+                    collect_facts, trace_program)
+from .catalog import catalog_reports, schedule_multiset
+
+__all__ = ["COLLECTIVE_SCOPE_PREFIX", "DELIBERATE_CAST_SCOPES",
+           "catalog_reports", "collect_facts", "cost_report",
+           "schedule_multiset", "trace_program"]

@@ -1,0 +1,74 @@
+"""Static cost of the bench-of-record step program, for ``bench.py``.
+
+``bench.py`` measures img/s on hardware; this prices the SAME program
+statically — the ResNet-50 batch-256 bf16 fused train step (fwd + bwd
++ SGD-momentum update as one donated XLA program) — by abstractly
+tracing it on CPU (``Executor.step_callable("fused")``; nothing
+compiles) and folding the jaxpr through graftir's cost model.
+``bench.py`` runs this in a bounded subprocess and records
+``ir_predicted_flops`` / ``ir_predicted_bytes`` next to the measured
+step time in the primary BENCH JSON line, so every captured benchmark
+carries the program's static price alongside its wall-clock —
+regressions in either column point at each other.
+
+Flops are exact for the matmul/conv terms that dominate; bytes are
+the unfused upper bound (``analysis/ir/cost.py`` has the honesty
+contract).  Run directly: ``python -m mxnet_tpu.analysis.ir.bench``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+__all__ = ["step_cost", "main"]
+
+
+def step_cost(num_layers=50, batch=256, image_shape=(3, 224, 224),
+              num_classes=1000, dtype="bfloat16"):
+    """CostReport dict of the bench step program (abstract trace)."""
+    from ... import optimizer as opt_mod
+    from .trace import trace_program
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    symdir = os.path.join(root, "example", "image-classification",
+                          "symbols")
+    if symdir not in sys.path:
+        sys.path.insert(0, symdir)
+    import resnet as resnet_mod
+    sym = resnet_mod.get_symbol(
+        num_classes=num_classes, num_layers=num_layers,
+        image_shape=",".join(str(s) for s in image_shape))
+    exe = sym.simple_bind(
+        data=(batch,) + tuple(image_shape),
+        compute_dtype=dtype if dtype not in (None, "float32") else None,
+        cast_exclude=("softmax_label",))
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    mode = "fused" if exe.install_fused_update(opt) else "train"
+    jit_fn, args = exe.step_callable(mode=mode)
+    report = trace_program(
+        jit_fn, args, name="bench/resnet%d-b%d-%s" % (num_layers, batch,
+                                                      dtype or "fp32"),
+        kind="program", origin="bench.py")
+    cost = dict(report["cost"])
+    cost["program"] = report["name"]
+    cost["mode"] = mode
+    cost["pallas"] = report["pallas_found"]
+    return cost
+
+
+def main():
+    cost = step_cost()
+    print(json.dumps({
+        "ir_predicted_flops": cost["flops"],
+        "ir_predicted_bytes": cost["bytes"],
+        "ir_program": cost["program"],
+        "ir_mode": cost["mode"],
+        "ir_eqns": cost["eqns"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Static cost model over jaxpr equations — flops, bytes, op mix.
+
+Honesty contract (mirrors graftplan's exact-vs-estimate split,
+``docs/faq/static_analysis.md``):
+
+- **flops are exact** for the dense-compute primitives that dominate a
+  step — ``dot_general`` (2·batch·M·N·K) and ``conv_general_dilated``
+  (2·out_elems·K_spatial·C_in/groups) — and a 1-flop-per-output-element
+  count for elementwise/reduction math;
+- **bytes are an unfused upper bound**: every eqn is charged its full
+  operand + result traffic, as if nothing fused.  XLA fuses most of it
+  away, so the number is a program-size/arithmetic-intensity signal,
+  not an HBM prediction (graftplan's ``memory.py`` owns residency).
+
+``scan`` bodies are multiplied by their trip count; ``while``/``cond``
+bodies are counted once (trip counts are not static — flagged in the
+report as ``estimated``).  Pure data movement (reshape, transpose,
+broadcast, slice, convert, ...) costs 0 flops but full bytes.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["eqn_flops", "eqn_bytes", "cost_report"]
+
+# primitives that are pure data movement / bookkeeping: 0 flops
+_ZERO_FLOP = frozenset((
+    "reshape", "transpose", "broadcast_in_dim", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "convert_element_type",
+    "squeeze", "expand_dims", "rev", "gather", "scatter", "pad",
+    "copy", "device_put", "sharding_constraint", "stop_gradient",
+    "iota", "split", "bitcast_convert_type",
+))
+
+
+def _aval_elems(aval):
+    shape = getattr(aval, "shape", ())
+    return int(math.prod(shape)) if shape else 1
+
+
+def _aval_bytes(aval):
+    dt = getattr(aval, "dtype", None)
+    itemsize = getattr(dt, "itemsize", 4) if dt is not None else 4
+    return _aval_elems(aval) * int(itemsize)
+
+
+def eqn_flops(eqn):
+    """Exact flops for dense compute, per-output-element for the rest."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        (lhs_c, _rhs_c), (lhs_b, _rhs_b) = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        out = eqn.outvars[0].aval
+        k = 1
+        for d in lhs_c:
+            k *= int(lhs.shape[d])
+        # out already carries batch x M x N; contraction adds the K term
+        return 2 * _aval_elems(out) * k
+    if name == "conv_general_dilated":
+        rhs = eqn.invars[1].aval          # kernel
+        out = eqn.outvars[0].aval
+        dn = eqn.params["dimension_numbers"]
+        k_spatial = 1
+        for d in dn.rhs_spec[2:]:
+            k_spatial *= int(rhs.shape[d])
+        c_in = int(rhs.shape[dn.rhs_spec[1]])
+        groups = int(eqn.params.get("feature_group_count", 1) or 1)
+        return 2 * _aval_elems(out) * k_spatial * c_in // max(groups, 1)
+    if name in _ZERO_FLOP:
+        return 0
+    return sum(_aval_elems(v.aval) for v in eqn.outvars
+               if hasattr(v, "aval"))
+
+
+def eqn_bytes(eqn):
+    """Unfused traffic upper bound: operands read + results written."""
+    total = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            total += _aval_bytes(aval)
+    return total
+
+
+def cost_report(eqn_rows):
+    """Fold ``(primitive_name, flops, bytes, scale)`` rows (the walk in
+    ``trace.collect_facts``) into one CostReport dict."""
+    flops = traffic = 0
+    by_prim = {}
+    estimated = False
+    n = 0
+    for prim, f, b, scale, est in eqn_rows:
+        n += 1
+        flops += f * scale
+        traffic += b * scale
+        estimated = estimated or est
+        slot = by_prim.setdefault(prim, {"eqns": 0, "flops": 0,
+                                         "bytes": 0})
+        slot["eqns"] += 1
+        slot["flops"] += f * scale
+        slot["bytes"] += b * scale
+    return {"flops": int(flops), "bytes": int(traffic), "eqns": n,
+            "estimated": bool(estimated),
+            "by_prim": {k: by_prim[k] for k in sorted(by_prim)}}

@@ -21,7 +21,8 @@ from __future__ import annotations
 from .interpreter import analyze
 from .spec import PlanSpec
 
-__all__ = ["in_tree_configs", "verify_predictions", "catalog_reports"]
+__all__ = ["in_tree_configs", "in_tree_live", "convnet_symbol",
+           "verify_predictions", "catalog_reports"]
 
 # the dryrun/scaling-net shape, small enough to build 4 trainers on a
 # virtual mesh in well under a second of device work
@@ -62,10 +63,13 @@ def _trainer_config(name, width, zero, compression=None,
     spec = PlanSpec.from_trainer(trainer, name=name)
     measured = {"opt_state": trainer.optimizer_state_bytes(),
                 "comm": trainer.comm_stats()}
-    return spec, measured
+    return spec, measured, trainer
 
 
-def _program_config(name):
+def convnet_symbol():
+    """The catalog's bound-program symbol (conv/pool/FC/SoftmaxOutput)
+    — shared with graftir's serving-ladder and fused-step traces so
+    all four analysis legs judge the same program."""
     from mxnet_tpu import sym
     data = sym.Variable("data")
     net = sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
@@ -77,9 +81,12 @@ def _program_config(name):
     net = sym.FullyConnected(net, num_hidden=16, name="fc1")
     net = sym.Activation(net, act_type="relu")
     net = sym.FullyConnected(net, num_hidden=4, name="fc2")
-    net = sym.SoftmaxOutput(net, name="softmax")
-    exe = net.simple_bind(data=(8, 3, 16, 16))
-    return PlanSpec.from_executor(exe, name=name), None
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _program_config(name):
+    exe = convnet_symbol().simple_bind(data=(8, 3, 16, 16))
+    return PlanSpec.from_executor(exe, name=name), None, exe
 
 
 def _serving_config(name):
@@ -96,18 +103,20 @@ def _serving_config(name):
         spec.manifest_ladders = {
             str(k): list(v)
             for k, v in WarmupManifest(manifest_path).ladders().items()}
-    return spec, None
+    return spec, None, None
 
 
-def in_tree_configs(width=None):
-    """``[(spec, measured_or_None), ...]`` for every in-tree
-    configuration.  ``width`` caps the mesh (default: 8, shrunk to the
-    available device count so the CLI still runs on odd hosts; the
-    tier-1 gate pins the full 8)."""
+def in_tree_live(width=None):
+    """``[(spec, measured_or_None, live_or_None), ...]`` for every
+    in-tree configuration — the live object (trainer / bound executor)
+    rides along so graftir (``analysis/ir/``) can abstractly trace the
+    very programs graftplan models.  ``width`` caps the mesh (default:
+    8, shrunk to the available device count so the CLI still runs on
+    odd hosts; the tier-1 gate pins the full 8)."""
     import jax
     n = len(jax.devices())
     width = min(width or _WIDTH, n)
-    out = [
+    return [
         _trainer_config("trainer/zero0-dp%d" % width, width, zero=0),
         _trainer_config("trainer/zero1-dp%d" % width, width, zero=1),
         _trainer_config("trainer/zero2-dp%d" % width, width, zero=2),
@@ -119,7 +128,13 @@ def in_tree_configs(width=None):
         _serving_config("serving/warmup-ladder"),
         _program_config("program/convnet"),
     ]
-    return out
+
+
+def in_tree_configs(width=None):
+    """``[(spec, measured_or_None), ...]`` — the pure-data view of
+    :func:`in_tree_live` (graftplan needs no live objects)."""
+    return [(spec, measured)
+            for spec, measured, _live in in_tree_live(width=width)]
 
 
 def verify_predictions(spec, measured):
@@ -146,10 +161,16 @@ def verify_predictions(spec, measured):
     return problems
 
 
-def catalog_reports(width=None, fill_min=None):
-    """Analyze the whole catalog: ``(reports, verify_problems)``."""
+def catalog_reports(width=None, fill_min=None, configs=None):
+    """Analyze the whole catalog: ``(reports, verify_problems)``.
+
+    ``configs`` lets a caller that already built the live catalog
+    (``tools/lint.py --all`` shares ONE ``in_tree_live`` between the
+    plan and IR legs) pass its ``(spec, measured)`` pairs instead of
+    instantiating every trainer a second time."""
     reports, problems = [], []
-    for spec, measured in in_tree_configs(width=width):
+    for spec, measured in (configs if configs is not None
+                           else in_tree_configs(width=width)):
         reports.append(analyze(spec, fill_min=fill_min))
         problems.extend(verify_predictions(spec, measured))
     return reports, problems

@@ -258,6 +258,21 @@ register_env("MXNET_PLAN_BUCKET_FILL_MIN", float, 0.6,
              "ladder (uniform-arrival model) before graftplan's "
              "bucket-plan-waste checker flags the rung as padding "
              "waste")
+register_env("MXNET_IR", bool, True,
+             "graftir master switch: include the jaxpr-level IR leg "
+             "(donation/dtype/collective/Pallas verification + cost "
+             "model, analysis/ir/) in tools/lint.py --all runs and "
+             "the bench cost columns; tools/lint.py --ir always runs "
+             "(explicit request wins)")
+register_env("MXNET_IR_F64_ALLOWLIST", str, None,
+             "comma-separated substrings naming DELIBERATE f64 sites "
+             "(matched against the eqn's name-stack/primitive) that "
+             "graftir's ir-dtype-drift skips — e.g. fp32-master "
+             "accumulators promoted on purpose; unset allows none")
+register_env("MXNET_IR_COST_REPORT", str, None,
+             "path where tools/lint.py --ir/--all writes the traced "
+             "catalog's static CostReports (flops/bytes/op-mix per "
+             "program) as JSON, next to graftplan's memory numbers")
 register_env("MXNET_PALLAS_FUSED_OPT", str, "auto",
              "one-sweep Pallas optimizer (ParallelTrainer ZeRO sweep, "
              "executor fused step; fused_sgd_momentum/fused_adam): "

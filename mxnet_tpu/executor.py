@@ -904,6 +904,91 @@ class Executor:
     def debug_str(self):
         return self._symbol.debug_str()
 
+    def step_callable(self, mode="train"):
+        """Export a compiled-step program for ABSTRACT analysis
+        (graftir, ``analysis/ir/``): ``(jitted_fn, args)`` where the
+        args mirror one real dispatch as ``ShapeDtypeStruct``s (plus a
+        concrete RNG key — key minting is host work, not a compile).
+        Tracing/lowering the pair never compiles or dispatches.
+
+        Modes: ``eval`` (inference forward), ``train`` (the fused
+        fwd+bwd program for loss graphs, plain train forward
+        otherwise), ``fused`` (the donated fwd+bwd+optimizer step —
+        requires :meth:`install_fused_update`; state/residual/lr
+        operands are staged exactly as ``_forward_fused`` stages them,
+        without advancing the optimizer's schedule bookkeeping)."""
+        import jax as _jax
+
+        from . import random as _mxrandom
+
+        def _sds(arr):
+            return _jax.ShapeDtypeStruct(tuple(arr.shape),
+                                         np.dtype(arr.dtype))
+
+        args = [_sds(self.arg_dict[n]) for n in self.arg_names]
+        aux = [_sds(self.aux_dict[n]) for n in self.aux_names]
+        # analysis must be RNG-neutral: minting trace keys off the
+        # global chain would shift every later draw and break the
+        # checkpoint-resume bit-identical contract (random.set_state)
+        rng_snapshot = _mxrandom.get_state()
+        try:
+            key = _mxrandom.next_key()
+            key_data = _mxrandom.next_key_data()
+        finally:
+            _mxrandom.set_state(rng_snapshot)
+        if mode == "eval":
+            return self._jit_fwd_eval, (args, aux, key)
+        if mode == "train":
+            if self._diff_idx and self._is_loss_graph:
+                outs = _jax.eval_shape(self._jit_fwd_train, args, aux,
+                                       key)[0]
+                seeds = [_jax.ShapeDtypeStruct(o.shape, o.dtype)
+                         for o in outs]
+                return self._jit_fb, (args, aux, key, seeds)
+            return self._jit_fwd_train, (args, aux, key)
+        if mode != "fused":
+            raise MXNetError("step_callable mode must be eval/train/"
+                             "fused; got %r" % (mode,))
+        if self._fused_update is None:
+            raise MXNetError("step_callable('fused') requires "
+                             "install_fused_update() first")
+        sweep = self._sweep
+        diff_set = set(self._diff_idx)
+        diff = [args[i] for i in self._diff_idx]
+        rest = [None if i in diff_set else a for i, a in enumerate(args)]
+        init_state = self._fused_update[1]
+        if self._fused_state is not None:
+            states = _jax.tree_util.tree_map(_sds, self._fused_state)
+        elif sweep is not None:
+            # abstract mirror of _sweep_init_state's bucket-major slot
+            # layout — no buffers materialize for a trace
+            n_slots = (1 if sweep["momentum"] != 0.0 else 0) \
+                if sweep["kind"] == "sgd" else 2
+            states = [tuple(_jax.ShapeDtypeStruct((b.n,), jnp.float32)
+                            for _ in range(n_slots))
+                      for b, _idxs in sweep["plan"]]
+        else:
+            # slots are zeros_like(weight) (fused_update_kernel's
+            # init_state contract) — build ONE prototype to learn the
+            # slot count/dtypes, then mirror abstractly per weight
+            # instead of allocating the full state
+            proto = init_state(diff[0]) if diff else ()
+            states = [tuple(_jax.ShapeDtypeStruct(d.shape, s.dtype)
+                            for s in proto) for d in diff]
+        resids = ([_jax.ShapeDtypeStruct(d.shape, jnp.float32)
+                   for d in diff]
+                  if getattr(self, "_fused_codec", None) is not None
+                  else [])
+        n_hyper = len(sweep["plan"]) if sweep is not None else len(diff)
+        lrs = _jax.ShapeDtypeStruct((n_hyper,), jnp.float32)
+        wds = _jax.ShapeDtypeStruct((n_hyper,), jnp.float32)
+        outs = _jax.eval_shape(self._jit_fwd_train, args, aux, key)[0]
+        seeds = [_jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs]
+        if self._jit_fbu is None:
+            self._jit_fbu = self._build_fbu()
+        return self._jit_fbu, (diff, rest, aux, key_data, seeds, states,
+                               resids, lrs, wds)
+
     def program_plan(self):
         """This bound program, declaratively, for graftplan
         (``analysis/plan/``): the symbol-JSON graph plus the bound

@@ -124,13 +124,23 @@ class CastCodec:
         self.dtype = dtype
 
     def encode(self, grad, residual):
+        import jax
+
         g = grad.astype(jnp.float32) + residual
         payload = g.astype(self.dtype)
-        decoded = payload.astype(jnp.float32)
+        # the mx_decode_fp32 scope marks this upcast deliberate for
+        # graftir's ir-dtype-drift (analysis/ir): decoding the wire
+        # payload back to fp32 is the codec's contract, not an
+        # accidental accumulation promotion
+        with jax.named_scope("mx_decode_fp32"):
+            decoded = payload.astype(jnp.float32)
         return payload, decoded, g - decoded
 
     def decode(self, payload, shape):
-        return payload.astype(jnp.float32).reshape(tuple(shape))
+        import jax
+
+        with jax.named_scope("mx_decode_fp32"):
+            return payload.astype(jnp.float32).reshape(tuple(shape))
 
     def roundtrip(self, grad, residual):
         _, decoded, new_residual = self.encode(grad, residual)

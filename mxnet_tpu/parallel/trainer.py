@@ -154,7 +154,17 @@ def _spec_shard_factor(spec, mesh):
     return factor
 
 
-def _make_bucket_tap(sharding):
+def _coll_scope(kind, bucket):
+    """The graftir collective-site tag: a ``jax.named_scope`` whose
+    name (``mx_coll:<kind>:b<bucket>``) rides the eqn's name stack
+    through trace AND transpose, so ``analysis/ir`` can read the
+    collective multiset straight out of the jaxpr and hold it equal to
+    ``plan/schedule.py``'s prediction (``ir-collective-schedule``).
+    Semantically free: a named_scope changes no computation."""
+    return jax.named_scope("mx_coll:%s:b%d" % (kind, bucket))
+
+
+def _make_bucket_tap(sharding, bucket):
     """Identity in the forward; in the backward the bucket's fused
     cotangent — produced the moment this bucket's backward segment
     completes — is immediately pinned to the ZeRO shard layout, so
@@ -169,7 +179,8 @@ def _make_bucket_tap(sharding):
         return flat, None
 
     def bwd(_, ct):
-        return (jax.lax.with_sharding_constraint(ct, sharding),)
+        with _coll_scope("reduce_scatter", bucket):
+            return (jax.lax.with_sharding_constraint(ct, sharding),)
 
     tap.defvjp(fwd, bwd)
     return tap
@@ -450,7 +461,8 @@ class ParallelTrainer:
             out = apply_train(params, key, x)
             if isinstance(out, tuple):
                 out = out[0]
-            out = out.astype(jnp.float32)  # loss always in fp32
+            with jax.named_scope("mx_master_fp32"):
+                out = out.astype(jnp.float32)  # loss always in fp32
             # pin logits to the batch layout: gives GSPMD a fixed
             # resharding boundary between model body and loss (see
             # _param_pspec docstring for the CPU-backend miscompile this
@@ -546,13 +558,16 @@ class ParallelTrainer:
         # reduce-scatter attached in the backward stream (overlap); with
         # a codec the wire transform runs on the fused cotangent after
         # backward instead (error feedback needs the residual state)
-        taps = [_make_bucket_tap(zero_ns) if zero >= 2 and codec is None
-                else None for _ in plan]
+        taps = [_make_bucket_tap(zero_ns, b.index)
+                if zero >= 2 and codec is None else None for b in plan]
 
-        def _exchange(gf, res):
+        def _exchange(gf, res, bucket):
             """One bucket's fused cotangent -> (slot-sharded gradient,
             new residual): codec with error feedback, then the stage-1
-            (full all-reduce) or stage-2 (reduce-scatter) layout."""
+            (full all-reduce) or stage-2 (reduce-scatter) layout.  The
+            ONE collective-implying constraint per bucket is tagged
+            with ``_coll_scope`` (zero-2 no-codec buckets are tagged
+            at their tap instead)."""
             if codec is not None:
                 payload, decoded, new_res = codec.encode(gf, res)
                 if payload.dtype != jnp.uint32:
@@ -560,7 +575,8 @@ class ParallelTrainer:
                     # dtype — constrain the payload, decode shard-side
                     payload = jax.lax.with_sharding_constraint(
                         payload, zero_ns)
-                    gf = payload.astype(jnp.float32)
+                    with jax.named_scope("mx_decode_fp32"):
+                        gf = payload.astype(jnp.float32)
                 else:
                     gf = decoded
             else:
@@ -568,8 +584,17 @@ class ParallelTrainer:
             if zero == 1:
                 # stage 1: materialize the FULL reduced gradient first
                 # (all-reduce), then slice — memory win only
-                gf = jax.lax.with_sharding_constraint(gf, rep_ns)
-            gshard = jax.lax.with_sharding_constraint(gf, zero_ns)
+                with _coll_scope("all_reduce", bucket):
+                    gf = jax.lax.with_sharding_constraint(gf, rep_ns)
+                gshard = jax.lax.with_sharding_constraint(gf, zero_ns)
+            elif codec is not None:
+                # stage 2 with a codec: the reduce-scatter rides this
+                # constraint (the no-codec form tags its backward tap)
+                with _coll_scope("reduce_scatter", bucket):
+                    gshard = jax.lax.with_sharding_constraint(gf,
+                                                              zero_ns)
+            else:
+                gshard = jax.lax.with_sharding_constraint(gf, zero_ns)
             return gshard, new_res
 
         def step(params, opt_state, resids, x, y, key):
@@ -593,7 +618,7 @@ class ParallelTrainer:
             p_shards, g_shards, new_resids = {}, {}, []
             for b, fl, gf in zip(plan, flats, gflats):
                 res = resids[b.index] if codec is not None else None
-                gshard, new_res = _exchange(gf, res)
+                gshard, new_res = _exchange(gf, res, b.index)
                 if new_res is not None:
                     new_resids.append(new_res)
                 # master param slice: params are replicated, so this is
@@ -614,8 +639,9 @@ class ParallelTrainer:
             for b in plan:
                 # the all-gather: shard-updated flat buffer back to the
                 # replicated master layout, then split into params
-                full = jax.lax.with_sharding_constraint(
-                    new_shards["b%d" % b.index], rep_ns)
+                with _coll_scope("all_gather", b.index):
+                    full = jax.lax.with_sharding_constraint(
+                        new_shards["b%d" % b.index], rep_ns)
                 new_fused.update(unflatten_bucket(full, b))
             if perparam_names:
                 new_pp, new_pp_state = opt.apply(pp, gpp,
@@ -628,6 +654,44 @@ class ParallelTrainer:
             return new_params, new_state, tuple(new_resids), loss
 
         return step
+
+    def step_callable(self, data_shape, label_shape=None, dtype=None):
+        """Export the compiled step for ABSTRACT analysis (graftir,
+        ``analysis/ir/``): ``(jit_step, args)`` where args mirror one
+        :meth:`step` call as ``ShapeDtypeStruct``s carrying the REAL
+        shardings of this trainer's live state (params/slots/residuals
+        exactly as placed, batch pinned to the same ``("dp","fsdp")``
+        sharding ``_build`` compiles in) plus a concrete RNG key.
+        Tracing/lowering the pair never compiles or dispatches — this
+        is how ``tools/lint.py --ir`` proves the donation, dtype,
+        Pallas-presence and collective-schedule claims about the
+        program the compiler actually sees."""
+        if self._jit_step is None:
+            self._build(1)
+
+        def sds(leaf):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                        sharding=leaf.sharding)
+
+        batch_ns = NamedSharding(self._mesh, P(("dp", "fsdp")))
+        x = jax.ShapeDtypeStruct(
+            tuple(data_shape), jnp.dtype(dtype) if dtype else jnp.float32,
+            sharding=batch_ns)
+        y = jax.ShapeDtypeStruct(
+            tuple(label_shape or (int(data_shape[0]),)), jnp.float32,
+            sharding=batch_ns)
+        # RNG-neutral: analysis must not advance the global chain (the
+        # checkpoint-resume bit-identical contract, random.set_state)
+        rng_snapshot = _mxrandom.get_state()
+        try:
+            key = _mxrandom.next_key()
+        finally:
+            _mxrandom.set_state(rng_snapshot)
+        args = (jax.tree_util.tree_map(sds, self._params),
+                jax.tree_util.tree_map(sds, self._opt_state),
+                jax.tree_util.tree_map(sds, self._resids),
+                x, y, key)
+        return self._jit_step, args
 
     # -- driving -------------------------------------------------------------
     def step(self, data, label):

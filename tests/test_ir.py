@@ -1,0 +1,548 @@
+"""graftir — jaxpr-level verification of the compiled step (PR 13).
+
+Proof obligations:
+
+1. each ``ir-*`` rule catches its seeded misconfiguration — an
+   undonated step, an injected ``astype(float64)``, a dropped output,
+   a Pallas knob forced on with the kernel gated off, a reduce-scatter
+   tap stripped from the backward — with compilation/execution
+   POISONED (abstract tracing only), and the checker layer judges
+   pure-data fixture reports with ``jax.jit`` fully poisoned;
+2. the in-tree catalog gate (tier-1): every traced program is clean
+   against the committed baseline and every trainer config's jaxpr
+   collective multiset equals ``plan/schedule.py``'s prediction;
+3. with ``MXNET_PALLAS_*`` forced on, ``ir-pallas-presence`` PROVES
+   the fused optimizer sweep and the layernorm/softmax ``pallas_call``s
+   are in the traced step — and absent when the families resolve off;
+4. the five ``ir-*`` rule ids ride the SARIF reporter and the
+   stale-suppression hygiene like every other rule.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, gluon, parallel
+from mxnet_tpu.analysis import baseline as baseline_mod
+from mxnet_tpu.analysis import rule_ids, sarif_report
+from mxnet_tpu.analysis.checkers.ir_rules import (IR_RULES,
+                                                  IrDeadOutputChecker,
+                                                  run_ir_checkers)
+from mxnet_tpu.analysis.ir import (catalog_reports, schedule_multiset,
+                                   trace_program)
+from mxnet_tpu.analysis.ir.catalog import (actual_multiset,
+                                           family_expectations,
+                                           finish_report, trainer_report)
+from mxnet_tpu.analysis.plan import PlanSpec
+from mxnet_tpu.analysis.plan.configs import in_tree_live
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures")
+
+
+@pytest.fixture
+def no_compile(monkeypatch):
+    """Poison XLA compilation AND concrete dispatch: the analysis
+    paths in these tests must stay abstract (trace + lower only).
+    Tracing a jitted fn and aot-lowering it never reach
+    MeshComputation.compile; executing or jit-compiling anything does.
+    Object CONSTRUCTION (trainers place their state with device_put
+    like graftplan's catalog) happens before the poison arms — tests
+    build first, then call ``no_compile()``."""
+    import jax
+    from jax._src.interpreters import pxla
+
+    def boom(*_a, **_k):
+        raise AssertionError(
+            "XLA compile reached from the graftir abstract path")
+
+    def arm():
+        monkeypatch.setattr(pxla.MeshComputation, "compile", boom)
+        monkeypatch.setattr(jax.stages.Lowered, "compile", boom)
+        return jax
+
+    return arm
+
+
+def _sds(shape, dtype=None):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(tuple(shape), dtype or jnp.float32)
+
+
+def _dense_net():
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, in_units=8, activation="relu"),
+            nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Zero())
+    return net
+
+
+def _trainer(zero, **kw):
+    import jax
+    mesh = parallel.make_mesh(dp=8, devices=jax.devices()[:8])
+    return parallel.ParallelTrainer(
+        _dense_net(), gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh, zero=zero,
+        bucket_bytes=4096, **kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded misconfigurations — abstract tracing only
+# ---------------------------------------------------------------------------
+
+def test_seeded_undonated_step_is_donation_lost(no_compile):
+    """ACCEPTANCE: a declared donation the lowering cannot alias (the
+    donated input never reaches an output) is an ir-donation-lost
+    finding — with compile/execute poisoned throughout."""
+    jax = no_compile()
+
+    def step(x, y):
+        return y * 2.0
+
+    jit = jax.jit(step, donate_argnums=(0, 1))
+    rep = trace_program(jit, (_sds((8,)), _sds((8,))),
+                        name="ir:seeded/undonated", kind="program",
+                        origin="x.py")
+    don = rep["donation"]
+    assert don["checked"] and don["declared"] == 2
+    assert don["aliased"] == 1 and len(don["lost"]) == 1
+    findings = run_ir_checkers([rep])
+    assert [f.rule for f in findings] == ["ir-donation-lost"]
+    # the healthy form: both donations aliased, no finding
+    jit_ok = jax.jit(lambda x, y: (x + 1, y * 2), donate_argnums=(0, 1))
+    rep_ok = trace_program(jit_ok, (_sds((8,)), _sds((8,))),
+                           name="ir:ok", kind="program", origin="x.py")
+    assert rep_ok["donation"]["lost"] == []
+    assert run_ir_checkers([rep_ok]) == []
+
+
+def test_seeded_f64_injection_and_allowlist_scope(no_compile):
+    """ACCEPTANCE: an injected ``astype(float64)`` is representable
+    (tracing runs under enable_x64) and caught; a named-scope +
+    allowlist combination declares a site deliberate."""
+    jax = no_compile()
+    import jax.numpy as jnp
+
+    def step(x):
+        return (x.astype(jnp.float64) * 2.0).sum()
+
+    rep = trace_program(jax.jit(step), (_sds((8,)),),
+                        name="ir:seeded/f64", kind="program",
+                        origin="x.py")
+    assert rep["f64"], "f64 leak not visible in the traced jaxpr"
+    assert any(f.rule == "ir-dtype-drift"
+               for f in run_ir_checkers([rep]))
+
+    def deliberate(x):
+        with jax.named_scope("science_f64"):
+            return (x.astype(jnp.float64) * 2.0).sum()
+
+    rep2 = trace_program(jax.jit(deliberate), (_sds((8,)),),
+                         name="ir:allow", kind="program", origin="x.py",
+                         f64_allow=("science_f64",))
+    assert rep2["f64"] == []
+
+
+def test_seeded_forward_promotion_vs_declared_cast(no_compile):
+    jax = no_compile()
+    import jax.numpy as jnp
+
+    def promo(x):
+        return x.astype(jnp.bfloat16).astype(jnp.float32).sum()
+
+    rep = trace_program(jax.jit(promo), (_sds((8,)),),
+                        name="ir:promo", kind="program", origin="x.py")
+    assert rep["promotions"]
+
+    def declared(x):
+        y = x.astype(jnp.bfloat16)
+        with jax.named_scope("mx_decode_fp32"):
+            return y.astype(jnp.float32).sum()
+
+    rep2 = trace_program(jax.jit(declared), (_sds((8,)),),
+                         name="ir:declared", kind="program",
+                         origin="x.py")
+    assert rep2["promotions"] == []
+
+
+def test_seeded_dropped_output_and_noise_floor(no_compile):
+    """ACCEPTANCE: a computed-but-dropped matmul survives in the
+    traced (un-DCE'd) jaxpr and is an ir-dead-output finding; dead
+    work under the flop floor (AD/library expansion noise) is not."""
+    jax = no_compile()
+    import jax.numpy as jnp
+
+    def step(x):
+        dropped = x @ x.T                 # 2*16^3 = 8192 flops, unused
+        return (x * 2.0).sum()
+
+    rep = trace_program(jax.jit(step), (_sds((16, 16)),),
+                        name="ir:seeded/dead", kind="program",
+                        origin="x.py")
+    assert any(s["flops"] >= 8192 and "dot_general" in s["prims"]
+               for s in rep["dead"])
+    assert any(f.rule == "ir-dead-output"
+               for f in run_ir_checkers([rep]))
+    # under the floor: a tiny dead add is trace noise, not lost work
+    tiny = dict(rep, dead=[{"site": "x.py:1", "flops": 16, "eqns": 1,
+                            "prims": ["add"], "shape": [16]}])
+    assert run_ir_checkers([tiny]) == []
+    assert IrDeadOutputChecker.MIN_FLOPS == 512
+
+
+def test_seeded_knob_on_kernel_gated_off(no_compile, monkeypatch):
+    """ACCEPTANCE: MXNET_PALLAS_FUSED_OPT forced on while the sweep
+    silently falls back to tree_map — the spec claims the sweep, the
+    traced step has no pallas_call, ir-pallas-presence fires."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    tr = _trainer(zero=2)
+    spec = PlanSpec.from_trainer(tr)
+    assert spec.optimizer.get("fused_sweep") is True
+    from mxnet_tpu.parallel import optimizer as popt
+    monkeypatch.setattr(popt, "_fused_sweep_on", lambda flat: False)
+    no_compile()
+    rep = trainer_report(tr, spec, data_shape=(16, 8))
+    assert rep["pallas"]["found"] == []
+    findings = run_ir_checkers([rep])
+    assert any(f.rule == "ir-pallas-presence"
+               and "silently fell back" in f.message for f in findings)
+
+
+def test_seeded_tap_stripped_schedule_mismatch(no_compile, monkeypatch):
+    """ACCEPTANCE: strip the backward tap that attaches the bucket's
+    reduce-scatter — the jaxpr loses the collective and the multiset
+    no longer equals plan/schedule.py's prediction."""
+    from mxnet_tpu.parallel import trainer as trainer_mod
+    monkeypatch.setattr(trainer_mod, "_make_bucket_tap",
+                        lambda sharding, bucket: lambda x: x)
+    tr = _trainer(zero=2)
+    spec = PlanSpec.from_trainer(tr)
+    no_compile()
+    rep = trainer_report(tr, spec, data_shape=(16, 8))
+    assert sorted(map(tuple, rep["schedule_expect"])) != \
+        sorted(map(tuple, rep["schedule_actual"]))
+    findings = run_ir_checkers([rep])
+    assert any(f.rule == "ir-collective-schedule"
+               and "reduce_scatter" in f.message for f in findings)
+
+
+def test_zero0_implied_credit_requires_sharded_batch(no_compile):
+    """The zero-0 bucket all-reduces are GSPMD-implied; the IR only
+    credits them when the traced program's batch is actually sharded
+    over the mesh — un-shard it and the schedule mismatch fires."""
+    tr = _trainer(zero=0)
+    spec = PlanSpec.from_trainer(tr)
+    no_compile()
+    rep = trainer_report(tr, spec, data_shape=(16, 8))
+    assert sorted(map(tuple, rep["schedule_expect"])) == \
+        sorted(map(tuple, rep["schedule_actual"]))
+    assert rep["schedule_expect"]          # non-vacuous: 1+ all_reduce
+    rep["batch_sharded"] = False
+    rep["schedule_actual"] = actual_multiset(rep, spec)
+    assert rep["schedule_actual"] == []
+    assert any(f.rule == "ir-collective-schedule"
+               for f in run_ir_checkers([rep]))
+
+
+# ---------------------------------------------------------------------------
+# checker layer: pure data, jax.jit FULLY poisoned
+# ---------------------------------------------------------------------------
+
+def test_checker_fixtures_with_jit_poisoned(monkeypatch):
+    """Every ir-* rule catches its fixture report with jax.jit fully
+    poisoned — the judging path is pure data, like graftplan's."""
+    import jax
+
+    def boom(*_a, **_k):
+        raise AssertionError("jax.jit reached from the IR checker path")
+
+    monkeypatch.setattr(jax, "jit", boom)
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "ir_bad_reports.json")))
+    seen = set()
+    for entry in doc["reports"]:
+        findings = run_ir_checkers([entry["report"]])
+        rules = {f.rule for f in findings}
+        assert entry["expect_rule"] in rules, \
+            (entry["report"]["name"], rules)
+        seen.add(entry["expect_rule"])
+    assert seen == set(IR_RULES)
+
+
+def test_sarif_coverage_of_ir_rules():
+    """Satellite: the SARIF reporter covers the five ir-* rule ids —
+    same fingerprint/level machinery as every other rule."""
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "ir_bad_reports.json")))
+    findings = run_ir_checkers([e["report"] for e in doc["reports"]])
+    sarif = json.loads(sarif_report(findings))
+    ids = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert ids == set(IR_RULES)
+    for res in sarif["runs"][0]["results"]:
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+        assert res["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"].startswith("mxnet_tpu/")
+    assert set(rule_ids()) >= ids
+
+
+def test_stale_suppression_handles_ir_rules(tmp_path):
+    """Satellite: an inline suppression naming an ir-* rule that
+    suppresses nothing is stale, like any static rule (ir rules are
+    NOT runtime rules — a static run does re-derive them)."""
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        def f(x):
+            return x  # graftlint: disable=ir-dtype-drift
+    """))
+    findings = analysis.run([str(tmp_path)], root=str(tmp_path))
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert len(stale) == 1 and "ir-dtype-drift" in stale[0].message
+
+
+# ---------------------------------------------------------------------------
+# hooks + cost model
+# ---------------------------------------------------------------------------
+
+def test_executor_step_callable_modes(no_compile):
+    from mxnet_tpu.analysis.plan.configs import convnet_symbol
+    exe = convnet_symbol().simple_bind(data=(8, 3, 16, 16))
+    with pytest.raises(mx.base.MXNetError):
+        exe.step_callable(mode="fused")     # nothing installed
+    with pytest.raises(mx.base.MXNetError):
+        exe.step_callable(mode="nope")
+    # install BEFORE arming the poison: it runs one real jitted copy
+    # program to decouple the weight buffers (executor.py)
+    assert exe.install_fused_update(
+        mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    no_compile()
+    for mode in ("eval", "train"):
+        jit_fn, args = exe.step_callable(mode=mode)
+        traced = jit_fn.trace(*args)        # must not compile
+        assert traced.jaxpr is not None
+    jit_fn, args = exe.step_callable(mode="fused")
+    rep = trace_program(jit_fn, args, name="ir:t/fused",
+                        kind="program", origin="x.py")
+    assert rep["donation"]["declared"] > 0
+    assert rep["donation"]["checked"] and rep["donation"]["lost"] == []
+
+
+def test_cost_model_dot_exact_and_scan_scaled(no_compile):
+    jax = no_compile()
+    import jax.numpy as jnp
+
+    def f(x):
+        return x @ x
+
+    rep = trace_program(jax.jit(f), (_sds((32, 32)),),
+                        name="ir:cost", kind="program", origin="x.py")
+    assert rep["cost"]["flops"] == 2 * 32 * 32 * 32
+    assert rep["cost"]["bytes"] >= 3 * 32 * 32 * 4
+    assert rep["cost"]["by_prim"]["dot_general"]["eqns"] == 1
+
+    def g(x):
+        def body(c, _):
+            return c @ x, ()
+        out, _ = jax.lax.scan(body, x, None, length=5)
+        return out
+
+    rep2 = trace_program(jax.jit(g), (_sds((16, 16)),),
+                         name="ir:scan", kind="program", origin="x.py")
+    # the body's matmul is charged once per trip (plus scan plumbing)
+    assert rep2["cost"]["by_prim"]["dot_general"]["flops"] == \
+        5 * 2 * 16 * 16 * 16
+
+    # wrapper eqns (nested jit) are priced by their bodies ONLY — the
+    # pjit wrapper itself must not double-count the program
+    def h(x):
+        return jax.jit(f)(x)
+
+    rep3 = trace_program(jax.jit(h), (_sds((32, 32)),),
+                         name="ir:nested", kind="program", origin="x.py")
+    assert rep3["cost"]["flops"] == rep["cost"]["flops"]
+    assert "pjit" not in rep3["cost"]["by_prim"]
+
+
+def test_cost_report_file_and_restricted_baseline_update(tmp_path,
+                                                         monkeypatch):
+    """MXNET_IR_COST_REPORT lands the per-program CostReports on disk;
+    --ir's baseline refresh is a RESTRICTED merge (out-of-scope
+    entries preserved, audit annotations carried)."""
+    from mxnet_tpu.analysis.cli import _restricted_update, \
+        _write_cost_report
+    from mxnet_tpu.analysis.core import Finding
+    path = tmp_path / "cost.json"
+    monkeypatch.setenv("MXNET_IR_COST_REPORT", str(path))
+    _write_cost_report([{"name": "p", "kind": "program", "origin": "o",
+                         "cost": {"flops": 1, "bytes": 2, "eqns": 3,
+                                  "estimated": False, "by_prim": {}}}])
+    doc = json.loads(path.read_text())
+    assert doc["programs"][0]["cost"]["flops"] == 1
+
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "findings": [
+        {"rule": "host-sync", "severity": "warning",
+         "path": "mxnet_tpu/x.py", "line": 1, "symbol": "f",
+         "message": "m", "fingerprint": "deadbeefdeadbeef"},
+        {"rule": "ir-dead-output", "severity": "warning",
+         "path": "mxnet_tpu/y.py", "line": 1, "symbol": "g",
+         "message": "old", "fingerprint": "feedfacefeedface",
+         "audit": {"verdict": "never-exercised"}}]}))
+    f = Finding("ir-dead-output", "warning", "mxnet_tpu/z.py", 1,
+                "fresh", symbol="ir:p")
+    assert _restricted_update([f], str(bl), IR_RULES) == 0
+    doc = json.loads(bl.read_text())
+    rules = sorted(e["rule"] for e in doc["findings"])
+    # host-sync preserved (out of scope), stale ir entry dropped
+    # (re-derived scope), fresh ir finding added
+    assert rules == ["host-sync", "ir-dead-output"]
+    assert {e["message"] for e in doc["findings"]} == {"m", "fresh"}
+
+
+# ---------------------------------------------------------------------------
+# 3. pallas presence — both directions, acceptance
+# ---------------------------------------------------------------------------
+
+def test_pallas_forced_on_proves_fused_kernels(no_compile, monkeypatch):
+    """ACCEPTANCE: with MXNET_PALLAS_* forced on, the traced programs
+    PROVE the one-sweep optimizer and the layernorm/softmax kernels
+    are in the step — and the reports gate clean."""
+    monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", "1")
+    monkeypatch.setenv("MXNET_PALLAS_NORM", "1")
+    monkeypatch.setenv("MXNET_PALLAS_SOFTMAX", "1")
+    tr = _trainer(zero=2)
+    spec = PlanSpec.from_trainer(tr)
+    d = mx.sym.Variable("data")
+    n = mx.sym.LayerNorm(d, name="ln")
+    n = mx.sym.FullyConnected(n, num_hidden=4, name="fc")
+    n = mx.sym.SoftmaxOutput(n, name="softmax")
+    exe = n.simple_bind(data=(8, 128))
+    pspec = PlanSpec.from_executor(exe, name="program/ln")
+    no_compile()
+    rep = trainer_report(tr, spec, data_shape=(16, 8))
+    assert "_sgd_mom_kernel" in rep["pallas"]["found"]
+    jit_fn, args = exe.step_callable(mode="train")
+    prep = trace_program(jit_fn, args, name="ir:program/ln",
+                         kind="program", origin="mxnet_tpu/executor.py")
+    ops = {nd.get("op") for nd in pspec.graph["nodes"]}
+    prep = finish_report(prep, pspec,
+                         family_expectations(spec=pspec, graph_ops=ops))
+    found = set(prep["pallas"]["found"])
+    assert {"_layernorm_fwd_kernel", "_softmax_fwd_kernel"} <= found
+    assert run_ir_checkers([rep, prep]) == []
+
+
+def test_pallas_off_means_absent(no_compile, monkeypatch):
+    for knob in ("MXNET_PALLAS_FUSED_OPT", "MXNET_PALLAS_NORM",
+                 "MXNET_PALLAS_SOFTMAX", "MXNET_PALLAS_BN_RELU"):
+        monkeypatch.setenv(knob, "0")
+    tr = _trainer(zero=2)
+    spec = PlanSpec.from_trainer(tr)
+    rep = trainer_report(tr, spec, data_shape=(16, 8))
+    assert rep["pallas"]["found"] == []
+    # presence while off is the other direction of the rule
+    rep["pallas"]["found"] = ["_sgd_mom_kernel"]
+    assert any(f.rule == "ir-pallas-presence"
+               for f in run_ir_checkers([rep]))
+
+
+# ---------------------------------------------------------------------------
+# 2. the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_in_tree_catalog_clean_and_schedules_match():
+    """THE gate: graftir over the shipping configurations — every
+    trainer config's jaxpr collective multiset equals schedule.py's
+    prediction, every declared donation is verified aliased in the
+    lowered program, and the tree-wide run ends 0 new findings
+    against the committed baseline."""
+    reports = catalog_reports(width=8)
+    names = {r["name"] for r in reports}
+    assert {"ir:trainer/zero0-dp8", "ir:trainer/zero2-dp8",
+            "ir:trainer/multichip-zero2-bf16-dp8",
+            "ir:program/convnet/train",
+            "ir:program/convnet-fused"} <= names
+    assert any(n.startswith("ir:serving/warmup-ladder/b") for n in names)
+    for r in reports:
+        assert sorted(map(tuple, r["schedule_expect"])) == \
+            sorted(map(tuple, r["schedule_actual"])), r["name"]
+        assert r["donation"]["lost"] == [], r["name"]
+        if r["kind"] == "trainer":
+            assert r["donation"]["declared"] > 0 \
+                and r["donation"]["checked"], r["name"]
+        assert r["cost"]["flops"] > 0
+    # non-vacuous: the zero>=1 trainers carry explicit tagged
+    # collectives, zero0 the implied credit
+    assert any(r["collectives"] for r in reports)
+    assert any(r["schedule_expect"] and not r["collectives"]
+               for r in reports if r["kind"] == "trainer")
+    findings = run_ir_checkers(reports)
+    known = baseline_mod.load(baseline_mod.default_path(ROOT))
+    new, _old = baseline_mod.filter_new(findings, known)
+    assert not new, [f.message for f in new]
+
+
+def test_schedule_multiset_matches_plan_schedule_shape():
+    """The canonical multiset is derived from plan/schedule.py itself
+    — one formula, two witnesses."""
+    for spec, _m, live in in_tree_live(width=8):
+        if spec.kind != "trainer":
+            continue
+        ms = schedule_multiset(spec)
+        from mxnet_tpu.analysis.plan.schedule import build_schedule
+        assert len(ms) == len(build_schedule(spec))
+
+
+# ---------------------------------------------------------------------------
+# CLI round trips (slow: subprocesses trace the full catalog)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_ir_roundtrip():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--ir", "--json"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    names = {rep["name"] for rep in doc["ir"]["reports"]}
+    assert "ir:trainer/zero2-dp8" in names
+    assert doc["summary"]["new"] == 0
+
+
+@pytest.mark.slow
+def test_cli_all_roundtrip():
+    """--all: lint + plan + ir in one process, one merged baseline
+    pass, one exit code."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--all", "--json"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=560)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["plan"]["verify_problems"] == []
+    assert doc["ir"]["enabled"] is True
+    assert {rep["name"] for rep in doc["ir"]["reports"]} >= \
+        {"ir:program/convnet-fused"}
+    assert doc["summary"]["new"] == 0
+    # mutually exclusive with the single-leg flags
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--all", "--plan"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=120)
+    assert r2.returncode == 2
+    # --changed is the whole-catalog fast path: diffing a ref against
+    # itself changes nothing, so the catalog run is skipped entirely
+    r3 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+         "--ir", "--changed", "HEAD...HEAD"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=120)
+    assert r3.returncode == 0 and "no changed" in r3.stdout

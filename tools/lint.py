@@ -1,23 +1,30 @@
 #!/usr/bin/env python
 """graftlint launcher — ``tools/lint.py [paths...] [--changed [REF]]
 [--json | --sarif] [--rule R] [--stale] [--update-baseline]
-[--cache PATH | --no-cache] [--plan] [--audit-suppressions]``.
+[--cache PATH | --no-cache] [--plan] [--ir] [--all]
+[--audit-suppressions]``.
 
 Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
 by putting the repo root on ``sys.path`` first.  The pre-push habit is
 ``tools/lint.py --changed`` — git-derived file set + the incremental
 cache, so it is near-instant (fixture-only edits under
 ``tests/fixtures/`` re-lint the analysis package, whose tests consume
-them).  Two modes leave the pure-AST world: ``--plan`` runs graftplan
+them).  Modes that leave the pure-AST world: ``--plan`` runs graftplan
 (static shape/sharding/memory analysis) over the in-tree
 configuration catalog — it instantiates trainers but never steps or
-XLA-compiles them — and ``--audit-suppressions`` EXECUTES a built-in
+XLA-compiles them; ``--ir`` runs graftir — the same catalog's step/
+serving programs ABSTRACTLY traced (``jax.jit(...).trace`` + aot
+lowering, nothing compiles) and verified at the jaxpr level (donation
+aliasing, dtype drift, dead outputs, collective schedule, Pallas
+presence, static cost model); ``--all`` runs lint + plan + ir in one
+process with ONE merged baseline pass and one exit code (the tier-1/
+CI entry point); and ``--audit-suppressions`` EXECUTES a built-in
 workload under the graftsan sanitizers, classifying every
 suppression/baseline entry as runtime-confirmed / never-exercised /
 contradicted (contradictions fail).  See
 ``docs/faq/static_analysis.md`` for the rule catalog, the
 whole-program engine, suppression syntax, the baseline workflow, the
-plan-analysis section, and the sanitizer catalog.
+plan/IR sections, and the sanitizer catalog.
 """
 import os
 import sys
@@ -26,7 +33,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-if "--plan" in sys.argv:
+if {"--plan", "--ir", "--all"} & set(sys.argv):
     # the full catalog wants the virtual 8-device mesh (same trick as
     # tests/conftest.py); must be set before jax initializes, which the
     # mxnet_tpu import below triggers.  Explicit env always wins.
