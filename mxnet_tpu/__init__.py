@@ -75,3 +75,12 @@ if any(config.get(_k) for _k in (
         "MXNET_SAN_LOCK_ORDER", "MXNET_SAN_DONATION")):
     from .analysis import sanitizers as _sanitizers
     _sanitizers.install()
+
+# graftfault: arm the fault-injection plan at import when
+# MXNET_FAULT_PLAN is set — drills and chaos soaks configure child
+# processes purely through the environment, same convention as the
+# sanitizers above.  Unset costs one config read here and one boolean
+# per instrumented site (mxnet_tpu/fault/hooks.py).
+from . import fault  # noqa: F401,E402
+if config.get("MXNET_FAULT_PLAN"):
+    fault.install()

@@ -19,13 +19,18 @@ the same primitive.
 
 This module is dependency-free on purpose — it is imported from
 ``ndarray``/``symbol``/``module``, all of which load before higher
-subsystems exist.
+subsystems exist.  (``fault.hooks`` is the one exception: itself a
+dependency-free leaf, it lets the ``atomic_io.commit`` injection site
+drill torn writes and ENOSPC through this exact protocol —
+docs/faq/fault_tolerance.md.)
 """
 from __future__ import annotations
 
 import contextlib
 import os
 import uuid
+
+from .fault import hooks as _fault
 
 __all__ = ["atomic_writer", "atomic_write"]
 
@@ -49,6 +54,13 @@ def atomic_writer(path, mode="wb"):
     committed = False
     try:
         yield f
+        # graftfault: a torn-write/ENOSPC injected here corrupts or
+        # fails the TEMP file after the payload was written — the crash
+        # window this module exists to close; the target must stay
+        # untouched (tests/test_fault.py holds legacy nd.save /
+        # Symbol.save to that)
+        if _fault.ACTIVE[0]:
+            _fault.fire("atomic_io.commit", file=f, path=path)
         f.flush()
         os.fsync(f.fileno())
         f.close()

@@ -1,0 +1,71 @@
+"""swallowed-exception — broad catches that eat errors on threads.
+
+On the main thread a swallowed exception is at least *visible* as
+wrong behavior near the call site.  On a worker thread — a
+``threading.Thread`` target, or anything inside an
+``engine.worker_scope`` block — a bare ``except:`` /
+``except Exception: pass`` (or log-and-continue) makes the failure
+vanish with the thread: the training loop keeps running on a dead
+prefetcher, the server keeps accepting requests its batcher will never
+serve, the checkpoint writer "succeeds" with nothing on disk.  The
+threaded-engine contract (``engine.py``) exists precisely so this
+cannot happen: a worker failure must reach a receiver — re-raise,
+deliver to the waiter's future, or ``engine.record_exception`` so the
+next sync point rethrows it.
+
+The fault-injection subsystem (``mxnet_tpu/fault/``) is what makes
+these paths testable — and what made the gaps visible: an injected
+``io_error`` at a swallowing site disappears without a trace, so the
+drill cannot even assert the degradation happened.
+
+Whole-program: the handler summaries come from ``project.py``
+(``rec["handlers"]``: only broad + swallowing handlers are recorded),
+the reachability verdict from the engine's thread set
+(``index.threaded``: Thread targets + transitive callees) and the
+lexical ``worker_scope`` flag.  A swallow in main-thread-only code is
+deliberately NOT flagged — the caller sees the consequences there.
+"""
+from __future__ import annotations
+
+from ..core import Checker, Finding, register
+
+__all__ = ["SwallowedExceptionChecker"]
+
+
+@register
+class SwallowedExceptionChecker(Checker):
+    rule = "swallowed-exception"
+    severity = "warning"
+    suffixes = (".py",)
+
+    def check(self, path, relpath, text, tree, ctx):
+        return []   # whole-program rule: see check_project
+
+    def check_project(self, index, ctx):
+        out = []
+        for fq in sorted(index.fns):
+            rec = index.fns[fq]
+            handlers = rec.get("handlers") or ()
+            if not handlers:
+                continue
+            threaded_via = index.threaded.get(fq)
+            symbol = fq.split(":", 1)[1]
+            for h in handlers:
+                if threaded_via is None and not h["ws"]:
+                    continue
+                where = ("worker_scope block"
+                         if h["ws"] and threaded_via is None
+                         else "thread spawned via %s"
+                         % threaded_via.split(":", 1)[1])
+                out.append(Finding(
+                    self.rule, self.severity, index.fn_file[fq],
+                    h["line"],
+                    "%s swallows the error on a thread-reachable path "
+                    "(%s) — the failure vanishes with the worker and "
+                    "no waiter ever learns; re-raise, deliver it to "
+                    "the receiver, or engine.record_exception so the "
+                    "next sync point rethrows "
+                    "(docs/faq/static_analysis.md)"
+                    % (h["what"], where),
+                    symbol=symbol))
+        return out

@@ -51,7 +51,7 @@ __all__ = ["SUMMARY_VERSION", "module_name", "summarize", "ProjectIndex"]
 
 # bump when the summary shape or any dataflow pass changes meaning —
 # the incremental cache keys on it
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2
 
 _JIT_TAILS = frozenset(("jit", "pjit"))
 _TRACE_TAILS = frozenset(("grad", "value_and_grad", "vmap", "remat",
@@ -222,6 +222,7 @@ class _FnScope:
             "hazards": [],
             "stores": [],
             "gmuts": [],
+            "handlers": [],
             "axis_lits": [],
             "mesh_user": bool(
                 any(_MESH_PARAM_RE.match(p) for p in _fn_params(node))),
@@ -517,6 +518,10 @@ def summarize(relpath, text, tree):
                 _scan_call(node, rec, local_names, new_loop,
                            with_locks(new_withs),
                            in_worker_scope(new_withs), summary)
+            elif isinstance(node, ast.ExceptHandler):
+                site = _scan_handler(node, in_worker_scope(new_withs))
+                if site is not None:
+                    rec["handlers"].append(site)
             elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
                 uses = _value_uses(node.test, set(rec["params"]))
                 if uses:
@@ -699,6 +704,73 @@ def _scan_gmut_assign(node, rec, summary, local_names, locks, ws, loop,
         rec["gmuts"].append({
             "line": node.lineno, "parts": parts, "what": what,
             "locks": locks, "ws": ws})
+
+
+_BROAD_EXC = frozenset(("Exception", "BaseException"))
+# handler-body calls that count as "just narrating": pure logging, no
+# routing of the exception anywhere a waiter could see it
+_LOG_CALL_TAILS = frozenset(("debug", "info", "warning", "warn", "error",
+                             "exception", "critical", "log", "print"))
+# handler-body calls that DO route the exception: the engine's deferred
+# surface, a deliver callback, or warning machinery a caller observes
+_ROUTE_CALL_TAILS = frozenset(("record_exception", "deliver",
+                               "_set_exception", "set_exception"))
+
+
+# calls harmless inside a log line's arguments (formatting helpers) —
+# they neither handle nor route the exception
+_NEUTRAL_CALL_TAILS = frozenset(("type", "str", "repr", "format", "len",
+                                 "getattr", "join"))
+
+
+def _walk_pruned(stmts):
+    """ast.walk over ``stmts`` that does NOT descend into nested
+    function/lambda bodies (those are judged as their own scopes)."""
+    stack = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_handler(node, ws):
+    """Summarize one ``except`` handler when (and only when) it both
+    catches broadly (bare / ``Exception`` / ``BaseException``) and
+    SWALLOWS — no re-raise, no ``record_exception``/deliver routing, a
+    body of nothing but ``pass``/``continue``/logging.  Anything with
+    real handling statements is presumed to handle; precision beyond
+    that belongs to a human reading the finding."""
+    names = []
+    if node.type is not None:
+        for t in (node.type.elts if isinstance(node.type, ast.Tuple)
+                  else [node.type]):
+            p = _parts_of(t)
+            names.append(p[-1] if p else "?")
+        if not any(n in _BROAD_EXC for n in names):
+            return None
+    for sub in _walk_pruned(node.body):
+        if isinstance(sub, ast.Raise):
+            return None
+        if isinstance(sub, ast.Call):
+            p = _parts_of(sub.func)
+            tail = p[-1] if p else ""
+            if tail in _ROUTE_CALL_TAILS:
+                return None
+            if tail not in _LOG_CALL_TAILS \
+                    and tail not in _NEUTRAL_CALL_TAILS:
+                return None   # real handling work
+        if isinstance(sub, (ast.Return, ast.Assign, ast.AugAssign,
+                            ast.AnnAssign, ast.Delete, ast.Yield,
+                            ast.YieldFrom, ast.Await, ast.Global,
+                            ast.Nonlocal)):
+            return None   # handling: state change or value flow
+    return {"line": node.lineno,
+            "what": ("bare except" if node.type is None
+                     else "except %s" % "/".join(names)),
+            "ws": bool(ws)}
 
 
 def _scan_call(node, rec, local_names, loop, locks, ws, summary):

@@ -406,8 +406,93 @@ def builtin_workload():
         gnet.initialize()
         gnet.hybridize()
         gnet(nd.ones((2, 8))).asnumpy()
+
+        # -- fault-injection leg (graftfault): drive the DEGRADATION
+        # -- paths whose suppressions only execute under faults --------
+        _fault_leg(mod, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _fault_leg(mod, tmp):
+    """Exercise the fault-handling suppression sites under an armed
+    FaultPlan (docs/faq/fault_tolerance.md):
+
+    - the executor cache's best-effort warmup-manifest swallow
+      (``serving/cache.py`` — on_miss raises: manifest parent is a
+      file);
+    - the watcher's promote-anyway swallow (``serving/registry.py`` —
+      an injected ``serving.cache.get`` fault fails warmup_version);
+    - the elastic driver's per-step loss sync (``fault/elastic.py``)
+      and the ParallelTrainerState scalar coercion
+      (``checkpoint/state.py``) via a 1-device run_elastic cycle with
+      an injected mid-run fault and a restore."""
+    import jax as _jax
+    import numpy as _np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, nd, parallel
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.fault.backoff import BackoffPolicy
+    from mxnet_tpu.fault.elastic import ElasticSupervisor, run_elastic
+
+    # (a) cache on_miss swallow: the warmup-manifest hook fails (any
+    # hook failure class — WarmupManifest.record itself degrades, so
+    # the drill injects at the hook boundary the swallow guards)
+    srv2 = mx.serving.ModelServer(max_batch=4, batch_wait_ms=1.0)
+    mod.export_serving("m2", srv2)
+
+    def _boom(entry, bucket):
+        raise OSError("graftfault: injected manifest-hook failure")
+
+    srv2.cache._on_miss = _boom
+    srv2.warmup("m2", buckets=[1])      # miss -> hook raises -> swallow
+    srv2.stop(drain=False)              # close the steady-state region
+    srv2.cache.clear()
+
+    # (b) watcher promote-anyway swallow under an injected warmup fault
+    ckdir = os.path.join(tmp, "fault-ck")
+    mgr = CheckpointManager(directory=ckdir, async_save=False)
+    mgr.save_module(mod, epoch=1, block=True)
+    srv3 = mx.serving.ModelServer(max_batch=4, batch_wait_ms=1.0)
+    watcher = srv3.watch_checkpoints(ckdir, "m3", start=False)
+    with fault.active_plan({"rules": [
+            {"site": "serving.cache.get", "kind": "raise",
+             "exc": "RuntimeError", "times": 0}]}):
+        served = watcher.poll_once()    # warmup fails, promotion proceeds
+    assert served is not None
+    srv3.stop(drain=False)
+    srv3.cache.clear()
+
+    # (c) elastic trainer cycle: injected fault + restore + resume
+    pnet = mx.gluon.nn.HybridSequential(prefix="auditnet_")
+    with pnet.name_scope():
+        pnet.add(mx.gluon.nn.Dense(4, in_units=8))
+    pnet.initialize()
+
+    def factory(restart):
+        return parallel.ParallelTrainer(
+            pnet, mx.gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1, "momentum": 0.9},
+            mesh=parallel.make_mesh(dp=1, devices=_jax.devices()[:1]),
+            zero=2, bucket_bytes=64)
+
+    rng = _np.random.RandomState(5)
+    X = rng.randn(16, 8).astype(_np.float32)
+    Y = rng.randint(0, 4, 16).astype(_np.float32)
+
+    def data_fn(step):
+        i = (step * 4) % 16
+        return nd.array(X[i:i + 4]), nd.array(Y[i:i + 4])
+
+    fast = BackoffPolicy(retries=4, base_s=0.001, max_s=0.002,
+                         sleep=lambda s: None)
+    with fault.active_plan({"rules": [
+            {"site": "elastic.step", "kind": "raise",
+             "exc": "OSError", "step": 1, "times": 1}]}):
+        run_elastic(factory, data_fn, 3,
+                    os.path.join(tmp, "elastic-ck"),
+                    supervisor=ElasticSupervisor(retries=2, backoff=fast))
 
 
 def run_audit(workload=None, root=None):

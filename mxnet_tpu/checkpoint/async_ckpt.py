@@ -121,6 +121,13 @@ class AsyncCheckpointer:
 
     def _run(self, step, arrays, blobs, meta):
         with engine.worker_scope(deliver=self._deliver):
+            # graftfault: a fault on the writer thread must land in
+            # _deliver (failure counted, training untouched), never
+            # poison global sync points — the containment this scope
+            # exists to prove
+            from ..fault import hooks as _fault
+            if _fault.ACTIVE[0]:
+                _fault.fire("checkpoint.async.worker", step=step)
             write_checkpoint(self.store, step, arrays, blobs=blobs,
                              meta=meta, retention=self.retention)
 

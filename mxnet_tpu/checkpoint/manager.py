@@ -127,10 +127,18 @@ class CheckpointManager:
     def save_module(self, module, epoch=0, nbatch=0, global_step=None,
                     train_data=None, block=False):
         """Capture ``module`` (+ loop/RNG/iterator state) and persist it
-        — THE save entry point for fit hooks and callbacks."""
-        state = TrainState.capture(module, epoch=epoch, nbatch=nbatch,
-                                   global_step=global_step,
-                                   train_data=train_data)
+        — THE save entry point for fit hooks and callbacks.
+
+        Capture's device_get staging is a deliberate sync whoever the
+        caller is, so it runs under the graftsan suspension here (fit's
+        call sites used to carry their own scope; manager-level is the
+        one place every caller — elastic driver, chaos drills, user
+        scripts — inherits it)."""
+        from ..analysis.sanitizers import hooks as _san_hooks
+        with _san_hooks.suspended():
+            state = TrainState.capture(module, epoch=epoch, nbatch=nbatch,
+                                       global_step=global_step,
+                                       train_data=train_data)
         return self.save_state(state, block=block)
 
     # -- restore -------------------------------------------------------------

@@ -49,6 +49,7 @@ import uuid
 import numpy as np
 
 from ..base import MXNetError
+from ..fault import hooks as _fault
 
 __all__ = ["CheckpointError", "IntegrityError", "CheckpointStore",
            "RetentionPolicy", "MANIFEST_NAME", "MANIFEST_FORMAT",
@@ -161,6 +162,12 @@ class CheckpointStore:
                 fname = _shard_file(name, used=used_names)
                 with open(os.path.join(tmp, fname), "wb") as f:
                     f.write(data)
+                    # graftfault: torn-write/ENOSPC while the shard is
+                    # still inside .tmp-* — the temp dir must stay
+                    # invisible and gc-able, never half-committed
+                    if _fault.ACTIVE[0]:
+                        _fault.fire("checkpoint.store.shard_write",
+                                    file=f, shard=name)
                     f.flush()
                     os.fsync(f.fileno())
                 manifest["shards"][name] = {
@@ -184,6 +191,12 @@ class CheckpointStore:
                 json.dump(manifest, f, indent=1, sort_keys=True)
                 f.flush()
                 os.fsync(f.fileno())
+            # graftfault: a fault here (crash, transient IO error,
+            # SIGKILL) lands in the widest window — everything written,
+            # nothing committed; recovery must see no ckpt-N and one
+            # orphan temp dir
+            if _fault.ACTIVE[0]:
+                _fault.fire("checkpoint.store.commit", step=step, tmp=tmp)
             os.replace(tmp, final)
             self._fsync_root()
             return final
@@ -233,6 +246,11 @@ class CheckpointStore:
     def manifest(self, step):
         path = os.path.join(self.path(step), MANIFEST_NAME)
         try:
+            # graftfault: transient manifest-read failures (flaky NFS,
+            # mid-rename rack move) — consumers (watcher, restore walk,
+            # elastic driver) must retry or fall back, never crash
+            if _fault.ACTIVE[0]:
+                _fault.fire("checkpoint.store.manifest_read", step=step)
             with open(path) as f:
                 return json.load(f)
         except (OSError, ValueError) as exc:

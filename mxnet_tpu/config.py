@@ -307,6 +307,33 @@ register_env("MXNET_PALLAS_OPT_BUCKET_BYTES", int, 0,
              "bucket size cap for the executor fused step's optimizer "
              "sweep (params flattened into contiguous fp32 buckets); "
              "<= 0 sweeps everything as one monolithic bucket")
+register_env("MXNET_FAULT_PLAN", str, None,
+             "deterministic fault-injection schedule (graftfault): "
+             "inline JSON or @/path/to/plan.json; armed at import, "
+             "every instrumented site then consults it "
+             "(docs/faq/fault_tolerance.md has the site catalog and "
+             "rule vocabulary); unset = one boolean per site")
+register_env("MXNET_FAULT_RETRIES", int, 3,
+             "default retry budget of the shared BackoffPolicy "
+             "(fault/backoff.py): elastic training restarts, watcher "
+             "transient reads, kvstore weight reads, serving submit "
+             "retries; per-call-site overrides win")
+register_env("MXNET_FAULT_BACKOFF_BASE_S", float, 0.5,
+             "first-retry delay of the shared BackoffPolicy; "
+             "subsequent delays multiply by 2 up to "
+             "MXNET_FAULT_BACKOFF_MAX_S")
+register_env("MXNET_FAULT_BACKOFF_MAX_S", float, 30.0,
+             "cap on any single BackoffPolicy delay")
+register_env("MXNET_FAULT_BACKOFF_JITTER", float, 0.25,
+             "jitter fraction of BackoffPolicy delays (each delay is "
+             "scaled by a seeded uniform draw from [1-j, 1+j]) so a "
+             "preempted fleet does not retry in lockstep")
+register_env("MXNET_SERVING_SUBMIT_RETRIES", int, 0,
+             "opt-in client-side retry budget for serving submissions "
+             "rejected with QueueFull: infer()/infer_async() re-submit "
+             "up to this many times, sleeping the error's retry_after_s "
+             "hint with BackoffPolicy jitter; 0 (default) surfaces "
+             "QueueFull to the caller unchanged")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

@@ -1,0 +1,41 @@
+"""graftfault — deterministic fault injection + elastic training.
+
+Three layers (docs/faq/fault_tolerance.md):
+
+- :mod:`.hooks` — the dependency-free leaf instrumented sites import;
+  one boolean per site while no plan is armed;
+- :mod:`.plan` — :class:`FaultPlan`: seeded, site/step-addressed fault
+  schedules (raise / transient-IO / torn-write / delay / SIGTERM /
+  SIGKILL / hard-exit), armed process-wide via ``MXNET_FAULT_PLAN`` or
+  :func:`install`;
+- :mod:`.elastic` — the supervised training runtime the injection core
+  exists to drill: restore-and-retry with a budgeted
+  :class:`~.backoff.BackoffPolicy`, topology change on re-entry
+  (``ParallelTrainer`` mesh-width shrink/grow through
+  ``checkpoint/compat.check_restore_compat``), and exact batch replay.
+
+``elastic`` imports the checkpoint/parallel stack, so it loads lazily —
+the package itself must stay importable from ``_atomic_io`` (which
+loads before everything)."""
+from __future__ import annotations
+
+from . import hooks  # noqa: F401
+from .backoff import BackoffPolicy  # noqa: F401
+from .plan import (FaultInjected, FaultPlan, active_plan,  # noqa: F401
+                   install, installed, uninstall)
+
+__all__ = ["hooks", "BackoffPolicy", "FaultPlan", "FaultInjected",
+           "install", "uninstall", "installed", "active_plan",
+           "elastic", "ElasticError", "ElasticSupervisor", "run_elastic"]
+
+_LAZY = ("elastic", "ElasticError", "ElasticSupervisor", "run_elastic")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import elastic
+        if name == "elastic":
+            return elastic
+        return getattr(elastic, name)
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

@@ -1,0 +1,477 @@
+"""The graftfault drills — executable proof the elastic runtime works.
+
+Two drills, both usable from tests (``tests/test_fault.py``, slow
+markers) and from the command line (``python -m mxnet_tpu.fault.drill``
+writes the MULTICHIP record):
+
+- :func:`elastic_kill_drill` — the MULTICHIP leg: a training worker is
+  SIGKILLed MID-RUN by an injected plan (``elastic.step`` addressed at
+  an exact global step), restarted on a DIFFERENT virtual mesh width
+  (shrink, then grow), and the stitched loss curve must match an
+  uninterrupted oracle — exactly where PR 7's reshard guarantee
+  applies.  Workers are real subprocesses (a SIGKILL takes no
+  cleanup path, exactly like a preempted VM); each leaves a per-step
+  loss log behind, and overlapping steps between a victim and its
+  successor must agree — the no-skip/no-double witness.
+
+- :func:`chaos_soak` — serving + checkpoint stack under a seeded
+  pseudo-random plan (transient executor-bind failures, batcher
+  delays, commit/manifest/poll IO errors) with live client traffic,
+  a periodic checkpoint writer and a hot-swap watcher.  Asserts the
+  global invariants: every submitted request resolves EXACTLY once
+  (served or a typed error — zero lost, zero duplicated), and every
+  checkpoint any reader ever resolves is COMPLETE (zero integrity
+  failures on committed directories).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+__all__ = ["elastic_kill_drill", "chaos_soak"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# the worker body (also the __main__ of `python -m mxnet_tpu.fault.drill`)
+# ---------------------------------------------------------------------------
+
+def _build_trainer(width, zero=2):
+    """A small deterministic conv+dense trainer on a dp=``width`` mesh.
+
+    Stable gluon prefixes (``net_``) so every (re)build — in whatever
+    process — produces the same param names: the restore contract is
+    name-addressed."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, parallel
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(0)
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(32, in_units=16, activation="relu"),
+                nn.Dense(16, in_units=32, activation="relu"),
+                nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Zero())
+    r = np.random.RandomState(42)
+    for _, p in sorted(net.collect_params().items()):
+        p.set_data(nd.array((r.randn(*p.shape) * 0.2).astype(np.float32)))
+    mesh = parallel.make_mesh(dp=width, devices=jax.devices()[:width])
+    return parallel.ParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh, zero=zero,
+        bucket_bytes=2048)
+
+
+def _drill_data_fn(batch=16):
+    """Pure-function-of-step batches (the replay-exactness contract)."""
+    import numpy as np
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(7)
+    X = rng.randn(256, 16).astype(np.float32)
+    Y = rng.randint(0, 4, 256).astype(np.float32)
+
+    def data_fn(step):
+        i = (step * batch) % 256
+        return nd.array(X[i:i + batch]), nd.array(Y[i:i + batch])
+
+    return data_fn
+
+
+def worker_main(width, steps, ckpt_dir, loss_log):
+    """One elastic training worker: resume-from-latest, run to
+    ``steps``, logging losses per step.  The injected plan (env
+    ``MXNET_FAULT_PLAN``) may SIGKILL it mid-run — that is the drill."""
+    from .elastic import run_elastic
+    losses = run_elastic(lambda restart: _build_trainer(width),
+                         _drill_data_fn(), steps, ckpt_dir,
+                         loss_log=loss_log)
+    print("drill-worker: completed %d steps on width %d" % (steps, width))
+    return losses
+
+
+def _worker_env(width, plan=None):
+    env = dict(os.environ)
+    env.pop("MXNET_FAULT_PLAN", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "float32"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=%d"
+                        % width).strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO] + [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                   if p])
+    if plan is not None:
+        env["MXNET_FAULT_PLAN"] = json.dumps(plan)
+    return env
+
+
+def _run_worker(width, steps, ckpt_dir, loss_log, plan=None, timeout=240):
+    cmd = [sys.executable, "-u", "-m", "mxnet_tpu.fault.drill",
+           "--worker", "--width", str(width), "--steps", str(steps),
+           "--ckpt", ckpt_dir, "--loss-log", loss_log]
+    proc = subprocess.run(cmd, env=_worker_env(width, plan), cwd=_REPO,
+                          capture_output=True, text=True, timeout=timeout)
+    return proc
+
+
+def _read_loss_log(path):
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rec = json.loads(line)
+                out[int(rec["step"])] = float(rec["loss"])
+    return out
+
+
+def elastic_kill_drill(steps=12, kill_at=(4, 8), widths=(4, 2, 8),
+                       tmpdir=None, atol=0.0):
+    """Kill-and-reshard drill (see module docstring).
+
+    ``widths[0]`` runs until the plan SIGKILLs it at global step
+    ``kill_at[0]``; ``widths[1]`` (shrink) resumes and dies at
+    ``kill_at[1]``; ``widths[2]`` (grow) resumes and finishes.  The
+    oracle is ``widths[0]`` uninterrupted.  Returns the report dict;
+    raises AssertionError on any violated invariant.
+
+    ``atol``: same-width resume is bit-identical — PR 7's reshard
+    guarantee — so an all-equal ``widths`` drill runs with the default
+    ``atol=0``.  A width CHANGE changes the collective reduction
+    topology of the *post-restore steps* (4-way vs 2-way gradient
+    sums associate differently), so those curves agree to float32
+    reduction noise (~1 ulp/step), not bitwise; pass an ``atol`` a few
+    ulps wide and read the measured ``max_loss_dev_vs_oracle``."""
+    own = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="graftfault-drill-")
+    report = {"steps": steps, "kill_at": list(kill_at),
+              "widths": list(widths), "legs": []}
+    try:
+        # -- oracle: uninterrupted run at the starting width ----------------
+        oracle_log = os.path.join(tmpdir, "oracle.jsonl")
+        proc = _run_worker(widths[0], steps, os.path.join(tmpdir, "ck-o"),
+                           oracle_log)
+        assert proc.returncode == 0, \
+            "oracle run failed rc=%s:\n%s" % (proc.returncode,
+                                              proc.stderr[-2000:])
+        oracle = _read_loss_log(oracle_log)
+        assert len(oracle) == steps, "oracle logged %d/%d steps" % (
+            len(oracle), steps)
+
+        # -- elastic chain: kill, shrink, kill, grow ------------------------
+        ckpt = os.path.join(tmpdir, "ck-e")
+        runs = [
+            (widths[0], {"rules": [{"site": "elastic.step",
+                                    "kind": "sigkill",
+                                    "step": int(kill_at[0])}]}),
+            (widths[1], {"rules": [{"site": "elastic.step",
+                                    "kind": "sigkill",
+                                    "step": int(kill_at[1])}]}),
+            (widths[2], None),
+        ]
+        logs = []
+        for i, (width, plan) in enumerate(runs):
+            log = os.path.join(tmpdir, "leg%d.jsonl" % i)
+            logs.append(log)
+            proc = _run_worker(width, steps, ckpt, log, plan=plan)
+            leg = {"width": width, "rc": proc.returncode,
+                   "killed": proc.returncode == -signal.SIGKILL,
+                   "steps_logged": sorted(_read_loss_log(log))}
+            report["legs"].append(leg)
+            if plan is not None:
+                assert proc.returncode == -signal.SIGKILL, \
+                    "leg %d expected SIGKILL death, got rc=%s:\n%s" % (
+                        i, proc.returncode, proc.stderr[-2000:])
+            else:
+                assert proc.returncode == 0, \
+                    "final leg failed rc=%s:\n%s" % (proc.returncode,
+                                                     proc.stderr[-2000:])
+
+        # -- invariants ------------------------------------------------------
+        # stitch: later legs win on overlap, but overlapping steps must
+        # AGREE between victim and successor (no skip, no double, no
+        # divergent replay)
+        stitched = {}
+        for log in logs:
+            got = _read_loss_log(log)
+            for s, l in got.items():
+                if s in stitched:
+                    assert abs(stitched[s] - l) <= atol, \
+                        "replayed step %d diverged: %r vs %r" % (
+                            s, stitched[s], l)
+                stitched[s] = l
+        assert sorted(stitched) == list(range(steps)), \
+            "stitched curve has holes: %s" % sorted(stitched)
+        dev = max(abs(stitched[s] - oracle[s]) for s in range(steps))
+        assert dev <= atol, \
+            "loss curve deviates from uninterrupted oracle by %g" % dev
+        report["max_loss_dev_vs_oracle"] = dev
+        report["loss_curve_matches_oracle"] = True
+        report["oracle_losses"] = [oracle[s] for s in range(steps)]
+        return report
+    finally:
+        if own:
+            import shutil
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak — serving + checkpoint stack under a pseudo-random plan
+# ---------------------------------------------------------------------------
+
+SOAK_PLAN = {
+    "seed": 7,
+    "rules": [
+        # transient executor-cache failures: poison the batch, not the
+        # batcher
+        {"site": "serving.cache.get", "kind": "raise", "exc":
+         "RuntimeError", "p": 0.05, "times": 0},
+        # batcher hiccups: latency, not loss
+        {"site": "serving.worker", "kind": "delay", "delay_s": 0.01,
+         "p": 0.1, "times": 0},
+        # checkpoint commits fail transiently; the NEXT save retries
+        {"site": "checkpoint.store.commit", "kind": "io_error",
+         "p": 0.25, "times": 0},
+        # watcher polls and manifest reads hit flaky-filesystem weather
+        {"site": "checkpoint.watcher.poll", "kind": "io_error",
+         "p": 0.15, "times": 0},
+        {"site": "checkpoint.store.manifest_read", "kind": "io_error",
+         "p": 0.1, "times": 0},
+    ],
+}
+
+
+def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
+    """Drive the serving + checkpoint stack under :data:`SOAK_PLAN`
+    (see module docstring for the invariants).  Returns the report
+    dict; raises AssertionError on a violated invariant."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, nd, sym
+    from mxnet_tpu.checkpoint import CheckpointManager, IntegrityError
+    from mxnet_tpu.serving.errors import ServingError
+
+    own = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="graftfault-soak-")
+    ckpt_dir = os.path.join(tmpdir, "ck")
+    rng = np.random.RandomState(0)
+
+    # a small trained module: the checkpoint writer snapshots it, the
+    # watcher hot-swaps the committed versions into the server
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, eval_metric="acc")
+    mgr = CheckpointManager(directory=ckpt_dir, async_save=False,
+                            keep_last=4)
+
+    srv = mx.serving.ModelServer(max_batch=8, batch_wait_ms=1.0,
+                                 queue_depth=32,
+                                 default_timeout_ms=30000.0)
+    mod.export_serving("m", srv)
+    srv.start()
+    srv.warmup("m")
+    watcher = srv.watch_checkpoints(ckpt_dir, "m", poll_interval=0.2)
+
+    stop = threading.Event()
+    counts = {"submitted": 0, "served": 0, "typed_failures": 0,
+              "lost": 0, "duplicated": 0}
+    counts_lock = threading.Lock()
+    commit_attempts = [0, 0]       # attempts, failures
+    integrity_failures = []
+    reader_polls = [0]
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            commit_attempts[0] += 1
+            try:
+                mgr.save_module(mod, epoch=i, block=True)
+            except OSError:
+                commit_attempts[1] += 1   # injected commit fault; next
+                # period retries — the drill point
+            stop.wait(0.15)
+
+    def reader():
+        """Any checkpoint a reader can RESOLVE must be complete:
+        integrity failures on committed directories are the violation
+        this soak exists to catch (transient injected IO errors are
+        weather, not a violation)."""
+        while not stop.is_set():
+            steps_now = mgr.store.steps()
+            if steps_now:
+                reader_polls[0] += 1
+                try:
+                    mgr.store.read(steps_now[-1], verify=True)
+                except IntegrityError as exc:
+                    integrity_failures.append(str(exc))
+                except (OSError, ValueError):
+                    pass   # injected transient weather
+            stop.wait(0.05)
+
+    def client(ci):
+        """Every submission must RESOLVE exactly once: a result, a
+        typed rejection, or the poisoning fault delivered to THIS
+        request's future.  ``lost`` counts futures that never resolve
+        (a hang is the failure mode backpressure bugs produce);
+        ``duplicated`` counts futures observed already-done before this
+        client ever waited — a double delivery."""
+        crng = np.random.RandomState(100 + ci)
+        while not stop.is_set():
+            rows = 1 + int(crng.randint(0, 5))
+            with counts_lock:
+                counts["submitted"] += 1
+            try:
+                fut = srv.infer_async(
+                    "m", crng.randn(rows, 8).astype(np.float32),
+                    retries=2)
+            except ServingError:
+                with counts_lock:
+                    counts["typed_failures"] += 1
+                continue
+            if not fut.wait(25.0):
+                with counts_lock:
+                    counts["lost"] += 1   # never resolved: the hang class
+                continue
+            try:
+                outs = fut.result()
+                assert outs[0].shape[0] == rows
+                with counts_lock:
+                    counts["served"] += 1
+            except Exception:
+                # delivered failure (injected bind fault, deadline):
+                # the future resolved — a TYPED outcome, not a loss
+                with counts_lock:
+                    counts["typed_failures"] += 1
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    threads += [threading.Thread(target=client, args=(ci,), daemon=True)
+                for ci in range(clients)]
+
+    plan = fault.FaultPlan(SOAK_PLAN)
+    try:
+        with fault.active_plan(plan):
+            for t in threads:
+                t.start()
+            time.sleep(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        watcher.stop()
+        srv.stop(drain=False)
+    finally:
+        if not stop.is_set():
+            stop.set()
+
+    # -- invariants ----------------------------------------------------------
+    stats = srv.stats()
+    resolved = counts["served"] + counts["typed_failures"]
+    assert counts["lost"] == 0, \
+        "%d futures never resolved (hung requests)" % counts["lost"]
+    assert resolved == counts["submitted"], \
+        "lost requests: %d submitted, %d resolved" % (counts["submitted"],
+                                                      resolved)
+    # server-side conservation: every ACCEPTED request lands in exactly
+    # one terminal outcome — a double delivery (or a dropped one) would
+    # unbalance this ledger
+    sreq = stats["requests"]
+    assert sreq["submitted"] == \
+        sreq["served"] + sreq["failed"] + sreq["expired"], \
+        "server request ledger unbalanced (duplicate or dropped " \
+        "delivery): %s" % sreq
+    assert not integrity_failures, \
+        "INCOMPLETE checkpoint visible to a reader: %s" % \
+        integrity_failures[:3]
+    injected = plan.stats()
+    assert injected["injected"], "soak injected nothing — plan dead?"
+    served_versions = stats["models"]["m"]["versions"]
+    report = {
+        "duration_s": duration_s,
+        "requests": dict(counts),
+        "server_stats": {k: stats[k] for k in ("requests", "queue")},
+        "checkpoints": {
+            "commit_attempts": commit_attempts[0],
+            "commit_failures_injected": commit_attempts[1],
+            "complete_on_disk": len(mgr.store.steps()),
+            "reader_polls": reader_polls[0],
+            "integrity_failures": len(integrity_failures),
+            "versions_hot_swapped": len(served_versions),
+        },
+        "faults_injected": {
+            "total": len(injected["injected"]),
+            "by_site": {s: sum(1 for i in injected["injected"]
+                               if i["site"] == s)
+                        for s in sorted({i["site"]
+                                         for i in injected["injected"]})},
+        },
+        "zero_lost_requests": True,
+        "zero_duplicated_requests": True,   # the ledger assertion above
+        "zero_incomplete_checkpoint_reads": True,
+    }
+    if own:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI: worker mode (drill subprocesses) + record mode (MULTICHIP json)
+# ---------------------------------------------------------------------------
+
+def _main(argv):
+    import argparse
+    ap = argparse.ArgumentParser(prog="mxnet_tpu.fault.drill")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--width", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--ckpt", default=None)
+    ap.add_argument("--loss-log", default=None)
+    ap.add_argument("--record", default=None,
+                    help="run drill + soak, write the MULTICHIP record")
+    args = ap.parse_args(argv)
+    if args.worker:
+        worker_main(args.width, args.steps, args.ckpt, args.loss_log)
+        return 0
+    # two drill flavors: same-width kill/restart must be EXACT (atol=0,
+    # the reshard guarantee); shrink-then-grow matches to float32
+    # reduction noise of the re-topologized collectives
+    same_width = elastic_kill_drill(widths=(4, 4, 4))
+    reshard = elastic_kill_drill(widths=(4, 2, 8), atol=1e-5)
+    soak = chaos_soak()
+    record = {"elastic_kill_drill_same_width": same_width,
+              "elastic_kill_drill_reshard": reshard,
+              "chaos_soak": soak}
+    out = args.record or "MULTICHIP_r07.json"
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print("wrote", out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main(sys.argv[1:]))

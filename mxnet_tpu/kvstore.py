@@ -23,8 +23,10 @@ import os
 import pickle
 import threading
 import time as _time
+import zipfile
 
 from .base import MXNetError
+from .fault import hooks as _fault
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
@@ -54,6 +56,19 @@ def _instrumented(op):
         @functools.wraps(fn)
         def wrapper(self, key, *args, **kwargs):
             from . import telemetry
+            # graftfault: one "kvstore.push"/"kvstore.pull" site hit per
+            # USER-visible call — the same reentrancy-flag pattern as
+            # telemetry below keeps super() chains from double-firing
+            # (the recursive call re-enters with the flag set and falls
+            # through to the real body)
+            if _fault.ACTIVE[0] and not getattr(_TELEM_TL, "fault_busy",
+                                                False):
+                _TELEM_TL.fault_busy = True
+                try:
+                    _fault.fire("kvstore." + op)
+                    return wrapper(self, key, *args, **kwargs)
+                finally:
+                    _TELEM_TL.fault_busy = False
             if not telemetry.enabled() or getattr(_TELEM_TL, "busy", False):
                 return fn(self, key, *args, **kwargs)
             _TELEM_TL.busy = True
@@ -670,7 +685,8 @@ class KVStoreDistAsync(KVStore):
                 with _np.load(path, allow_pickle=False) as z:
                     k = str(z["key"])
                     grad = z["grad"]
-            except Exception:
+            except (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile):
                 continue  # partially-written file; next scan gets it
             with self._lock:
                 k = self._key_by_name.get(k, k)
@@ -728,14 +744,19 @@ class KVStoreDistAsync(KVStore):
 
     def _load_weight(self, k):
         import numpy as _np
-        import time
+        from .fault.backoff import BackoffPolicy
         path = os.path.join(self._w_dir, "%s.npy" % _san(k))
-        for _ in range(100):
-            try:
-                return _np.load(path)
-            except (OSError, ValueError):
-                time.sleep(0.01)  # mid-replace; retry
-        raise MXNetError("dist_async: cannot read weight %r" % (k,))
+        # mid-replace reads ride the SHARED backoff policy (constant
+        # millisecond-scale delays, jittered so workers don't re-read in
+        # lockstep) instead of the old fixed 100x10ms spin; same ~1s
+        # worst-case budget
+        policy = BackoffPolicy(retries=40, base_s=0.005, max_s=0.025,
+                               seed=self._rank)
+        try:
+            return policy.call(lambda: _np.load(path),
+                               retry_on=(OSError, ValueError))
+        except (OSError, ValueError):
+            raise MXNetError("dist_async: cannot read weight %r" % (k,))
 
     def _spool_lock(self, deadline):
         """flock-based lock serializing scan+publish across workers on

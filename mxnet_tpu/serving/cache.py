@@ -21,6 +21,7 @@ from collections import OrderedDict
 
 from .. import telemetry
 from ..analysis.sanitizers import hooks as _san_hooks
+from ..fault import hooks as _fault
 from ..predictor import Predictor
 
 __all__ = ["ExecutorCache"]
@@ -72,6 +73,12 @@ class ExecutorCache:
         silently.  The cached value holds the entry itself, so the id
         in a live key can never be recycled onto a different
         ModelVersion by the allocator."""
+        # graftfault: a failed lookup/bind poisons only the batch that
+        # needed it (worker_scope delivers to its futures); the batcher
+        # and every cached entry keep serving
+        if _fault.ACTIVE[0]:
+            _fault.fire("serving.cache.get", model=entry.name,
+                        bucket=int(bucket))
         key = (entry.name, entry.version, id(entry), int(bucket))
         with self._lock:
             cached = self._entries.get(key)
@@ -103,8 +110,12 @@ class ExecutorCache:
         if self._on_miss is not None:
             try:
                 self._on_miss(entry, bucket)
-            except Exception:   # noqa: BLE001 — manifest I/O never
-                pass            # poisons a successful bind
+            # deliberate swallow: the manifest is a best-effort restart
+            # optimization — failing a SUCCESSFUL bind over its I/O
+            # would turn a lost warm-start into lost traffic (runtime-
+            # confirmed by the suppression audit's fault-injection leg)
+            except Exception:   # graftlint: disable=swallowed-exception
+                pass
         return pred
 
     def invalidate(self, name, version=None):

@@ -24,14 +24,32 @@ class ModelNotFound(ServingError):
     """No such model name / version in the registry (NOT_FOUND)."""
 
 
-class QueueFull(ServingError):
+class _RetryHinted(ServingError):
+    """Mixin state for rejections that carry a server-side backoff
+    hint: ``retry_after_s`` estimates, from the LIVE queue depth and
+    recent batch service times, when capacity is plausibly available
+    again.  None when the raising side had no server context (e.g. a
+    client-side deadline with the server unreachable).  Clients add
+    jitter (``fault.BackoffPolicy``) — a bare hint replayed verbatim by
+    every rejected client reconverges the herd on one instant."""
+
+    def __init__(self, message, retry_after_s=None):
+        super().__init__(message)
+        self.retry_after_s = (float(retry_after_s)
+                              if retry_after_s is not None else None)
+
+
+class QueueFull(_RetryHinted):
     """Bounded request queue is at capacity — explicit backpressure
-    (RESOURCE_EXHAUSTED); the request was NOT enqueued, retry later."""
+    (RESOURCE_EXHAUSTED); the request was NOT enqueued, retry after
+    ``retry_after_s``."""
 
 
-class DeadlineExceeded(ServingError):
+class DeadlineExceeded(_RetryHinted):
     """The request's deadline passed before a result was produced
-    (DEADLINE_EXCEEDED); it will not be executed if still queued."""
+    (DEADLINE_EXCEEDED); it will not be executed if still queued.
+    ``retry_after_s`` hints when a FRESH submission would clear the
+    current backlog (the original request is gone either way)."""
 
 
 class ServerClosed(ServingError):

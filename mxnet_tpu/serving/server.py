@@ -46,6 +46,7 @@ from .. import engine
 from .. import profiler
 from .. import telemetry
 from ..analysis.sanitizers import hooks as _san_hooks
+from ..fault import hooks as _fault
 from ..io import pad_batch
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
@@ -69,15 +70,19 @@ class InferenceFuture:
     client never consumes accelerator time retroactively."""
 
     __slots__ = ("_ev", "_lock", "_result", "_exc", "_cancelled",
-                 "_deadline")
+                 "_deadline", "_hint")
 
-    def __init__(self, deadline_ms):
+    def __init__(self, deadline_ms, hint=None):
         self._ev = threading.Event()
         self._lock = threading.Lock()
         self._result = None
         self._exc = None
         self._cancelled = False
         self._deadline = deadline_ms
+        # live backoff-hint supplier (the server's _retry_after_s),
+        # consulted at expiry so the hint reflects the queue NOW, not
+        # at submit time
+        self._hint = hint
 
     def done(self):
         return self._ev.is_set()
@@ -111,11 +116,20 @@ class InferenceFuture:
     def result(self):
         remaining = (self._deadline - _now_ms()) / 1000.0
         self._ev.wait(max(0.0, remaining))
+        # hint BEFORE taking _lock: the supplier acquires server locks
+        # (_cv/_mlock), and the batcher delivers into this future's
+        # _lock while holding _cv — hint-under-_lock would be an ABBA
+        # deadlock with _prune_locked.  Racing a late delivery is fine:
+        # the hint is simply unused then.
+        hint = None
+        if not self._ev.is_set() and self._hint is not None:
+            hint = self._hint()
         with self._lock:
             if not self._ev.is_set():
                 self._cancelled = True
                 raise DeadlineExceeded(
-                    "deadline passed before a result was delivered")
+                    "deadline passed before a result was delivered",
+                    retry_after_s=hint)
         if self._exc is not None:
             raise self._exc
         return self._result
@@ -232,7 +246,15 @@ class ModelServer:
             "serving.ModelServer._mlock", threading.Lock())
         self._req_counts = {o: 0           # guarded-by: _mlock
                             for o in ("submitted", "served", "failed",
-                                      "rejected_queue_full", "expired")}
+                                      "rejected_queue_full", "expired",
+                                      "retried")}
+        # client-side submit retry (MXNET_SERVING_SUBMIT_RETRIES, off by
+        # default): jittered sleeps floored at the server's live
+        # retry_after_s hint; base = one batch window, the natural
+        # drain cadence of the queue
+        from ..fault.backoff import BackoffPolicy
+        self._submit_backoff = BackoffPolicy(
+            retries=0, base_s=max(self._batch_wait_ms, 1.0) / 1000.0)
         self._batch_hist = {}              # guarded-by: _mlock
         self._latencies = []               # guarded-by: _mlock
         self._lat_cap = 4096
@@ -314,21 +336,68 @@ class ModelServer:
         self.stop()
 
     # -- request path -------------------------------------------------------
-    def infer(self, name, inputs, version=None, timeout_ms=None):
+    def infer(self, name, inputs, version=None, timeout_ms=None,
+              retries=None):
         """Blocking inference: returns the model's outputs as a list of
-        numpy arrays whose batch axis matches the request's rows."""
+        numpy arrays whose batch axis matches the request's rows.
+        ``retries`` — see :meth:`infer_async`."""
         return self.infer_async(name, inputs, version=version,
-                                timeout_ms=timeout_ms).result()
+                                timeout_ms=timeout_ms,
+                                retries=retries).result()
 
     def infer_async(self, name, inputs, version=None, timeout_ms=None,
-                    _solo=False):
+                    retries=None, _solo=False):
         """Enqueue a request; returns an :class:`InferenceFuture`.
 
         ``inputs`` maps input name -> array; a single-input model also
         accepts the bare array.  Arrays may carry a leading batch axis
         (1..max_batch rows) or be a single sample (the batch axis is
         added).  Raises ``QueueFull``/``BadRequest``/``ModelNotFound``
-        synchronously — a rejected request was never enqueued."""
+        synchronously — a rejected request was never enqueued.
+
+        ``retries`` (default ``MXNET_SERVING_SUBMIT_RETRIES``, 0 = off):
+        re-submit after ``QueueFull`` up to this many times, sleeping
+        the rejection's live ``retry_after_s`` hint with
+        ``BackoffPolicy`` jitter; only the submit is retried — an
+        ACCEPTED request is never duplicated."""
+        if retries is None:
+            retries = config.get("MXNET_SERVING_SUBMIT_RETRIES")
+        budget = max(0, int(retries))
+        attempt = 0
+        while True:
+            try:
+                return self._submit_async(name, inputs, version=version,
+                                          timeout_ms=timeout_ms,
+                                          _solo=_solo)
+            except QueueFull as exc:
+                if attempt >= budget:
+                    raise
+                self._req_inc("retried")
+                self._submit_backoff.sleep_for(
+                    attempt, floor_s=exc.retry_after_s or 0.0)
+                attempt += 1
+
+    def _retry_after_s(self, depth=None):
+        """Server-side backoff hint: seconds until the CURRENT backlog
+        plausibly clears — queued batches ahead times the recent
+        request service time (median submit-to-result, which includes
+        queue wait, so the estimate errs long — an honest hint for a
+        shedding server), floored at one batch window.  An estimate,
+        not a promise: the client adds jitter and bounds its own
+        retries."""
+        if depth is None:
+            with self._cv:
+                depth = len(self._queue)
+        with self._mlock:
+            lats = self._latencies[-32:]
+        per_batch_s = (float(np.median(lats)) / 1000.0 if lats
+                       else self._batch_wait_ms / 1000.0)
+        batches_ahead = 1 + depth // max(1, self._max_batch)
+        floor = self._batch_wait_ms / 1000.0
+        return min(max(batches_ahead * per_batch_s, floor, 0.001), 60.0)
+
+    def _submit_async(self, name, inputs, version=None, timeout_ms=None,
+                      _solo=False):
         entry = self.registry.get(name, version)
         if not isinstance(inputs, dict):
             if len(entry.input_names) != 1:
@@ -368,19 +437,26 @@ class ModelServer:
         timeout = self._default_timeout_ms if timeout_ms is None \
             else float(timeout_ms)
         now = _now_ms()
-        fut = InferenceFuture(now + timeout)
+        fut = InferenceFuture(now + timeout, hint=self._retry_after_s)
         req = _Request(entry, arrs, rows, fut, now, solo=_solo)
+        rejected_depth = None
         with self._cv:
             if self._stopping:
                 raise ServerClosed("server is stopping")
             if len(self._queue) >= self._queue_depth:
-                self._req_inc("rejected_queue_full")
-                raise QueueFull(
-                    "serving queue at capacity (%d requests); retry "
-                    "later" % self._queue_depth)
-            self._queue.append(req)
-            depth = len(self._queue)
-            self._cv.notify_all()
+                rejected_depth = len(self._queue)
+            else:
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._cv.notify_all()
+        if rejected_depth is not None:
+            # hint computed OUTSIDE _cv (it takes _mlock; keep the lock
+            # graph one-directional)
+            self._req_inc("rejected_queue_full")
+            raise QueueFull(
+                "serving queue at capacity (%d requests); retry "
+                "later" % self._queue_depth,
+                retry_after_s=self._retry_after_s(rejected_depth))
         self._req_inc("submitted")
         with self._mlock:
             if depth > self._queue_peak:
@@ -563,6 +639,12 @@ class ModelServer:
                 return got > 0
 
             with engine.worker_scope(deliver):
+                # graftfault: a fault on the batcher thread fails THIS
+                # batch's futures through deliver() and the loop keeps
+                # serving — the poisoned-batch isolation contract
+                if _fault.ACTIVE[0]:
+                    _fault.fire("serving.worker", model=entry.name,
+                                bucket=bucket)
                 self._execute(reqs, entry, bucket)
 
     def _collect_batch(self):
@@ -596,7 +678,8 @@ class ModelServer:
                 continue
             if r.future._expired(now):
                 r.future._set_exception(DeadlineExceeded(
-                    "deadline passed while queued"))
+                    "deadline passed while queued",
+                    retry_after_s=self._retry_after_s(len(self._queue))))
                 self._req_inc("expired")
                 continue
             keep.append(r)
@@ -701,7 +784,8 @@ class ModelServer:
                 "served": req["served"],
                 "failed": req["failed"],
                 "rejected_queue_full": req["rejected_queue_full"],
-                "expired": req["expired"]},
+                "expired": req["expired"],
+                "retried": req["retried"]},
             "batches": {"count": sum(n for n, _r in hist.values()),
                         "rows": sum(r for _n, r in hist.values()),
                         "occupancy": occupancy},

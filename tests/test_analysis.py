@@ -472,6 +472,141 @@ def test_tracer_escape_deep_store_via_global(tmp_path):
     assert "global LAST" in findings[0].message
 
 
+# -- swallowed-exception -----------------------------------------------------
+
+_SWALLOW_SRC = """
+    import logging
+    import threading
+
+    def _worker():
+        while True:
+            try:
+                do_work()
+            except Exception:
+                pass               # the failure dies with the thread
+
+    def _poller():
+        try:
+            poll()
+        except Exception as exc:
+            logging.warning("poll failed: %s", exc)   # log-and-continue
+
+    def start():
+        threading.Thread(target=_worker).start()
+        threading.Thread(target=_poller).start()
+"""
+
+
+def test_swallowed_exception_detected(tmp_path):
+    findings = _lint(tmp_path, "m.py", _SWALLOW_SRC, "swallowed-exception")
+    assert len(findings) == 2
+    by_symbol = {f.symbol: f for f in findings}
+    assert "_worker" in by_symbol and "_poller" in by_symbol
+    assert "thread spawned via start" in by_symbol["_worker"].message
+    assert by_symbol["_worker"].severity == "warning"
+
+
+def test_swallowed_exception_worker_scope_and_transitive(tmp_path):
+    findings = _pkg(tmp_path, {
+        "helper.py": """
+            def fragile():
+                try:
+                    risky()
+                except:
+                    pass
+        """,
+        "driver.py": """
+            import threading
+            from . import engine
+            from .helper import fragile
+
+            def target():
+                fragile()              # swallow 2 hops below the spawn
+
+            def batcher(deliver):
+                with engine.worker_scope(deliver):
+                    try:
+                        execute()
+                    except Exception:
+                        pass           # lexical worker_scope body
+
+            def start():
+                threading.Thread(target=target).start()
+        """,
+        "engine.py": """
+            import contextlib
+
+            @contextlib.contextmanager
+            def worker_scope(deliver=None):
+                yield
+        """,
+    }, rule="swallowed-exception")
+    assert len(findings) == 2
+    paths = sorted(f.path.rsplit("/", 1)[-1] for f in findings)
+    assert paths == ["driver.py", "helper.py"]
+    ws = [f for f in findings if f.path.endswith("driver.py")][0]
+    assert "worker_scope block" in ws.message
+    assert "bare except" in \
+        [f for f in findings if f.path.endswith("helper.py")][0].message
+
+
+def test_swallowed_exception_good_paths(tmp_path):
+    # routed, re-raised, narrow, or main-thread-only swallows are clean
+    assert _lint(tmp_path, "m.py", """
+        import logging
+        import queue
+        import threading
+        from . import engine
+
+        def routed():
+            try:
+                work()
+            except Exception as exc:
+                engine.record_exception(exc)   # deferred to sync point
+
+        def reraised():
+            try:
+                work()
+            except Exception:
+                logging.exception("work failed")
+                raise
+
+        def narrow(q):
+            while True:
+                try:
+                    q.put(1, timeout=0.1)
+                except queue.Full:
+                    continue               # narrow catch: not broad
+
+        def handles(self):
+            try:
+                work()
+            except Exception as exc:
+                self.last_error = exc      # real handling: state change
+
+        def main_thread_only():
+            try:
+                work()
+            except Exception:
+                pass                       # not thread-reachable: unflagged
+
+        def start():
+            threading.Thread(target=routed).start()
+            threading.Thread(target=reraised).start()
+            threading.Thread(target=narrow).start()
+            threading.Thread(target=handles).start()
+            main_thread_only()
+    """, "swallowed-exception") == []
+    suppressed = _SWALLOW_SRC.replace(
+        "except Exception:",
+        "except Exception:  # graftlint: disable=swallowed-exception"
+    ).replace(
+        "except Exception as exc:",
+        "except Exception as exc:  "
+        "# graftlint: disable=swallowed-exception")
+    assert _lint(tmp_path, "s.py", suppressed, "swallowed-exception") == []
+
+
 # -- mesh-contract -----------------------------------------------------------
 
 _MESH_FIXTURE = {

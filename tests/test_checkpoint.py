@@ -749,17 +749,31 @@ def test_watch_retries_after_transient_read_error(tmp_path, monkeypatch):
     watcher = registry.watch_checkpoints(str(tmp_path), "clf", start=False)
     real_read = CheckpointStore.read
     calls = {"n": 0}
+    # 5 consecutive failures: more than the in-poll backoff budget
+    # (fault/backoff.py, retries=2 -> 3 attempts per poll), so the
+    # FIRST poll exhausts its budget and must leave the version
+    # unconsumed for the next poll to serve
+    fail_until = 5
 
     def flaky_read(self, step, verify=True):
         calls["n"] += 1
-        if calls["n"] == 1:
+        if calls["n"] <= fail_until:
             raise OSError("transient NFS hiccup")
         return real_read(self, step, verify=verify)
 
     monkeypatch.setattr(CheckpointStore, "read", flaky_read)
-    assert watcher.poll_once() is None       # transient: not consumed
-    assert watcher.poll_once() == 1          # retried and served
+    assert watcher.poll_once() is None       # budget exhausted: not consumed
+    assert calls["n"] == 3                   # 1 + 2 shared-backoff retries
+    assert watcher.poll_once() == 1          # next poll retries and serves
     assert registry.get("clf").version == 1
+
+    # a SINGLE hiccup now recovers INSIDE one poll (the shared backoff,
+    # fault/backoff.py) instead of waiting a poll interval
+    mgr.save_module(mod, epoch=1)
+    calls["n"] = 0
+    fail_until = 1
+    assert watcher.poll_once() == 2
+    assert registry.get("clf").version == 2
 
 
 def test_watch_skips_unservable_checkpoint(tmp_path):

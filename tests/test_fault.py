@@ -1,0 +1,794 @@
+"""graftfault — deterministic fault injection + elastic training.
+
+Fast legs (default marker set): plan parsing/determinism/addressing,
+the disabled fast path, torn-write/ENOSPC drills over ``atomic_write``
+and the checkpoint store (including the legacy ``nd.save`` /
+``Symbol.save`` paths the injection core makes testable for the first
+time), backoff jitter bounds, the shared-policy consumers (watcher,
+serving hints/retries), and the single-process kill-and-resume smokes:
+an injected mid-epoch fault and a REAL SIGTERM through fit's
+grace-save path, both resuming bit-identically to an uninterrupted
+oracle.
+
+Slow legs: the multi-process SIGKILL + mesh-width-change drill and the
+serving+checkpoint chaos soak (``mxnet_tpu/fault/drill.py`` — the same
+functions that write MULTICHIP_r07.json).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, nd, sym
+from mxnet_tpu.fault import BackoffPolicy, FaultInjected, FaultPlan, hooks
+from mxnet_tpu.fault.elastic import (ElasticError, ElasticSupervisor,
+                                     run_elastic)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """No plan leaks across tests; step address cleared."""
+    yield
+    fault.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# injection core
+# ---------------------------------------------------------------------------
+
+def test_plan_validation_is_loud():
+    with pytest.raises(ValueError, match="unknown key"):
+        FaultPlan({"rules": [{"site": "x", "knid": "raise"}]})
+    with pytest.raises(ValueError, match="kind"):
+        FaultPlan({"rules": [{"site": "x", "kind": "explode"}]})
+    with pytest.raises(ValueError, match="exc"):
+        FaultPlan({"rules": [{"site": "x", "exc": "Nope"}]})
+    with pytest.raises(ValueError, match="site"):
+        FaultPlan({"rules": [{"kind": "raise"}]})
+    with pytest.raises(ValueError, match="unknown key"):
+        FaultPlan({"rules": [], "sedd": 1})
+
+
+def test_site_addressing_after_every_times():
+    plan = FaultPlan({"rules": [{"site": "a", "kind": "raise",
+                                 "after": 2, "every": 3, "times": 2}]})
+    hits = []
+    for n in range(1, 12):
+        try:
+            plan.fire("a")
+            hits.append(0)
+        except FaultInjected:
+            hits.append(1)
+    # fires on hits 3 and 6 (after=2, every=3), capped at times=2
+    assert hits == [0, 0, 1, 0, 0, 1, 0, 0, 0, 0, 0]
+    assert plan.injected_count(site="a") == 2
+
+
+def test_glob_sites_and_step_addressing():
+    plan = FaultPlan({"rules": [
+        {"site": "kvstore.*", "kind": "raise", "times": 1},
+        {"site": "elastic.step", "kind": "raise", "step": 5, "times": 1},
+    ]})
+    with fault.active_plan(plan):
+        with pytest.raises(FaultInjected):
+            hooks.fire("kvstore.push")
+        hooks.fire("kvstore.pull")       # times=1 exhausted
+        hooks.set_step(4)
+        hooks.fire("elastic.step")       # wrong step: no fire
+        hooks.set_step(5)
+        with pytest.raises(FaultInjected):
+            hooks.fire("elastic.step")
+
+
+def test_seeded_probabilistic_schedule_is_reproducible():
+    spec = {"seed": 3, "rules": [{"site": "s", "kind": "raise",
+                                  "p": 0.3, "times": 0}]}
+
+    def sequence():
+        plan = FaultPlan(spec)
+        out = []
+        for _ in range(200):
+            try:
+                plan.fire("s")
+                out.append(0)
+            except FaultInjected:
+                out.append(1)
+        return out
+
+    a, b = sequence(), sequence()
+    assert a == b                       # identical plans replay identically
+    assert 20 < sum(a) < 120            # p=0.3 actually thins the schedule
+    assert FaultPlan({**spec, "seed": 4}) and True
+    c_plan = FaultPlan({**spec, "seed": 4})
+    c = []
+    for _ in range(200):
+        try:
+            c_plan.fire("s")
+            c.append(0)
+        except FaultInjected:
+            c.append(1)
+    assert c != a                       # the seed is the schedule
+
+
+def test_disabled_fast_path_and_install_roundtrip():
+    assert not hooks.ACTIVE[0]
+    hooks.fire("anything")              # default no-op: never raises
+    plan = fault.install(FaultPlan({"rules": []}))
+    assert hooks.ACTIVE[0] and fault.installed() is plan
+    fault.uninstall()
+    assert not hooks.ACTIVE[0] and fault.installed() is None
+    # env-driven arming: inline JSON and @file both parse
+    import mxnet_tpu.config  # noqa: F401  (registered knob)
+    os.environ["MXNET_FAULT_PLAN"] = json.dumps(
+        {"rules": [{"site": "x", "kind": "raise"}]})
+    try:
+        assert fault.FaultPlan.from_env() is not None
+    finally:
+        del os.environ["MXNET_FAULT_PLAN"]
+
+
+def test_delay_and_exit_kinds(tmp_path):
+    plan = FaultPlan({"rules": [{"site": "d", "kind": "delay",
+                                 "delay_s": 0.05, "times": 1}]})
+    t0 = time.perf_counter()
+    plan.fire("d")
+    assert time.perf_counter() - t0 >= 0.04
+    # sigkill/exit kill a real subprocess, not this one
+    import subprocess
+    import sys
+    code = ("import mxnet_tpu as mx\n"
+            "from mxnet_tpu.fault import hooks\n"
+            "hooks.fire('die')\n"
+            "print('SURVIVED')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_FAULT_PLAN=json.dumps(
+        {"rules": [{"site": "die", "kind": "exit", "code": 41}]}))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 41
+    assert "SURVIVED" not in proc.stdout
+
+
+def test_active_plan_restores_outer_plan():
+    """A scoped drill must not disarm the process-wide plan around it
+    (the audit's fault leg runs inside whatever the operator armed)."""
+    outer = fault.install(FaultPlan({"rules": [
+        {"site": "o", "kind": "raise", "times": 0}]}))
+    try:
+        with fault.active_plan({"rules": []}):
+            hooks.fire("o")                 # inner plan: no rule, no fire
+        assert fault.installed() is outer   # outer re-armed on exit
+        with pytest.raises(FaultInjected):
+            hooks.fire("o")
+    finally:
+        fault.uninstall()
+
+
+def test_future_expiry_hint_cannot_deadlock_delivery():
+    """result() must compute the retry hint OUTSIDE the future lock: the
+    hint supplier takes server locks the delivering batcher holds while
+    it takes the future lock (the ABBA pair a review caught)."""
+    from mxnet_tpu.serving.server import InferenceFuture, _now_ms
+    server_lock = threading.Lock()
+    in_hint = threading.Event()
+    release_hint = threading.Event()
+
+    def hint():
+        in_hint.set()
+        release_hint.wait(5.0)     # deliverer runs while we're in-hint
+        with server_lock:          # old code: deadlock right here
+            return 0.5
+
+    fut = InferenceFuture(_now_ms() - 1.0, hint=hint)   # already expired
+    delivered = []
+
+    def deliver():
+        in_hint.wait(5.0)
+        with server_lock:          # the batcher's lock, held at delivery
+            delivered.append(fut._set_exception(RuntimeError("boom")))
+        release_hint.set()
+
+    t = threading.Thread(target=deliver, daemon=True)
+    t.start()
+    out = {}
+
+    def client():
+        try:
+            fut.result()
+        except Exception as exc:   # delivered error or DeadlineExceeded
+            out["exc"] = exc
+
+    c = threading.Thread(target=client, daemon=True)
+    c.start()
+    c.join(5.0)
+    assert not c.is_alive(), "result() deadlocked against delivery"
+    t.join(5.0)
+    assert delivered == [True] and "exc" in out
+
+
+def test_injection_telemetry_counter():
+    from mxnet_tpu import telemetry
+    plan = FaultPlan({"rules": [{"site": "t", "kind": "raise",
+                                 "times": 1}]})
+    with pytest.raises(FaultInjected):
+        plan.fire("t")
+    snap = telemetry.snapshot()
+    values = snap["mxnet_fault_injected_total"]["values"]
+    assert any(v["labels"].get("site") == "t"
+               and v["labels"].get("kind") == "raise" and v["value"] >= 1
+               for v in values)
+
+
+# ---------------------------------------------------------------------------
+# atomic_write under torn-write / ENOSPC (legacy persistence paths)
+# ---------------------------------------------------------------------------
+
+def _no_temps(dirpath):
+    return [n for n in os.listdir(dirpath) if ".tmp-" in n]
+
+
+@pytest.mark.parametrize("kind", ["torn_write", "enospc"])
+def test_nd_save_injected_fault_never_exposes_partial(tmp_path, kind):
+    path = str(tmp_path / "w.params")
+    nd.save(path, {"a": nd.ones((4,)), "b": nd.zeros((2, 2))})
+    before = open(path, "rb").read()
+    with fault.active_plan({"rules": [{"site": "atomic_io.commit",
+                                       "kind": kind, "times": 1}]}):
+        with pytest.raises(OSError):
+            nd.save(path, {"a": nd.zeros((16,))})
+    # the old complete file survives byte-for-byte; no temp residue
+    assert open(path, "rb").read() == before
+    assert _no_temps(str(tmp_path)) == []
+    loaded = nd.load(path)
+    assert sorted(loaded) == ["a", "b"]
+    np.testing.assert_array_equal(loaded["a"].asnumpy(), np.ones((4,)))
+
+
+def test_symbol_save_injected_torn_write(tmp_path):
+    net = sym.FullyConnected(sym.Variable("data"), num_hidden=4, name="fc")
+    path = str(tmp_path / "net.json")
+    net.save(path)
+    before = open(path).read()
+    with fault.active_plan({"rules": [{"site": "atomic_io.commit",
+                                       "kind": "torn_write", "times": 1}]}):
+        with pytest.raises(OSError):
+            sym.FullyConnected(sym.Variable("data"), num_hidden=8,
+                               name="fc2").save(path)
+    assert open(path).read() == before
+    assert _no_temps(str(tmp_path)) == []
+    assert mx.sym.load(path).list_arguments() == \
+        net.list_arguments()
+
+
+def test_fresh_target_torn_write_leaves_nothing(tmp_path):
+    path = str(tmp_path / "fresh.params")
+    with fault.active_plan({"rules": [{"site": "atomic_io.commit",
+                                       "kind": "torn_write", "times": 1}]}):
+        with pytest.raises(OSError):
+            nd.save(path, {"x": nd.ones((8,))})
+    assert not os.path.exists(path)
+    assert _no_temps(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# checkpoint store under injected faults
+# ---------------------------------------------------------------------------
+
+def test_store_commit_fault_invisible_then_recoverable(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointStore
+    store = CheckpointStore(str(tmp_path))
+    arrays = {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    with fault.active_plan({"rules": [{"site": "checkpoint.store.commit",
+                                       "kind": "io_error", "times": 1}]}):
+        with pytest.raises(OSError):
+            store.write(1, arrays)
+    assert store.steps() == []          # nothing half-committed
+    assert len(store.gc_orphans()) == 1
+    store.write(1, arrays)              # the retry commits cleanly
+    assert store.steps() == [1]
+    _m, got, _b = store.read(1, verify=True)
+    np.testing.assert_array_equal(got["w"], arrays["w"])
+
+
+def test_store_shard_torn_write_stays_in_tmp(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointStore
+    store = CheckpointStore(str(tmp_path))
+    with fault.active_plan({"rules": [
+            {"site": "checkpoint.store.shard_write", "kind": "torn_write",
+             "times": 1}]}):
+        with pytest.raises(OSError):
+            store.write(3, {"w": np.ones((64,), np.float32)})
+    assert store.latest() is None
+    orphans = store.gc_orphans()
+    assert len(orphans) == 1 and ".tmp-" in orphans[0]
+
+
+def test_async_worker_fault_contained(tmp_path):
+    """A fault on the async writer thread lands in last_error() +
+    failure counter, never at a global sync point."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.checkpoint import CheckpointStore
+    from mxnet_tpu.checkpoint.async_ckpt import AsyncCheckpointer
+    store = CheckpointStore(str(tmp_path))
+    ck = AsyncCheckpointer(store)
+    with fault.active_plan({"rules": [{"site": "checkpoint.async.worker",
+                                       "kind": "io_error", "times": 1}]}):
+        assert ck.save(1, {"w": np.ones((4,), np.float32)})
+        assert ck.wait(10.0)
+    assert isinstance(ck.last_error(), OSError)
+    assert store.steps() == []
+    engine.check_raise()                # nothing poisoned the engine
+    assert ck.save(2, {"w": np.ones((4,), np.float32)}, block=True)
+    assert store.steps() == [2]
+
+
+def test_manager_restore_walks_past_manifest_fault(tmp_path):
+    """Transient manifest-read faults push restore to an older complete
+    checkpoint instead of crashing (and the next call sees the new
+    one)."""
+    from mxnet_tpu.checkpoint import CheckpointStore
+    store = CheckpointStore(str(tmp_path))
+    store.write(1, {"w": np.full((2,), 1.0, np.float32)})
+    store.write(2, {"w": np.full((2,), 2.0, np.float32)})
+    with fault.active_plan({"rules": [
+            {"site": "checkpoint.store.manifest_read", "kind": "io_error",
+             "times": 1}]}):
+        # steps() parses manifests itself; the injected fault hits the
+        # newest step's read, so the walk lands on step 1
+        from mxnet_tpu.checkpoint.state import ParallelTrainerState  # noqa
+        from mxnet_tpu.checkpoint.store import CheckpointError
+        got = None
+        for s in reversed(store.steps()):
+            try:
+                _m, arrays, _b = store.read(s, verify=True)
+            except (OSError, ValueError, CheckpointError):
+                continue
+            got = arrays
+            break
+        assert got is not None and got["w"][0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# BackoffPolicy — jitter bounds, cap, call semantics
+# ---------------------------------------------------------------------------
+
+def test_backoff_delay_bounds_and_cap():
+    p = BackoffPolicy(retries=5, base_s=0.1, max_s=0.4, multiplier=2.0,
+                      jitter=0.25, seed=1, sleep=lambda s: None)
+    for attempt, raw in [(0, 0.1), (1, 0.2), (2, 0.4), (3, 0.4),
+                         (9, 0.4)]:
+        for _ in range(50):
+            d = p.delay(attempt)
+            assert raw * 0.75 - 1e-9 <= d <= raw * 1.25 + 1e-9, \
+                (attempt, d)
+
+
+def test_backoff_zero_jitter_is_exact_exponential():
+    p = BackoffPolicy(retries=3, base_s=0.5, max_s=30.0, jitter=0.0,
+                      sleep=lambda s: None)
+    assert [p.delay(a) for a in range(4)] == [0.5, 1.0, 2.0, 4.0]
+
+
+def test_backoff_call_budget_and_abort_on():
+    slept = []
+    p = BackoffPolicy(retries=2, base_s=0.01, max_s=0.02, jitter=0.0,
+                      sleep=slept.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        p.call(flaky, retry_on=(OSError,))
+    assert len(calls) == 3 and len(slept) == 2   # retries, then re-raise
+
+    class Permanent(OSError):
+        pass
+
+    calls.clear()
+
+    def broken():
+        calls.append(1)
+        raise Permanent("bit rot")
+
+    with pytest.raises(Permanent):
+        p.call(broken, retry_on=(OSError,), abort_on=(Permanent,))
+    assert len(calls) == 1                       # no budget burned
+
+    def unexpected():
+        raise KeyError("bug")
+
+    with pytest.raises(KeyError):
+        p.call(unexpected, retry_on=(OSError,))
+
+
+def test_backoff_floor_honors_server_hint():
+    slept = []
+    p = BackoffPolicy(retries=1, base_s=0.01, max_s=0.02, jitter=0.0,
+                      sleep=slept.append)
+    p.sleep_for(0, floor_s=0.5)
+    assert slept == [0.5]
+
+
+def test_knob_defaults_flow_into_policy(monkeypatch):
+    monkeypatch.setenv("MXNET_FAULT_RETRIES", "7")
+    monkeypatch.setenv("MXNET_FAULT_BACKOFF_BASE_S", "0.125")
+    monkeypatch.setenv("MXNET_FAULT_BACKOFF_JITTER", "0")
+    p = BackoffPolicy(sleep=lambda s: None)
+    assert p.retries == 7 and p.delay(0) == 0.125
+
+
+# ---------------------------------------------------------------------------
+# shared-policy consumers: watcher transient reads, serving hints/retries
+# ---------------------------------------------------------------------------
+
+def _tiny_servable_checkpoint(tmp_path):
+    """One committed, servable checkpoint (symbol + shapes + params)."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd", eval_metric="acc")
+    mgr = CheckpointManager(directory=str(tmp_path / "ck"),
+                            async_save=False)
+    mgr.save_module(mod, epoch=1, block=True)
+    return mgr
+
+
+def test_watcher_transient_read_retries_within_one_poll(tmp_path):
+    """The shared backoff clears a transient read INSIDE one poll — the
+    version serves now, not a poll interval later (the old ad-hoc
+    behavior)."""
+    from mxnet_tpu.serving import ModelRegistry
+    mgr = _tiny_servable_checkpoint(tmp_path)
+    reg = ModelRegistry()
+    watcher = reg.watch_checkpoints(str(tmp_path / "ck"), "m",
+                                    poll_interval=60.0, start=False)
+    with fault.active_plan({"rules": [
+            {"site": "checkpoint.store.manifest_read", "kind": "io_error",
+             "times": 2}]}) as plan:
+        served = watcher.poll_once()
+    assert served == mgr.latest_step()
+    assert reg.get("m").version == served
+    assert plan.injected_count() == 2    # the faults really fired
+
+
+def test_watcher_integrity_error_not_retried(tmp_path):
+    """abort_on: bit rot is permanent — one attempt, version skipped."""
+    mgr = _tiny_servable_checkpoint(tmp_path)
+    step = mgr.latest_step()
+    ckdir = str(tmp_path / "ck")
+    # corrupt one shard on disk
+    import glob
+    shard = sorted(glob.glob(os.path.join(
+        ckdir, "ckpt-%08d" % step, "*.bin")))[0]
+    with open(shard, "r+b") as f:
+        f.write(b"\xff" * 8)
+    from mxnet_tpu.serving import ModelRegistry
+    reg = ModelRegistry()
+    watcher = reg.watch_checkpoints(ckdir, "m", poll_interval=60.0,
+                                    start=False)
+    t0 = time.perf_counter()
+    assert watcher.poll_once() is None
+    assert time.perf_counter() - t0 < 2.0   # no backoff sleeps burned
+    assert watcher.last_step == step        # permanent: never retried
+
+
+def test_queue_full_carries_live_retry_hint(tmp_path):
+    from mxnet_tpu.serving.errors import QueueFull
+    mgr = _tiny_servable_checkpoint(tmp_path)
+    del mgr
+    srv = mx.serving.ModelServer(max_batch=4, queue_depth=2,
+                                 batch_wait_ms=5.0)
+    rng = np.random.RandomState(0)
+    Xw = rng.randn(32, 8).astype(np.float32)
+    yw = (Xw[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(Xw, yw, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd", eval_metric="acc")
+    mod.export_serving("m", srv)
+    # batcher NOT started: submissions pile into the bounded queue
+    x = rng.randn(1, 8).astype(np.float32)
+    srv.infer_async("m", x)
+    srv.infer_async("m", x)
+    with pytest.raises(QueueFull) as exc_info:
+        srv.infer_async("m", x)
+    hint = exc_info.value.retry_after_s
+    assert hint is not None and 0.0 < hint <= 60.0
+    srv.stop(drain=False)
+
+
+def test_submit_retry_resubmits_after_queue_full(tmp_path):
+    mgr = _tiny_servable_checkpoint(tmp_path)
+    del mgr
+    srv = mx.serving.ModelServer(max_batch=4, queue_depth=1,
+                                 batch_wait_ms=1.0)
+    rng = np.random.RandomState(0)
+    Xw = rng.randn(32, 8).astype(np.float32)
+    yw = (Xw[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(Xw, yw, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc1")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd", eval_metric="acc")
+    mod.export_serving("m", srv)
+    x = rng.randn(1, 8).astype(np.float32)
+    blocker = srv.infer_async("m", x)     # fills the depth-1 queue
+    drained = threading.Event()
+
+    def drain_later():
+        time.sleep(0.15)
+        srv.start()                        # batcher comes up, queue drains
+        drained.set()
+
+    t = threading.Thread(target=drain_later, daemon=True)
+    t.start()
+    out = srv.infer("m", x, retries=8)     # opt-in bounded retry wins
+    assert out[0].shape == (1, 4)
+    assert blocker.result()[0].shape == (1, 4)
+    assert srv.stats()["requests"]["retried"] >= 1
+    t.join()
+    srv.stop(drain=False)
+
+
+def test_kvstore_push_pull_sites_fire():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((2, 2)))
+    with fault.active_plan({"rules": [
+            {"site": "kvstore.push", "kind": "raise", "times": 1}]}) as plan:
+        with pytest.raises(FaultInjected):
+            kv.push("w", nd.ones((2, 2)))
+        out = nd.zeros((2, 2))
+        kv.pull("w", out=out)              # pull unaffected
+        assert plan.stats()["hits"].get("kvstore.pull") == 1
+    assert plan.injected_count(site="kvstore.push") == 1
+
+
+def test_io_prefetch_fault_surfaces_at_sync_point():
+    from mxnet_tpu import engine
+    from mxnet_tpu.base import MXNetError
+    engine.clear_exception()
+    X = np.random.randn(64, 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, np.zeros(64, np.float32), batch_size=16)
+    with fault.active_plan({"rules": [
+            {"site": "io.prefetch", "kind": "raise", "exc": "MXNetError",
+             "after": 1, "times": 1}]}):
+        pf = mx.io.PrefetchingIter(it)
+        batches = 0
+        with pytest.raises(MXNetError):
+            for _ in range(16):
+                next(pf)
+                batches += 1
+        assert batches >= 1          # first batch fine, fault deferred
+    engine.clear_exception()
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor + single-process drills (the tier-1 smoke)
+# ---------------------------------------------------------------------------
+
+def _fast_backoff():
+    return BackoffPolicy(retries=8, base_s=0.001, max_s=0.002, jitter=0.0,
+                         sleep=lambda s: None)
+
+
+def test_supervisor_budget_exhaustion_is_loud():
+    sup = ElasticSupervisor(retries=2, backoff=_fast_backoff())
+    calls = []
+
+    def always_dies(restart):
+        calls.append(restart)
+        raise OSError("infra")
+
+    with pytest.raises(ElasticError) as exc_info:
+        sup.run(always_dies)
+    assert len(calls) == 3                      # 1 + 2 retries
+    assert isinstance(exc_info.value.__cause__, OSError)
+
+
+def test_supervisor_classification():
+    sup = ElasticSupervisor(retries=3, backoff=_fast_backoff())
+
+    def bug(restart):
+        raise TypeError("programming error")
+
+    with pytest.raises(TypeError):
+        sup.run(bug)                            # not recoverable: no retry
+
+    seen = []
+
+    def preempted_once(restart):
+        seen.append(restart)
+        if not restart:
+            raise SystemExit(143)               # the preemption exit
+        return "done"
+
+    assert sup.run(preempted_once) == "done"
+    assert seen == [0, 1]
+
+    def real_exit(restart):
+        raise SystemExit(2)                     # an operator exit: not ours
+
+    with pytest.raises(SystemExit):
+        sup.run(real_exit)
+
+
+def _fit_oracle_and_elastic(tmp_path, plan_spec, monkeypatch):
+    """Run the same 3-epoch job uninterrupted and under ``plan_spec``
+    with elastic=True; return (oracle params, elastic params)."""
+    monkeypatch.setenv("MXNET_FAULT_BACKOFF_BASE_S", "0.01")
+    monkeypatch.setenv("MXNET_FAULT_BACKOFF_MAX_S", "0.02")
+
+    def build():
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+        net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+        return sym.SoftmaxOutput(net, name="softmax")
+
+    def run(plan=None, ckpt=None):
+        np.random.seed(0)
+        mx.random.seed(0)
+        X = np.random.randn(64, 8).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+        mod = mx.mod.Module(build(), context=mx.cpu())
+        mgr = None
+        if ckpt:
+            from mxnet_tpu.checkpoint import CheckpointManager
+            mgr = CheckpointManager(directory=ckpt, async_save=False,
+                                    period_steps=1, keep_last=50)
+        kwargs = dict(num_epoch=3, optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.05},
+                      eval_metric="acc", checkpoint_manager=mgr)
+        if plan is not None:
+            with fault.active_plan(plan):
+                mod.fit(train, elastic=True, **kwargs)
+        else:
+            mod.fit(train, **kwargs)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    oracle = run()
+    got = run(plan=plan_spec, ckpt=str(tmp_path / "ck"))
+    return oracle, got
+
+
+def test_fit_elastic_mid_epoch_fault_resumes_bit_identical(
+        tmp_path, monkeypatch):
+    plan = {"rules": [{"site": "fit.step", "kind": "raise",
+                       "exc": "RuntimeError", "step": 6, "times": 1}]}
+    oracle, got = _fit_oracle_and_elastic(tmp_path, plan, monkeypatch)
+    for k in oracle:
+        np.testing.assert_array_equal(oracle[k], got[k], err_msg=k)
+
+
+def test_fit_elastic_sigterm_kill_and_resume_bit_identical(
+        tmp_path, monkeypatch):
+    """The CI fault-drill smoke: a REAL SIGTERM mid-epoch takes fit's
+    grace-save + exit-143 path; the supervisor classifies it as
+    preemption, restores, re-enters, and the final params match the
+    uninterrupted oracle bit-for-bit."""
+    plan = {"rules": [{"site": "fit.step", "kind": "sigterm",
+                       "step": 6, "times": 1}]}
+    oracle, got = _fit_oracle_and_elastic(tmp_path, plan, monkeypatch)
+    for k in oracle:
+        np.testing.assert_array_equal(oracle[k], got[k], err_msg=k)
+
+
+def test_fit_elastic_requires_checkpointing(tmp_path):
+    mod = mx.mod.Module(sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2), name="softmax"),
+        context=mx.cpu())
+    X = np.random.randn(16, 8).astype(np.float32)
+    train = mx.io.NDArrayIter(X, np.zeros(16, np.float32), batch_size=8)
+    with pytest.raises(ValueError, match="checkpoint"):
+        mod.fit(train, num_epoch=1, elastic=True)
+
+
+def test_run_elastic_width_change_loss_curve_exact(tmp_path):
+    """Single-process form of the MULTICHIP drill: kill at step 4,
+    resume on a WIDER mesh; the loss curve equals the uninterrupted
+    oracle exactly (reshard-on-restore is bit-identical, CPU matmuls
+    run under float32 precision in tier-1)."""
+    import jax
+    from mxnet_tpu.fault.drill import _build_trainer, _drill_data_fn
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device virtual platform")
+    data_fn = _drill_data_fn()
+    oracle = run_elastic(lambda r: _build_trainer(2), data_fn, 6,
+                         str(tmp_path / "ck-o"),
+                         supervisor=ElasticSupervisor(
+                             retries=0, backoff=_fast_backoff()))
+    plan = {"rules": [{"site": "elastic.step", "kind": "raise",
+                      "exc": "RuntimeError", "step": 3, "times": 1}]}
+    widths = [2, 4]
+    restores = []
+    with fault.active_plan(plan):
+        got = run_elastic(
+            lambda r: _build_trainer(widths[min(r, 1)]), data_fn, 6,
+            str(tmp_path / "ck-e"),
+            supervisor=ElasticSupervisor(retries=2,
+                                         backoff=_fast_backoff()),
+            on_restore=lambda s, v: restores.append((s, v)))
+    assert restores and restores[-1][0] == 3
+    assert any("reshard" in n for n in restores[-1][1]["notes"])
+    # pre-kill prefix ran on the oracle's width: bitwise equal; the
+    # post-restore tail ran on a WIDER mesh whose collectives associate
+    # differently — float32 reduction noise, nothing more
+    assert got[:3] == oracle[:3]
+    np.testing.assert_allclose(got, oracle, rtol=0, atol=1e-5)
+
+
+def test_run_elastic_incompatible_topology_is_loud(tmp_path):
+    """A checkpoint that cannot land on the new trainer (different
+    param set) refuses loudly via check_restore_compat — never a
+    silent re-init."""
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.fault.drill import _build_trainer, _drill_data_fn
+    data_fn = _drill_data_fn()
+    run_elastic(lambda r: _build_trainer(1), data_fn, 2,
+                str(tmp_path / "ck"),
+                supervisor=ElasticSupervisor(retries=0,
+                                             backoff=_fast_backoff()))
+
+    def other_factory(restart):
+        mx.random.seed(0)
+        net = nn.HybridSequential(prefix="other_")
+        with net.name_scope():
+            net.add(nn.Dense(4, in_units=16))
+        net.initialize(mx.init.Zero())
+        mesh = parallel.make_mesh(dp=1, devices=jax.devices()[:1])
+        return parallel.ParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.1}, mesh=mesh)
+
+    with pytest.raises(ElasticError, match="topology"):
+        run_elastic(other_factory, data_fn, 4, str(tmp_path / "ck"),
+                    supervisor=ElasticSupervisor(retries=1,
+                                                 backoff=_fast_backoff()))
+
+
+# ---------------------------------------------------------------------------
+# slow drills — the MULTICHIP legs (mxnet_tpu/fault/drill.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_elastic_multiproc_kill_drill(tmp_path):
+    """SIGKILL mid-run + mesh shrink + SIGKILL + grow: stitched loss
+    curve equals the uninterrupted oracle (see drill.py; same-width
+    exactness is covered by the record run and the fast in-process
+    drill above — this leg exercises the real-SIGKILL reshard path)."""
+    from mxnet_tpu.fault.drill import elastic_kill_drill
+    report = elastic_kill_drill(steps=10, kill_at=(3, 6), widths=(4, 2, 8),
+                                tmpdir=str(tmp_path), atol=1e-5)
+    assert report["loss_curve_matches_oracle"]
+    assert report["legs"][0]["killed"] and report["legs"][1]["killed"]
+    assert not report["legs"][2]["killed"]
+    assert report["max_loss_dev_vs_oracle"] <= 1e-5
+
+
+@pytest.mark.slow
+def test_chaos_soak_zero_lost_zero_incomplete():
+    from mxnet_tpu.fault.drill import chaos_soak
+    report = chaos_soak(duration_s=6.0, clients=4)
+    assert report["zero_lost_requests"]
+    assert report["zero_duplicated_requests"]
+    assert report["zero_incomplete_checkpoint_reads"]
+    assert report["faults_injected"]["total"] > 0
+    assert report["checkpoints"]["versions_hot_swapped"] >= 1
