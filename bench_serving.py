@@ -17,6 +17,15 @@ populated, manifest-replayed) — and records ``warmup_cold_s`` /
 ``warmup_warm_s`` as first-class fields (acceptance: warm <= 0.5x
 cold on the 5-bucket ladder).
 
+Since ISSUE 15 there is also a **multi-tenant leg** (``--multitenant``
+runs it standalone and merges into BENCH_SERVING.json): two tenant
+models under skewed load with per-model quotas and one injected-POISON
+canary (``fault.drill.multitenant_soak`` — the NaN fault kind at
+``serving.canary.execute`` scoped to the victim), recording per-tenant
+throughput/p99, the canary rollback latency, and the isolation
+evidence (zero cross-tenant evictions, per-tenant exactly-once
+ledgers, quotas respected).
+
 Methodology mirrors bench.py: warmup excluded from measurement (every
 bucket compiled by ``warmup()`` before the clock starts), ONE JSON
 line on stdout win or lose, details written incrementally to
@@ -222,6 +231,41 @@ def _measure_warm_restart():
     return legs
 
 
+def _measure_multitenant():
+    """The ISSUE-15 leg: the multi-tenant soak drill IS the
+    measurement — small models (throughput numbers are about the
+    batcher/quota/canary machinery, not conv flops), skewed load (3
+    victim clients vs 1 bystander), tenant-scoped faults and one
+    NaN-poisoned canary."""
+    from mxnet_tpu.fault.drill import multitenant_soak
+    return multitenant_soak(duration_s=8.0)
+
+
+def _multitenant_only():
+    """--multitenant: run just the multi-tenant leg and merge it into
+    an existing BENCH_SERVING.json (or a fresh skeleton)."""
+    try:
+        with open(OUT_PATH) as f:
+            result = json.load(f)
+    except (OSError, ValueError):
+        result = {}
+    leg = _measure_multitenant()
+    result["multitenant"] = leg
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "metric": "serving_multitenant_rollback_s",
+        "value": leg["canary"]["rollback_wall_s"],
+        "unit": "s",
+        "victim_req_per_sec": leg["per_tenant"]["tenantA"]["req_per_sec"],
+        "bystander_req_per_sec":
+            leg["per_tenant"]["tenantB"]["req_per_sec"],
+        "bystander_p99_ms": leg["per_tenant"]["tenantB"]["p99_ms"],
+        "faults_injected": leg["faults_injected"]["total"],
+    }))
+    sys.stdout.flush()
+
+
 def main():
     result = {"model": "resnet%d_cifar" % NUM_LAYERS,
               "image_shape": list(IMAGE_SHAPE),
@@ -287,6 +331,14 @@ def main():
     except Exception as exc:   # noqa: BLE001
         _fail("warm-restart leg failed: %r" % (exc,), 6)
 
+    # multi-tenant leg: the ISSUE-15 drill evidence — quotas, a
+    # poisoned canary's auto-rollback latency, per-tenant isolation
+    try:
+        result["multitenant"] = _measure_multitenant()
+        checkpoint()
+    except Exception as exc:   # noqa: BLE001
+        _fail("multi-tenant leg failed: %r" % (exc,), 7)
+
     seq = result["sequential"]["req_per_sec"]
     c64 = [leg for leg in result["serving"]
            if leg.get("concurrency") == 64]
@@ -304,6 +356,8 @@ def main():
         "vs_sequential": result["vs_sequential_c64"],
         "warmup_cold_s": result["warmup_cold_s"],
         "warmup_warm_s": result["warmup_warm_s"],
+        "multitenant_rollback_s":
+            result["multitenant"]["canary"]["rollback_wall_s"],
     }))
     sys.stdout.flush()
 
@@ -311,5 +365,7 @@ def main():
 if __name__ == "__main__":
     if "--warmup-probe" in sys.argv[1:]:
         _warmup_probe()
+    elif "--multitenant" in sys.argv[1:]:
+        _multitenant_only()
     else:
         main()

@@ -410,6 +410,11 @@ def builtin_workload():
         # -- fault-injection leg (graftfault): drive the DEGRADATION
         # -- paths whose suppressions only execute under faults --------
         _fault_leg(mod, tmp)
+
+        # -- multi-tenant serving leg: quotas, shedding, canary
+        # -- rollback — the ISSUE 15 paths run under the probe so any
+        # -- suppression they carry is runtime-classified ---------------
+        _multitenant_leg(mod)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -493,6 +498,70 @@ def _fault_leg(mod, tmp):
         run_elastic(factory, data_fn, 3,
                     os.path.join(tmp, "elastic-ck"),
                     supervisor=ElasticSupervisor(retries=2, backoff=fast))
+
+
+def _multitenant_leg(mod):
+    """Drive the multi-tenant hardening paths (ISSUE 15): per-model
+    quota rejection, brownout + priority shedding, doomed shedding,
+    and a canary whose NaN poisoning AND promote-step fault are both
+    injected — covering the executor-cache quota eviction sweep, the
+    shed accounting, and the canary contain-and-retry handler."""
+    import numpy as _np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault
+    from mxnet_tpu.serving.errors import QueueFull
+
+    rng = _np.random.RandomState(9)
+    args, _aux = mod.get_params()
+    net = mod.symbol
+    srv = mx.serving.ModelServer(max_batch=4, batch_wait_ms=1.0,
+                                 queue_depth=8, canary_fraction=0.5,
+                                 default_timeout_ms=30000.0)
+    srv.add_model("mtA", net, dict(args), {}, {"data": (1, 8)})
+    srv.add_model("mtB", net, dict(args), {}, {"data": (1, 8)})
+    srv.set_quota("mtA", queue_depth=2, cache_entries=6)
+    # quota rejection + brownout shed while the batcher is down
+    parked = []
+    try:
+        for _ in range(4):
+            parked.append(srv.infer_async(
+                "mtA", rng.randn(1, 8).astype(_np.float32)))
+    except QueueFull:
+        pass
+    try:
+        for _ in range(8):
+            parked.append(srv.infer_async(
+                "mtB", rng.randn(1, 8).astype(_np.float32), priority=2))
+    except QueueFull:
+        pass
+    srv.start()
+    # drain the parked traffic BEFORE warmup: its lazy binds are
+    # legitimate cold compiles, and they must land before warmup
+    # completes and opens the serving steady-state region (racing them
+    # into the region would be a real san-recompile finding)
+    for f in parked:
+        f.wait(30.0)
+    srv.warmup()
+    # canary: NaN-poisoned outputs plus an injected promote fault — the
+    # rollback path retries past the fault, the registry default never
+    # moves off the baseline
+    v2 = srv.add_model("mtA", net, dict(args), {}, {"data": (1, 8)})
+    srv.warmup_version("mtA", v2)
+    srv.begin_canary("mtA", v2, fraction=1.0, min_requests=4)
+    with fault.active_plan({"rules": [
+            {"site": "serving.canary.execute", "kind": "nan",
+             "times": 0, "where": {"model": "mtA"}},
+            {"site": "serving.canary.promote", "kind": "io_error",
+             "times": 1}]}):
+        for _ in range(12):
+            if srv.canary_status("mtA")["live"] is None:
+                break
+            srv.infer("mtA", rng.randn(1, 8).astype(_np.float32))
+    assert srv.canary_status("mtA")["history"], \
+        "audit multi-tenant leg: canary never decided"
+    srv.stop(drain=False)
+    srv.cache.clear()
 
 
 def run_audit(workload=None, root=None):

@@ -334,6 +334,58 @@ register_env("MXNET_SERVING_SUBMIT_RETRIES", int, 0,
              "up to this many times, sleeping the error's retry_after_s "
              "hint with BackoffPolicy jitter; 0 (default) surfaces "
              "QueueFull to the caller unchanged")
+register_env("MXNET_SERVING_MODEL_QUEUE_DEPTH", int, 0,
+             "default per-model queue quota: at most this many requests "
+             "of one model queued at once, rejected with that model's "
+             "own QueueFull/retry_after_s beyond it (0 = no per-model "
+             "cap; the global MXNET_SERVING_QUEUE_DEPTH always applies); "
+             "ModelServer.set_quota overrides per model")
+register_env("MXNET_SERVING_MODEL_INFLIGHT", int, 0,
+             "default per-model cap on accepted-but-unresolved requests "
+             "(queued + executing); 0 = no cap; set_quota overrides")
+register_env("MXNET_SERVING_PRIORITY_CLASSES", int, 3,
+             "number of serving priority classes (0 = most important, "
+             "N-1 = first shed under brownout)")
+register_env("MXNET_SERVING_DEFAULT_PRIORITY", int, 1,
+             "priority class assigned to requests that pass none")
+register_env("MXNET_SERVING_BROWNOUT_HIGH", float, 0.75,
+             "queue-fill fraction (of MXNET_SERVING_QUEUE_DEPTH) at "
+             "which the server enters declared brownout: hold-open "
+             "window skipped, dispatch shrunk to "
+             "MXNET_SERVING_BROWNOUT_MAX_BATCH, priority classes >= "
+             "MXNET_SERVING_BROWNOUT_REJECT_CLASS shed")
+register_env("MXNET_SERVING_BROWNOUT_LOW", float, 0.25,
+             "queue-fill fraction at which brownout exits (hysteresis: "
+             "must be below MXNET_SERVING_BROWNOUT_HIGH)")
+register_env("MXNET_SERVING_BROWNOUT_MAX_BATCH", int, 0,
+             "dispatch-size cap while in brownout (smaller programs "
+             "turn the queue over faster); 0 keeps the ladder max")
+register_env("MXNET_SERVING_BROWNOUT_REJECT_CLASS", int, 2,
+             "lowest priority class still ADMITTED during brownout: "
+             "classes >= this are rejected at submit and shed from the "
+             "queue, counted per model+class in "
+             "mxnet_serving_sheds_total")
+register_env("MXNET_SERVING_CANARY_FRACTION", float, 0.0,
+             "staged-promotion traffic fraction: watcher-promoted "
+             "checkpoint versions serve only this fraction of the "
+             "model's unversioned traffic until the health gate "
+             "decides promotion vs rollback; 0 (default) promotes "
+             "directly (the PR 5 behavior)")
+register_env("MXNET_SERVING_CANARY_MIN_REQUESTS", int, 20,
+             "canary completions required before the health gate "
+             "decides (the evidence budget; the non-finite sentinel "
+             "rolls back immediately regardless)")
+register_env("MXNET_SERVING_CANARY_MAX_ERROR_RATE", float, 0.05,
+             "canary failed/completed ratio above which the gate rolls "
+             "back")
+register_env("MXNET_SERVING_CANARY_P99_FACTOR", float, 3.0,
+             "rollback when canary p99 latency exceeds this multiple "
+             "of the baseline version's p99 over the same window")
+register_env("MXNET_SERVING_CANARY_TIMEOUT_S", float, 600.0,
+             "canary decision budget: a canary that cannot gather "
+             "min_requests within this window is decided on whatever "
+             "evidence exists (healthy -> promote, zero traffic -> "
+             "rollback)")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

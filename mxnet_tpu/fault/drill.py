@@ -22,6 +22,18 @@ writes the MULTICHIP record):
   (served or a typed error — zero lost, zero duplicated), and every
   checkpoint any reader ever resolves is COMPLETE (zero integrity
   failures on committed directories).
+
+- :func:`multitenant_soak` — the ISSUE 15 drill: two tenants share
+  one hardened server (per-model quotas, reserved executor-cache
+  slots, canary staged promotion).  The VICTIM tenant takes scoped
+  faults (``where: {"model": ...}``): transient bind failures plus a
+  NaN-poisoned canary (a checkpoint hot-swap whose outputs the plan
+  corrupts at ``serving.canary.execute``).  Asserts: the canary is
+  auto-rolled-back within budget with the baseline still serving;
+  each tenant's request ledger is exactly conserved (zero lost, zero
+  duplicated, per tenant); the bystander tenant sees ZERO failures,
+  ZERO executor-cache evictions and keeps serving throughout; queue
+  peaks respect the registered quotas.
 """
 from __future__ import annotations
 
@@ -35,7 +47,7 @@ import tempfile
 import threading
 import time
 
-__all__ = ["elastic_kill_drill", "chaos_soak"]
+__all__ = ["elastic_kill_drill", "chaos_soak", "multitenant_soak"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -399,8 +411,8 @@ def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
     # one terminal outcome — a double delivery (or a dropped one) would
     # unbalance this ledger
     sreq = stats["requests"]
-    assert sreq["submitted"] == \
-        sreq["served"] + sreq["failed"] + sreq["expired"], \
+    assert sreq["submitted"] == sreq["served"] + sreq["failed"] \
+        + sreq["expired"] + sreq["shed"], \
         "server request ledger unbalanced (duplicate or dropped " \
         "delivery): %s" % sreq
     assert not integrity_failures, \
@@ -431,6 +443,256 @@ def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
         "zero_lost_requests": True,
         "zero_duplicated_requests": True,   # the ledger assertion above
         "zero_incomplete_checkpoint_reads": True,
+    }
+    if own:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant soak — quotas + canary rollback under tenant-scoped faults
+# ---------------------------------------------------------------------------
+
+VICTIM, BYSTANDER = "tenantA", "tenantB"
+
+MT_PLAN = {
+    "seed": 11,
+    "rules": [
+        # the victim's executor binds fail transiently: its batches
+        # poison, its quota'd cache slots churn — the bystander's must
+        # not
+        {"site": "serving.cache.get", "kind": "raise",
+         "exc": "RuntimeError", "p": 0.05, "times": 0,
+         "where": {"model": VICTIM}},
+        # victim batches run slow (brownout pressure feed)
+        {"site": "serving.worker", "kind": "delay", "delay_s": 0.005,
+         "p": 0.1, "times": 0, "where": {"model": VICTIM}},
+        # the poisoned canary: EVERY canary-version batch of the victim
+        # silently emits NaNs — the health gate's non-finite sentinel,
+        # not any exception handler, must roll it back
+        {"site": "serving.canary.execute", "kind": "nan", "times": 0,
+         "where": {"model": VICTIM}},
+    ],
+}
+
+
+def _soak_module(seed=0):
+    """The small trained module both soaks checkpoint/hot-swap from."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    rng = np.random.RandomState(seed)
+    X = rng.randn(64, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=16)
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, eval_metric="acc")
+    return mod
+
+
+def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
+                     canary_fraction=0.3, tmpdir=None):
+    """Two tenants, one hardened server, tenant-scoped faults + one
+    poisoned canary (see module docstring for the invariants).
+    Returns the report dict; raises AssertionError on any violation."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.serving.errors import ServingError
+
+    own = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="graftfault-mt-")
+    ckpt_dir = os.path.join(tmpdir, "ck")
+
+    mod_v = _soak_module(seed=0)      # the victim (checkpoint source)
+    mod_b = _soak_module(seed=1)      # the bystander
+
+    srv = mx.serving.ModelServer(max_batch=8, batch_wait_ms=1.0,
+                                 queue_depth=64,
+                                 default_timeout_ms=30000.0,
+                                 canary_fraction=canary_fraction)
+    mod_v.export_serving(VICTIM, srv)
+    mod_b.export_serving(BYSTANDER, srv)
+    # cache quota sized for the ladder x 2 live versions: a canary
+    # transiently doubles the victim's working set, and its binds must
+    # evict neither the bystander NOR the victim's own baseline
+    ladder = len(srv.stats()["buckets"])
+    srv.set_quota(VICTIM, queue_depth=32, cache_entries=2 * ladder)
+    srv.set_quota(BYSTANDER, queue_depth=32, cache_entries=ladder)
+    srv.start()
+    srv.warmup()
+
+    mgr = CheckpointManager(directory=ckpt_dir, async_save=False,
+                            keep_last=4)
+    # step-1 checkpoint BEFORE the watcher: it aliases the exported
+    # version 1 (same weights), so the watcher's first poll is a no-op
+    # promote and the MID-SOAK save below claims step 2 — the canary
+    mgr.save_module(mod_v, epoch=1, block=True)
+    watcher = srv.watch_checkpoints(ckpt_dir, VICTIM, poll_interval=0.2)
+
+    stop = threading.Event()
+    counts = {t: {"submitted": 0, "served": 0, "typed_failures": 0,
+                  "lost": 0}
+              for t in (VICTIM, BYSTANDER)}
+    counts_lock = threading.Lock()
+    t_start = time.monotonic()
+    canary_seen = threading.Event()
+
+    def client(tenant, ci):
+        crng = np.random.RandomState(500 + ci)
+        mine = counts[tenant]
+        while not stop.is_set():
+            rows = 1 + int(crng.randint(0, 4))
+            with counts_lock:
+                mine["submitted"] += 1
+            try:
+                fut = srv.infer_async(
+                    tenant, crng.randn(rows, 8).astype(np.float32),
+                    retries=2)
+            except ServingError:
+                with counts_lock:
+                    mine["typed_failures"] += 1
+                continue
+            if not fut.wait(25.0):
+                with counts_lock:
+                    mine["lost"] += 1
+                continue
+            try:
+                outs = fut.result()
+                assert outs[0].shape[0] == rows
+                with counts_lock:
+                    mine["served"] += 1
+            except Exception:
+                # delivered failure (injected bind fault, deadline,
+                # poisoned canary outputs raising downstream): the
+                # future RESOLVED — a typed outcome, not a loss
+                with counts_lock:
+                    mine["typed_failures"] += 1
+
+    threads = [threading.Thread(target=client, args=(VICTIM, ci),
+                                daemon=True)
+               for ci in range(clients_victim)]
+    threads += [threading.Thread(target=client, args=(BYSTANDER, 100 + ci),
+                                 daemon=True)
+                for ci in range(clients_bystander)]
+
+    plan = fault.FaultPlan(MT_PLAN)
+    rollback_wall_s = None
+    try:
+        with fault.active_plan(plan):
+            for t in threads:
+                t.start()
+            # commit ONE new victim checkpoint a beat in: the watcher
+            # warms it, stages it as a canary, the plan poisons it
+            time.sleep(min(1.0, duration_s / 4.0))
+            mgr.save_module(mod_v, epoch=2, block=True)
+            t_commit = time.monotonic()
+            deadline = t_start + duration_s
+            while time.monotonic() < deadline:
+                hist = srv.canary_status(VICTIM)["history"]
+                if hist and rollback_wall_s is None:
+                    rollback_wall_s = time.monotonic() - t_commit
+                    canary_seen.set()
+                time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        watcher.stop()
+        srv.stop(drain=False)
+    finally:
+        if not stop.is_set():
+            stop.set()
+
+    # -- invariants ----------------------------------------------------------
+    stats = srv.stats()
+    per_model = stats["per_model"]
+    # (1) per-tenant client-side exactly-once + server-side ledger
+    for tenant in (VICTIM, BYSTANDER):
+        c = counts[tenant]
+        resolved = c["served"] + c["typed_failures"]
+        assert c["lost"] == 0, \
+            "%s: %d futures never resolved" % (tenant, c["lost"])
+        assert resolved == c["submitted"], \
+            "%s: %d submitted, %d resolved" % (tenant, c["submitted"],
+                                               resolved)
+        sreq = per_model[tenant]["requests"]
+        assert sreq["submitted"] == sreq["served"] + sreq["failed"] \
+            + sreq["expired"] + sreq["shed"], \
+            "%s server ledger unbalanced: %s" % (tenant, sreq)
+        # (2) quotas respected
+        quota = per_model[tenant]["quota"]
+        assert per_model[tenant]["queue_peak"] <= quota["queue_depth"], \
+            "%s queue peak %d exceeded quota %s" % (
+                tenant, per_model[tenant]["queue_peak"], quota)
+    # (3) the poisoned canary rolled back; baseline still serving
+    hist = srv.canary_status(VICTIM)["history"]
+    assert canary_seen.is_set() and hist, \
+        "canary never staged/decided — watcher or promotion dead?"
+    verdict = hist[-1]
+    assert verdict["decision"] == "rolled_back", verdict
+    assert verdict["reason"] == "nonfinite_outputs", verdict
+    assert srv.registry.get(VICTIM).version == \
+        verdict["baseline_version"], \
+        "rollback left the wrong default serving"
+    # (4) the bystander never suffered: zero failures, zero cache
+    # evictions, real throughput throughout
+    b = per_model[BYSTANDER]["requests"]
+    assert b["failed"] == 0 and b["shed"] == 0, \
+        "bystander absorbed the victim's faults: %s" % b
+    cache_pm = stats["executor_cache"]["per_model"]
+    assert cache_pm.get(BYSTANDER, {}).get("evictions", 0) == 0, \
+        "cross-tenant eviction: %s" % cache_pm
+    assert counts[BYSTANDER]["served"] > 0
+    # (5) every injected fault was scoped to the victim
+    injected = plan.stats()
+    assert injected["injected"], "soak injected nothing — plan dead?"
+    nan_hits = plan.injected_count(site="serving.canary.execute",
+                                   kind="nan")
+    assert nan_hits >= 1, "the canary was never poisoned"
+
+    wall = time.monotonic() - t_start
+    report = {
+        "duration_s": round(wall, 2),
+        "canary_fraction": canary_fraction,
+        "per_tenant": {
+            t: {
+                "clients": (clients_victim if t == VICTIM
+                            else clients_bystander),
+                "requests": dict(counts[t]),
+                "req_per_sec": round(counts[t]["served"] / wall, 2),
+                "p99_ms": per_model[t]["latency_ms"]["p99"],
+                "server_ledger": per_model[t]["requests"],
+                "queue_peak": per_model[t]["queue_peak"],
+                "quota": per_model[t]["quota"],
+                "cache": stats["executor_cache"]["per_model"].get(t),
+            } for t in (VICTIM, BYSTANDER)},
+        "canary": {
+            "verdict": verdict,
+            "rollback_wall_s": (round(rollback_wall_s, 3)
+                                if rollback_wall_s is not None else None),
+            "decision_latency_s": verdict["decision_latency_s"],
+        },
+        "faults_injected": {
+            "total": len(injected["injected"]),
+            "nan_canary_batches": nan_hits,
+            "by_site": {s: sum(1 for i in injected["injected"]
+                               if i["site"] == s)
+                        for s in sorted({i["site"]
+                                         for i in injected["injected"]})},
+        },
+        "zero_lost_requests_per_tenant": True,
+        "zero_duplicated_requests_per_tenant": True,
+        "zero_cross_tenant_evictions": True,
+        "quotas_respected": True,
+        "rolled_back_to_baseline": True,
     }
     if own:
         import shutil

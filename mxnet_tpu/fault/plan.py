@@ -34,8 +34,18 @@ schedule must not silently drill nothing):
 - ``p``: probability per otherwise-matching hit, drawn from a PER-RULE
   ``random.Random(seed, index)`` chain — pseudo-random but exactly
   reproducible given the plan (chaos-soak mode);
+- ``where``: dict of fnmatch patterns over the site's ``ctx`` kwargs
+  (``{"model": "tenantA"}``) — the multi-tenant form: one tenant's
+  site hits match, everyone else's pass through untouched.  A ctx key
+  the site never publishes simply never matches (loudness lives in the
+  site catalog, not the rule);
 - ``exc`` (kind=raise): exception class name from :data:`EXC_NAMES`;
 - ``delay_s`` (kind=delay), ``code`` (kind=exit), ``message``.
+
+``kind=nan`` corrupts the float arrays a site passes as
+``ctx["arrays"]`` in place (non-float payloads are left untouched) —
+the poisoned-canary drill: a model version that silently emits
+non-finite outputs, which the serving health gate must catch.
 
 Determinism contract: with the same plan, the same sequence of site
 hits and the same published steps, exactly the same faults fire.
@@ -57,10 +67,10 @@ __all__ = ["FaultInjected", "FaultPlan", "install", "uninstall",
            "installed", "active_plan", "KINDS", "EXC_NAMES"]
 
 KINDS = ("raise", "io_error", "enospc", "torn_write", "delay",
-         "sigterm", "sigkill", "exit")
+         "sigterm", "sigkill", "exit", "nan")
 
 _RULE_KEYS = frozenset(("site", "kind", "after", "every", "times", "step",
-                        "p", "exc", "delay_s", "code", "message"))
+                        "p", "exc", "delay_s", "code", "message", "where"))
 
 
 class FaultInjected(Exception):
@@ -99,8 +109,8 @@ EXC_NAMES = ("FaultInjected", "OSError", "IOError", "RuntimeError",
 
 class _Rule:
     __slots__ = ("site", "kind", "after", "every", "times", "step", "p",
-                 "exc", "delay_s", "code", "message", "fired", "rng",
-                 "index")
+                 "exc", "delay_s", "code", "message", "where", "fired",
+                 "rng", "index")
 
     def __init__(self, spec, index, seed):
         unknown = set(spec) - _RULE_KEYS
@@ -127,6 +137,12 @@ class _Rule:
         self.delay_s = float(spec.get("delay_s", 0.05))
         self.code = int(spec.get("code", 137))
         self.message = spec.get("message") or ""
+        where = spec.get("where") or {}
+        if not isinstance(where, dict):
+            raise ValueError("fault rule %d 'where' must be a dict of "
+                             "ctx-key -> fnmatch pattern, got %r"
+                             % (index, where))
+        self.where = {str(k): str(v) for k, v in where.items()}
         self.index = index
         self.fired = 0
         # per-rule chain: reproducible regardless of how many OTHER
@@ -134,15 +150,19 @@ class _Rule:
         # unlike tuple-hash seeding)
         self.rng = random.Random("%d:%d" % (seed, index))
 
-    def wants(self, site, hit_no, step):
+    def wants(self, site, hit_no, step, ctx):
         """Deterministic match verdict for hit ``hit_no`` (1-based) of
         ``site``.  Consumes this rule's RNG only on otherwise-matching
-        hits, so the draw sequence is a pure function of the hit
-        sequence."""
+        hits, so the draw sequence is a pure function of the hit (and
+        ctx) sequence."""
         if not fnmatch.fnmatchcase(site, self.site):
             return False
         if self.step is not None and step != self.step:
             return False
+        for k, pat in self.where.items():
+            v = ctx.get(k)
+            if v is None or not fnmatch.fnmatchcase(str(v), pat):
+                return False
         k = hit_no - self.after
         if k <= 0 or (k - 1) % self.every:
             return False
@@ -198,7 +218,7 @@ class FaultPlan:
             n = self._hits.get(site, 0) + 1
             self._hits[site] = n
             for rule in self._rules:
-                if rule.wants(site, n, step):
+                if rule.wants(site, n, step, ctx):
                     rule.fired += 1
                     self._injected.append((site, rule.kind, rule.index))
                     actions.append(rule)
@@ -221,6 +241,15 @@ class FaultPlan:
             % (rule.kind, site, rule.index))
         if rule.kind == "delay":
             time.sleep(rule.delay_s)
+            return
+        if rule.kind == "nan":
+            # corrupt the site's float payload in place — silent bad
+            # outputs, the failure mode a health gate's non-finite
+            # sentinel (not an exception handler) must catch
+            for a in ctx.get("arrays") or ():
+                dt = getattr(a, "dtype", None)
+                if dt is not None and getattr(dt, "kind", "") == "f":
+                    a[...] = float("nan")
             return
         if rule.kind == "sigterm":
             os.kill(os.getpid(), signal.SIGTERM)

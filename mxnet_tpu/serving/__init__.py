@@ -5,10 +5,15 @@ wrapper: a versioned model registry, a shape-bucketed LRU executor
 cache (every compiled program reused, zero steady-state recompiles),
 and a dynamic micro-batcher with per-request deadlines, bounded-queue
 backpressure, worker fault isolation, and a /stats metrics snapshot.
+Multi-tenant hardening: per-model admission quotas + executor-cache
+reservations, priority-classed SLO load-shedding with a declared
+brownout mode, and canary staged promotion with health-gated
+auto-rollback (``canary.py``).
 See ``docs/faq/serving.md`` for architecture and knobs.
 """
 from .bucketing import pick_bucket, shape_buckets  # noqa: F401
 from .cache import ExecutorCache  # noqa: F401
+from .canary import CanaryState  # noqa: F401
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
                      QueueFull, ServerClosed, ServingError)
 from .manifest import WarmupManifest  # noqa: F401
@@ -17,7 +22,7 @@ from .registry import (CheckpointWatcher, ModelRegistry,  # noqa: F401
 from .server import InferenceFuture, ModelServer  # noqa: F401
 
 __all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
-           "InferenceFuture", "ServingError", "ModelNotFound", "QueueFull",
-           "DeadlineExceeded", "ServerClosed", "BadRequest",
-           "CheckpointWatcher", "WarmupManifest", "shape_buckets",
-           "pick_bucket"]
+           "InferenceFuture", "CanaryState", "ServingError",
+           "ModelNotFound", "QueueFull", "DeadlineExceeded", "ServerClosed",
+           "BadRequest", "CheckpointWatcher", "WarmupManifest",
+           "shape_buckets", "pick_bucket"]

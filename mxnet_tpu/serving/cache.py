@@ -13,6 +13,16 @@ cache's reference: the batcher may still be mid-forward on an evicted
 or invalidated executor, so buffers are reclaimed by refcount once any
 in-flight batch completes — never freed out from under it.  The shared
 params live in the registry entries and are untouched either way.
+
+Multi-tenancy: ``set_quota(name, entries)`` RESERVES executor slots
+for one model.  A quota'd model over its own budget evicts its OWN
+least-recent entries (a tenant pays for its own churn), and the global
+LRU sweep skips entries of quota'd models that are within budget — so
+one tenant's bind storm can never evict another tenant's hot
+executors (the cross-tenant recompile storm the shared LRU allowed).
+Reserved slots are a guarantee, not an allocation: when the sum of
+quotas exceeds ``capacity`` the cache is allowed to run over capacity
+rather than break a reservation (it warns once — fix the config).
 """
 from __future__ import annotations
 
@@ -39,6 +49,9 @@ class ExecutorCache:
         self.hits = 0                   # guarded-by: _lock
         self.misses = 0                 # guarded-by: _lock
         self.evictions = 0              # guarded-by: _lock
+        self._quotas = {}               # guarded-by: _lock — name -> slots
+        self._per_model = {}            # guarded-by: _lock — name -> counts
+        self._over_capacity_warned = False   # guarded-by: _lock
         # miss hook: the server records every freshly-bound (entry,
         # bucket) key into the warmup manifest, so a restarted replica
         # knows the working set to re-warm.  Called OUTSIDE the lock
@@ -84,7 +97,9 @@ class ExecutorCache:
             cached = self._entries.get(key)
             if cached is not None:
                 self.hits += 1
-                self._t_events.labels(outcome="hit").inc()
+                self._count_locked(entry.name, "hits")
+                self._t_events.labels(outcome="hit",
+                                      model=entry.name).inc()
                 self._entries.move_to_end(key)
                 return cached[1]
         # bind OUTSIDE the lock: a compile can take seconds and must not
@@ -96,17 +111,17 @@ class ExecutorCache:
             race = self._entries.get(key)
             if race is not None:        # another thread bound it first
                 self.hits += 1
-                self._t_events.labels(outcome="hit").inc()
+                self._count_locked(entry.name, "hits")
+                self._t_events.labels(outcome="hit",
+                                      model=entry.name).inc()
                 self._entries.move_to_end(key)
                 return race[1]
             self.misses += 1
-            self._t_events.labels(outcome="miss").inc()
+            self._count_locked(entry.name, "misses")
+            self._t_events.labels(outcome="miss",
+                                  model=entry.name).inc()
             self._entries[key] = (entry, pred)
-            while len(self._entries) > self._capacity:
-                self._entries.popitem(last=False)
-                self.evictions += 1
-                self._t_events.labels(outcome="eviction").inc()
-                self._t_evictions.inc()
+            self._evict_locked(entry.name)
         if self._on_miss is not None:
             try:
                 self._on_miss(entry, bucket)
@@ -117,6 +132,73 @@ class ExecutorCache:
             except Exception:   # graftlint: disable=swallowed-exception
                 pass
         return pred
+
+    def set_quota(self, name, entries):
+        """Reserve ``entries`` executor slots for model ``name`` (the
+        serving ladder's length is the natural value).  ``None`` or
+        ``<= 0`` clears the reservation back to shared-LRU behavior."""
+        with self._lock:
+            if entries is None or int(entries) <= 0:
+                self._quotas.pop(name, None)
+            else:
+                self._quotas[name] = int(entries)
+                if sum(self._quotas.values()) > self._capacity and \
+                        not self._over_capacity_warned:
+                    self._over_capacity_warned = True
+                    import logging
+                    logging.warning(
+                        "executor-cache quotas reserve %d slots but "
+                        "capacity is %d; reservations win and the cache "
+                        "may run over capacity — raise "
+                        "MXNET_SERVING_EXECUTOR_CACHE",
+                        sum(self._quotas.values()), self._capacity)
+
+    def quotas(self):
+        with self._lock:
+            return dict(self._quotas)
+
+    def _count_locked(self, name, outcome, n=1):
+        per = self._per_model.setdefault(
+            name, {"hits": 0, "misses": 0, "evictions": 0})
+        per[outcome] += n
+
+    def _size_locked(self, name):
+        return sum(1 for k in self._entries if k[0] == name)
+
+    def _evict_locked(self, inserted_name):
+        """Capacity enforcement after inserting a key of
+        ``inserted_name``.  Two passes: (1) a quota'd model over its
+        OWN budget sheds its own LRU entries; (2) the global sweep
+        evicts LRU entries whose model is NOT protected — protected =
+        quota'd and within budget.  When every remaining entry is
+        protected the cache runs over capacity (reservations win)."""
+        quota = self._quotas.get(inserted_name)
+        if quota is not None:
+            while self._size_locked(inserted_name) > quota:
+                victim = next(k for k in self._entries
+                              if k[0] == inserted_name)
+                self._evict_one_locked(victim)
+        while len(self._entries) > self._capacity:
+            victim = None
+            for k in self._entries:          # LRU order
+                q = self._quotas.get(k[0])
+                if q is None or self._size_locked(k[0]) > q:
+                    victim = k
+                    break
+            if victim is None:
+                break                        # all protected: run over
+            self._evict_one_locked(victim)
+
+    def _evict_one_locked(self, key):
+        self._entries.pop(key)
+        self.evictions += 1
+        self._count_locked(key[0], "evictions")
+        self._t_events.labels(outcome="eviction", model=key[0]).inc()
+        # dual-write: the unlabeled child stays the cross-model total
+        # (the pre-multi-tenant series dashboards alert on), the
+        # model child is the per-tenant slice
+        self._t_evictions.inc()
+        self._t_evictions.labels(model=key[0]).inc()
 
     def invalidate(self, name, version=None):
         """Drop cached executors for a model (hot swap / unload path)."""
@@ -134,7 +216,12 @@ class ExecutorCache:
 
     def stats(self):
         with self._lock:
+            per_model = {
+                n: dict(c, size=self._size_locked(n),
+                        quota=self._quotas.get(n))
+                for n, c in sorted(self._per_model.items())}
             return {"hits": self.hits, "misses": self.misses,
                     "recompiles": self.misses, "evictions": self.evictions,
                     "size": len(self._entries),
-                    "capacity": self._capacity}
+                    "capacity": self._capacity,
+                    "per_model": per_model}

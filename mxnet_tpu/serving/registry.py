@@ -297,9 +297,20 @@ class CheckpointWatcher:
                     "(%s: %s); promoting anyway (lazy compile)",
                     self.name, step, type(exc).__name__, exc)
         if self.set_default:
-            self.registry.set_default(self.name, step)
-        logging.info("checkpoint watcher %r: now serving version %d",
-                     self.name, step)
+            if self.server is not None:
+                # staged promotion: with a canary fraction configured
+                # the new version receives only that fraction of
+                # traffic until the server's health gate (error rate,
+                # p99 vs baseline, non-finite sentinel) promotes it —
+                # or rolls it back, leaving the CURRENT default
+                # serving.  Fraction 0 (default) is the direct PR 5
+                # set_default.
+                self.server.promote_version(self.name, step)
+            else:
+                self.registry.set_default(self.name, step)
+        logging.info("checkpoint watcher %r: now serving version %d "
+                     "(staged=%s)", self.name, step,
+                     self.server is not None and self.set_default)
         return step
 
     def _loop(self):
@@ -309,6 +320,10 @@ class CheckpointWatcher:
             # transiently unreadable filesystem
             with engine.worker_scope(deliver=self._log_error):
                 self.poll_once()
+            if self.server is not None:
+                # time-based canary gates (budget timeout) must fire
+                # even when the model gets no traffic at all
+                self.server.tick_canaries()
             self._stop.wait(self.poll_interval)
 
     def _log_error(self, exc):

@@ -30,12 +30,37 @@ Production behaviors, each with a typed error and a /stats counter:
   hits/misses and the recompile count; each executed batch also emits
   a ``serving:batch`` span through the profiler's chrome-trace path.
 
+Multi-tenant hardening (docs/faq/serving.md §multi-tenancy):
+
+- **admission control** — ``set_quota`` registers per-model queue
+  depth / in-flight / executor-cache reservations; one tenant's burst
+  is rejected with ITS OWN ``QueueFull`` (and a ``retry_after_s``
+  computed from that model's OWN service-time history) while other
+  tenants keep being admitted.  Batch scheduling round-robins across
+  models with queued work instead of strict FIFO, so a deep backlog
+  for one tenant cannot starve another's shallow queue;
+- **SLO-aware load-shedding** — requests carry a priority class
+  (0 = most important); the batcher sheds already-doomed work (the
+  deadline cannot be met given the model's measured execute time)
+  before it costs accelerator time, and under sustained pressure the
+  server enters a declared *brownout*: dispatch size shrinks, the
+  hold-open window is skipped, and the lowest priority classes are
+  rejected at submit / shed from the queue — every shed decision is
+  counted per model+class+reason (``mxnet_serving_sheds_total``)
+  instead of collapsing into one global failure mode;
+- **canary auto-rollback** — ``promote_version`` stages a new version
+  behind a traffic fraction with a health gate (non-finite sentinel,
+  error rate, p99 vs baseline) deciding full promotion vs automatic
+  rollback; the registry default only ever moves AFTER the gate
+  passes (``serving/canary.py``).
+
 Threading model: ONE batcher thread owns all executor dispatch (the
 natural fit for a single accelerator's program queue); client threads
 only enqueue and wait on futures.
 """
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
 
@@ -50,7 +75,9 @@ from ..fault import hooks as _fault
 from ..io import pad_batch
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
-from .errors import (BadRequest, DeadlineExceeded, QueueFull, ServerClosed)
+from .canary import CanaryState
+from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,
+                     QueueFull, ServerClosed)
 from .manifest import WarmupManifest
 from .registry import ModelRegistry
 
@@ -137,13 +164,15 @@ class InferenceFuture:
 
 class _Request:
     __slots__ = ("entry", "inputs", "rows", "future", "gkey", "t_submit",
-                 "solo")
+                 "solo", "priority")
 
-    def __init__(self, entry, inputs, rows, future, t_submit, solo=False):
+    def __init__(self, entry, inputs, rows, future, t_submit, solo=False,
+                 priority=0):
         self.entry = entry
         self.inputs = inputs
         self.rows = rows
         self.future = future
+        self.priority = int(priority)
         # id(entry) pins the EXACT registry object: an unload +
         # re-register of the same version number while requests are
         # queued must not co-batch old-entry and new-entry requests.
@@ -169,7 +198,8 @@ class ModelServer:
 
     def __init__(self, registry=None, max_batch=None, queue_depth=None,
                  batch_wait_ms=None, default_timeout_ms=None,
-                 cache_size=None, buckets=None, manifest_path=None):
+                 cache_size=None, buckets=None, manifest_path=None,
+                 canary_fraction=None):
         self.registry = registry if registry is not None else ModelRegistry()
         if buckets is not None:
             self._buckets = sorted({int(b) for b in buckets})
@@ -213,10 +243,51 @@ class ModelServer:
         self._cv = threading.Condition(_san_hooks.make_lock(
             "serving.ModelServer._cv", threading.Lock()))
         self._queue = []                # guarded-by: _cv
+        self._depths = {}               # guarded-by: _cv — model -> queued
+        self._rr_last = ""              # guarded-by: _cv — RR cursor
         self._san_region = None         # graftsan steady-state handle
         self._stopping = False
         self._drain = True
         self._thread = None
+        # -- admission control / shedding policy ---------------------------
+        self._model_quotas = {}         # guarded-by: _cv — name -> dict
+        self._default_model_queue = int(
+            config.get("MXNET_SERVING_MODEL_QUEUE_DEPTH"))
+        self._default_model_inflight = int(
+            config.get("MXNET_SERVING_MODEL_INFLIGHT"))
+        self._priority_classes = max(
+            1, int(config.get("MXNET_SERVING_PRIORITY_CLASSES")))
+        self._default_priority = min(
+            self._priority_classes - 1,
+            max(0, int(config.get("MXNET_SERVING_DEFAULT_PRIORITY"))))
+        self._brownout_high = max(1, int(round(
+            float(config.get("MXNET_SERVING_BROWNOUT_HIGH"))
+            * self._queue_depth)))
+        self._brownout_low = max(0, int(round(
+            float(config.get("MXNET_SERVING_BROWNOUT_LOW"))
+            * self._queue_depth)))
+        if self._brownout_low >= self._brownout_high:
+            raise ValueError(
+                "brownout hysteresis needs a gap: low watermark %d "
+                "(MXNET_SERVING_BROWNOUT_LOW) must be below high "
+                "watermark %d (MXNET_SERVING_BROWNOUT_HIGH) — equal or "
+                "inverted watermarks would flap enter/exit per submit"
+                % (self._brownout_low, self._brownout_high))
+        self._brownout_max_batch = int(
+            config.get("MXNET_SERVING_BROWNOUT_MAX_BATCH"))
+        self._brownout_reject_class = int(
+            config.get("MXNET_SERVING_BROWNOUT_REJECT_CLASS"))
+        self._brownout = False          # guarded-by: _cv
+        self._brownout_entered = 0      # guarded-by: _cv
+        # -- canary staged promotion ---------------------------------------
+        self._canary_fraction = float(
+            canary_fraction if canary_fraction is not None
+            else config.get("MXNET_SERVING_CANARY_FRACTION"))
+        self._canary_lock = _san_hooks.make_lock(
+            "serving.ModelServer._canary_lock", threading.Lock())
+        self._canaries = {}             # guarded-by: _canary_lock
+        self._canary_rng = {}           # guarded-by: _canary_lock
+        self._canary_history = {}       # guarded-by: _canary_lock
         # -- metrics --------------------------------------------------------
         # dual-written: per-instance ints back stats() — an EXACT
         # per-server view even with several servers alive in one process
@@ -242,12 +313,29 @@ class ModelServer:
             "mxnet_serving_latency_ms",
             "submit-to-result latency of served requests",
             buckets=telemetry.exponential_buckets(0.5, 2.0, 14))
+        self._t_sheds = telemetry.counter(
+            "mxnet_serving_sheds_total",
+            "load-shedding decisions by model, priority class and "
+            "reason (doomed/brownout_reject/brownout_queue)")
+        self._t_brownout = telemetry.gauge(
+            "mxnet_serving_brownout",
+            "1 while the server is in declared brownout (queue above "
+            "the high watermark: shrunk dispatch, lowest classes shed)")
+        self._t_canary = telemetry.gauge(
+            "mxnet_serving_canary_state",
+            "per-model canary state: 0 none, 1 canarying, 2 last "
+            "decision promoted, -1 last decision rolled back")
         self._mlock = _san_hooks.make_lock(
             "serving.ModelServer._mlock", threading.Lock())
         self._req_counts = {o: 0           # guarded-by: _mlock
                             for o in ("submitted", "served", "failed",
                                       "rejected_queue_full", "expired",
-                                      "retried")}
+                                      "retried", "shed")}
+        self._model_req = {}               # guarded-by: _mlock
+        self._inflight = {}                # guarded-by: _mlock
+        self._shed_counts = {}             # guarded-by: _mlock
+        self._exec_ms = {}                 # guarded-by: _mlock
+        self._exec_est = {}                # guarded-by: _mlock — medians
         # client-side submit retry (MXNET_SERVING_SUBMIT_RETRIES, off by
         # default): jittered sleeps floored at the server's live
         # retry_after_s hint; base = one batch window, the natural
@@ -256,17 +344,45 @@ class ModelServer:
         self._submit_backoff = BackoffPolicy(
             retries=0, base_s=max(self._batch_wait_ms, 1.0) / 1000.0)
         self._batch_hist = {}              # guarded-by: _mlock
-        self._latencies = []               # guarded-by: _mlock
+        self._latencies = {}               # guarded-by: _mlock — per model
         self._lat_cap = 4096
         self._queue_peak = 0               # guarded-by: _mlock
+        self._model_queue_peak = {}        # guarded-by: _mlock
         self._domain = profiler.Domain("serving")
         self._q_counter = self._domain.new_counter("serving_queue_depth")
 
-    def _req_inc(self, outcome, n=1):
-        if n:
-            with self._mlock:
-                self._req_counts[outcome] += n
+    _TERMINAL = frozenset(("served", "failed", "expired", "shed"))
+
+    def _req_inc(self, outcome, n=1, model=None):
+        """Count a request outcome, per model when one is known.  The
+        ledger invariant the chaos soaks assert: per model AND
+        globally, submitted == served + failed + expired + shed —
+        every ACCEPTED request lands in exactly one terminal outcome
+        (rejected_* outcomes were never accepted)."""
+        if not n:
+            return
+        with self._mlock:
+            self._req_counts[outcome] += n
+            if model is not None:
+                per = self._model_req.setdefault(
+                    model, dict.fromkeys(self._req_counts, 0))
+                per[outcome] = per.get(outcome, 0) + n
+                if outcome in self._TERMINAL:
+                    left = self._inflight.get(model, 0) - n
+                    self._inflight[model] = max(0, left)
+        if model is not None:
+            self._t_requests.labels(outcome=outcome, model=model).inc(n)
+        else:
             self._t_requests.labels(outcome=outcome).inc(n)
+
+    def _shed_inc(self, model, cls, reason, n=1):
+        """Every shed decision is visible per model+class+reason —
+        brownout must be a DECLARED mode, not a mystery error spike."""
+        with self._mlock:
+            key = (model, int(cls), reason)
+            self._shed_counts[key] = self._shed_counts.get(key, 0) + n
+        self._t_sheds.labels(model=model, cls=str(int(cls)),
+                             reason=reason).inc(n)
 
     # -- model management ---------------------------------------------------
     def load_model(self, name, symbol_file, param_file, input_shapes,
@@ -297,6 +413,237 @@ class ModelServer:
             directory, name, poll_interval=poll_interval,
             set_default=set_default, start=start, server=self)
 
+    # -- admission control --------------------------------------------------
+    def set_quota(self, name, queue_depth=None, inflight=None,
+                  cache_entries=None):
+        """Register per-model admission quotas for ``name``:
+
+        - ``queue_depth`` — max requests of this model queued at once;
+          beyond it submits are rejected with ``QueueFull`` carrying
+          THIS model's ``retry_after_s`` (other models keep admitting);
+        - ``inflight`` — max accepted-but-unresolved requests (queued +
+          executing), the end-to-end occupancy cap;
+        - ``cache_entries`` — executor-cache slots RESERVED for this
+          model (``ExecutorCache.set_quota``): its hot executors can
+          never be evicted by another tenant's bind storm.
+
+        ``None`` leaves a field at the ``MXNET_SERVING_MODEL_*`` knob
+        default; ``0`` disables that cap explicitly.  Returns the
+        effective quota dict."""
+        q = {"queue_depth": (self._default_model_queue
+                             if queue_depth is None else int(queue_depth)),
+             "inflight": (self._default_model_inflight
+                          if inflight is None else int(inflight))}
+        with self._cv:
+            self._model_quotas[name] = q
+        if cache_entries is not None:
+            self.cache.set_quota(name, cache_entries)
+            q = dict(q, cache_entries=int(cache_entries))
+        return q
+
+    def _quota_for_locked(self, name):
+        q = self._model_quotas.get(name)
+        if q is not None:
+            return q
+        return {"queue_depth": self._default_model_queue,
+                "inflight": self._default_model_inflight}
+
+    # -- canary staged promotion --------------------------------------------
+    def promote_version(self, name, version, fraction=None):
+        """The watcher's promote step, staged: with a canary fraction
+        configured (``MXNET_SERVING_CANARY_FRACTION`` / ctor /
+        ``fraction``) and an existing default version to protect, the
+        new version receives only that fraction of unversioned traffic
+        until the health gate decides; otherwise this is the PR 5
+        direct ``set_default``.  Returns the live ``CanaryState`` or
+        None when promotion was direct."""
+        version = int(version)
+        frac = self._canary_fraction if fraction is None else float(fraction)
+        try:
+            baseline = self.registry.get(name).version
+        except ModelNotFound:
+            baseline = None
+        if frac <= 0.0 or baseline is None or baseline == version:
+            self.registry.set_default(name, version)
+            return None
+        return self.begin_canary(name, version, fraction=frac)
+
+    def begin_canary(self, name, version, fraction=None,
+                     min_requests=None, max_error_rate=None,
+                     p99_factor=None, timeout_s=None):
+        """Start routing ``fraction`` of model ``name``'s unversioned
+        traffic to ``version`` while the registry default stays on the
+        current baseline; the health gate (canary.py) promotes or
+        rolls back automatically.  A still-undecided previous canary
+        for the same model is rolled back as superseded first."""
+        version = int(version)
+        entry = self.registry.get(name, version)   # loud when unknown
+        baseline = self.registry.get(name).version
+        if baseline == version:
+            raise BadRequest(
+                "model %r version %d is already the serving default; "
+                "nothing to canary" % (name, version))
+        cfg = config
+        st = CanaryState(
+            name, baseline, version,
+            self._canary_fraction if fraction is None else float(fraction),
+            int(min_requests if min_requests is not None
+                else cfg.get("MXNET_SERVING_CANARY_MIN_REQUESTS")),
+            float(max_error_rate if max_error_rate is not None
+                  else cfg.get("MXNET_SERVING_CANARY_MAX_ERROR_RATE")),
+            float(p99_factor if p99_factor is not None
+                  else cfg.get("MXNET_SERVING_CANARY_P99_FACTOR")),
+            float(timeout_s if timeout_s is not None
+                  else cfg.get("MXNET_SERVING_CANARY_TIMEOUT_S")),
+            baseline_seed_lat=self._recent_latencies(name))
+        superseded = None
+        with self._canary_lock:
+            prev = self._canaries.get(name)
+            if prev is not None and prev.decision is None:
+                prev.decide("rolled_back", "superseded")
+                self._finish_canary_locked(prev)
+                superseded = prev
+            self._canaries[name] = st
+            # seeded per (model, version): the routing draw sequence —
+            # and therefore the drill — is reproducible
+            self._canary_rng[name] = _random.Random(
+                "canary:%s:%d" % (name, version))
+        if superseded is not None:
+            # same cleanup as a gate-decided rollback: an abandoned
+            # candidate's bound executors and params must not linger
+            # against the tenant's own cache quota
+            self.cache.invalidate(name, superseded.canary_version)
+            try:
+                self.registry.unload(name, superseded.canary_version)
+            except ModelNotFound:
+                pass   # operator raced us; nothing to free
+        self._t_canary.labels(model=name).set(1)
+        del entry
+        return st
+
+    def canary_status(self, name=None):
+        """Live + recent canary evidence (also surfaced in stats())."""
+        with self._canary_lock:
+            live = {n: st.describe() for n, st in self._canaries.items()}
+            hist = {n: list(h) for n, h in self._canary_history.items()}
+        if name is not None:
+            return {"live": live.get(name),
+                    "history": hist.get(name, [])}
+        return {"live": live, "history": hist}
+
+    def tick_canaries(self):
+        """Evaluate time-based canary gates (budget timeout).  Called
+        by the batcher after every executed batch and by the
+        checkpoint watcher each poll; safe to call from anywhere."""
+        with self._canary_lock:
+            pending = [st for st in self._canaries.values()
+                       if st.decision is None]
+        for st in pending:
+            self._maybe_decide_canary(st)
+
+    def _recent_latencies(self, name, n=64):
+        with self._mlock:
+            return list(self._latencies.get(name, ()))[-n:]
+
+    def _canary_route(self, name, entry):
+        """Routing decision for an UNVERSIONED request: a seeded draw
+        sends ``fraction`` of the baseline's traffic to the canary
+        version.  Requests pinning an explicit version bypass this —
+        a pinned client asked for those exact weights."""
+        with self._canary_lock:
+            st = self._canaries.get(name)
+            if st is None or st.decision is not None \
+                    or entry.version != st.baseline_version:
+                return entry
+            if self._canary_rng[name].random() >= st.fraction:
+                return entry
+            st.routed += 1
+            version = st.canary_version
+        if _fault.ACTIVE[0]:
+            # graftfault: a fault here must fail only THIS request's
+            # submit, never the baseline path or the batcher
+            _fault.fire("serving.canary.route", model=name,
+                        version=version)
+        try:
+            return self.registry.get(name, version)
+        except ModelNotFound:
+            return entry   # rolled back between draw and resolve
+
+    def _canary_observe(self, entry, served=0, failed=0, latencies=(),
+                        nonfinite=False):
+        """Batch-outcome evidence feed (batcher thread)."""
+        with self._canary_lock:
+            st = self._canaries.get(entry.name)
+            if st is None or st.decision is not None:
+                return
+            st.record(entry.version, served=served, failed=failed,
+                      latencies=latencies, nonfinite=nonfinite)
+        self._maybe_decide_canary(st)
+
+    def _maybe_decide_canary(self, st):
+        """Run the health gate; apply a terminal verdict.  The verdict
+        is STAMPED under the canary lock (claiming it against races)
+        but APPLIED outside any lock — set_default/unload take the
+        registry and cache locks, and an apply failure reverts the
+        stamp so the next observation retries."""
+        with self._canary_lock:
+            if st.decision is not None:
+                return
+            verdict = st.evaluate()
+            if verdict is None:
+                return
+            decision, reason = verdict
+            st.decide(decision, reason)
+        try:
+            if _fault.ACTIVE[0]:
+                _fault.fire("serving.canary.promote", model=st.name,
+                            version=st.canary_version, decision=decision)
+            if decision == "promoted":
+                self.registry.set_default(st.name, st.canary_version)
+            else:
+                self.cache.invalidate(st.name, st.canary_version)
+                try:
+                    self.registry.unload(st.name, st.canary_version)
+                except ModelNotFound:
+                    pass   # already unloaded (operator raced us)
+        # contain-and-retry: the decision runs on the batcher thread
+        # inside _execute — an injected/transient promotion failure
+        # must fail the PROMOTION (stamp reverted below, retried on
+        # the next observation/tick), never the innocent in-flight
+        # batch above it (drilled by the suppression audit's
+        # multi-tenant leg via an injected serving.canary.promote
+        # fault)
+        except Exception as exc:
+            import logging
+            logging.warning(
+                "canary %s of model %r version %d failed to apply "
+                "(%s: %s); will retry", decision, st.name,
+                st.canary_version, type(exc).__name__, exc)
+            with self._canary_lock:
+                st.decision = None
+                st.reason = None
+                st.decided_s = None
+            return
+        with self._canary_lock:
+            self._finish_canary_locked(st)
+        import logging
+        logging.info("canary of model %r: version %d %s (%s)",
+                     st.name, st.canary_version, st.decision, st.reason)
+
+    def _finish_canary_locked(self, st):
+        if self._canaries.get(st.name) is st:
+            del self._canaries[st.name]
+        hist = self._canary_history.setdefault(st.name, [])
+        hist.append(st.describe())
+        del hist[:-8]
+        self._t_canary.labels(model=st.name).set(
+            2 if st.decision == "promoted" else -1)
+        telemetry.counter(
+            "mxnet_serving_canary_decisions_total",
+            "terminal canary verdicts by model, decision and reason"
+        ).labels(model=st.name, decision=st.decision,
+                 reason=st.reason).inc()
+
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         with self._cv:
@@ -323,8 +670,17 @@ class ModelServer:
         with self._cv:
             leftovers = list(self._queue)
             del self._queue[:]
+            self._depths.clear()
         for r in leftovers:
-            r.future._set_exception(ServerClosed("server stopped"))
+            # leftovers are terminal outcomes too: the ledger must
+            # balance and the per-model inflight budget must release,
+            # or a stop/start cycle leaves quota'd tenants rejected
+            # forever (review-found, regression-tested)
+            name = r.entry.name
+            if r.future._set_exception(ServerClosed("server stopped")):
+                self._req_inc("failed", model=name)
+            else:
+                self._req_inc("expired", model=name)
         if self._san_region is not None:
             self._san_region.close()
             self._san_region = None
@@ -337,16 +693,16 @@ class ModelServer:
 
     # -- request path -------------------------------------------------------
     def infer(self, name, inputs, version=None, timeout_ms=None,
-              retries=None):
+              retries=None, priority=None):
         """Blocking inference: returns the model's outputs as a list of
         numpy arrays whose batch axis matches the request's rows.
-        ``retries`` — see :meth:`infer_async`."""
+        ``retries``/``priority`` — see :meth:`infer_async`."""
         return self.infer_async(name, inputs, version=version,
-                                timeout_ms=timeout_ms,
-                                retries=retries).result()
+                                timeout_ms=timeout_ms, retries=retries,
+                                priority=priority).result()
 
     def infer_async(self, name, inputs, version=None, timeout_ms=None,
-                    retries=None, _solo=False):
+                    retries=None, priority=None, _solo=False):
         """Enqueue a request; returns an :class:`InferenceFuture`.
 
         ``inputs`` maps input name -> array; a single-input model also
@@ -354,6 +710,11 @@ class ModelServer:
         (1..max_batch rows) or be a single sample (the batch axis is
         added).  Raises ``QueueFull``/``BadRequest``/``ModelNotFound``
         synchronously — a rejected request was never enqueued.
+
+        ``priority`` (default ``MXNET_SERVING_DEFAULT_PRIORITY``): SLO
+        class 0..MXNET_SERVING_PRIORITY_CLASSES-1, 0 most important.
+        Under brownout the lowest classes are shed first — batch
+        composition and result delivery are otherwise identical.
 
         ``retries`` (default ``MXNET_SERVING_SUBMIT_RETRIES``, 0 = off):
         re-submit after ``QueueFull`` up to this many times, sleeping
@@ -368,28 +729,35 @@ class ModelServer:
             try:
                 return self._submit_async(name, inputs, version=version,
                                           timeout_ms=timeout_ms,
-                                          _solo=_solo)
+                                          priority=priority, _solo=_solo)
             except QueueFull as exc:
                 if attempt >= budget:
                     raise
-                self._req_inc("retried")
+                self._req_inc("retried", model=name)
                 self._submit_backoff.sleep_for(
                     attempt, floor_s=exc.retry_after_s or 0.0)
                 attempt += 1
 
-    def _retry_after_s(self, depth=None):
+    def _retry_after_s(self, model=None, depth=None):
         """Server-side backoff hint: seconds until the CURRENT backlog
         plausibly clears — queued batches ahead times the recent
         request service time (median submit-to-result, which includes
         queue wait, so the estimate errs long — an honest hint for a
-        shedding server), floored at one batch window.  An estimate,
-        not a promise: the client adds jitter and bounds its own
-        retries."""
+        shedding server), floored at one batch window.  With ``model``
+        the history AND the backlog are that model's own — a slow
+        tenant's service times must not inflate every tenant's backoff.
+        An estimate, not a promise: the client adds jitter and bounds
+        its own retries."""
         if depth is None:
             with self._cv:
-                depth = len(self._queue)
+                depth = (self._depths.get(model, 0) if model is not None
+                         else len(self._queue))
         with self._mlock:
-            lats = self._latencies[-32:]
+            if model is not None:
+                lats = list(self._latencies.get(model, ()))[-32:]
+            else:            # cross-model view: flatten recent history
+                lats = [v for hist in self._latencies.values()
+                        for v in hist[-8:]]
         per_batch_s = (float(np.median(lats)) / 1000.0 if lats
                        else self._batch_wait_ms / 1000.0)
         batches_ahead = 1 + depth // max(1, self._max_batch)
@@ -397,8 +765,17 @@ class ModelServer:
         return min(max(batches_ahead * per_batch_s, floor, 0.001), 60.0)
 
     def _submit_async(self, name, inputs, version=None, timeout_ms=None,
-                      _solo=False):
+                      priority=None, _solo=False):
         entry = self.registry.get(name, version)
+        if version is None and not _solo:
+            entry = self._canary_route(name, entry)
+        priority = self._default_priority if priority is None \
+            else int(priority)
+        if not 0 <= priority < self._priority_classes:
+            raise BadRequest(
+                "priority class %d outside 0..%d "
+                "(MXNET_SERVING_PRIORITY_CLASSES)"
+                % (priority, self._priority_classes - 1))
         if not isinstance(inputs, dict):
             if len(entry.input_names) != 1:
                 raise BadRequest(
@@ -437,32 +814,71 @@ class ModelServer:
         timeout = self._default_timeout_ms if timeout_ms is None \
             else float(timeout_ms)
         now = _now_ms()
-        fut = InferenceFuture(now + timeout, hint=self._retry_after_s)
-        req = _Request(entry, arrs, rows, fut, now, solo=_solo)
-        rejected_depth = None
+        name = entry.name
+        fut = InferenceFuture(now + timeout,
+                              hint=lambda: self._retry_after_s(name))
+        req = _Request(entry, arrs, rows, fut, now, solo=_solo,
+                       priority=priority)
+        reject = None          # (shed?, message, depth for the hint)
         with self._cv:
             if self._stopping:
                 raise ServerClosed("server is stopping")
+            # warmup solo dummies are operator actions, not tenant
+            # traffic: they bypass the per-model quotas (a full tenant
+            # queue must not block warming that tenant's executors) —
+            # the global depth bound still applies
+            quota = self._quota_for_locked(name) if not _solo \
+                else {"queue_depth": 0, "inflight": 0}
+            mdepth = self._depths.get(name, 0)
             if len(self._queue) >= self._queue_depth:
-                rejected_depth = len(self._queue)
-            else:
+                reject = (False, "serving queue at capacity (%d "
+                          "requests); retry later" % self._queue_depth,
+                          len(self._queue))
+            elif quota["queue_depth"] and mdepth >= quota["queue_depth"]:
+                reject = (False, "model %r queue quota at capacity "
+                          "(%d requests); other models are unaffected "
+                          "— retry later" % (name, quota["queue_depth"]),
+                          mdepth)
+            elif quota["inflight"]:
+                with self._mlock:
+                    infl = self._inflight.get(name, 0)
+                if infl >= quota["inflight"]:
+                    reject = (False, "model %r in-flight quota at "
+                              "capacity (%d unresolved requests); "
+                              "retry later" % (name, quota["inflight"]),
+                              mdepth)
+            if reject is None and self._brownout and not _solo \
+                    and priority >= self._brownout_reject_class:
+                reject = (True, "brownout: shedding priority class %d "
+                          "(queue above the high watermark); retry "
+                          "later" % priority, mdepth)
+            if reject is None:
                 self._queue.append(req)
+                self._depths[name] = mdepth + 1
+                with self._mlock:
+                    self._inflight[name] = self._inflight.get(name, 0) + 1
                 depth = len(self._queue)
+                self._update_brownout_locked()
                 self._cv.notify_all()
-        if rejected_depth is not None:
+        if reject is not None:
+            shed, msg, hint_depth = reject
             # hint computed OUTSIDE _cv (it takes _mlock; keep the lock
             # graph one-directional)
-            self._req_inc("rejected_queue_full")
+            self._req_inc("rejected_queue_full", model=name)
+            if shed:
+                self._shed_inc(name, priority, "brownout_reject")
             raise QueueFull(
-                "serving queue at capacity (%d requests); retry "
-                "later" % self._queue_depth,
-                retry_after_s=self._retry_after_s(rejected_depth))
-        self._req_inc("submitted")
+                msg, retry_after_s=self._retry_after_s(
+                    name, depth=hint_depth))
+        self._req_inc("submitted", model=name)
         with self._mlock:
             if depth > self._queue_peak:
                 self._queue_peak = depth
+            if mdepth + 1 > self._model_queue_peak.get(name, 0):
+                self._model_queue_peak[name] = mdepth + 1
         self._q_counter.set_value(depth)
         self._t_queue_depth.set(depth)
+        self._t_queue_depth.labels(model=name).set(mdepth + 1)
         return fut
 
     def warmup(self, name=None, version=None, buckets=None,
@@ -627,15 +1043,17 @@ class ModelServer:
                 return
             reqs, entry, bucket = batch
 
-            def deliver(exc, _reqs=reqs):
+            def deliver(exc, _reqs=reqs, _entry=entry):
                 got, gone = 0, 0
                 for r in _reqs:
                     if r.future._set_exception(exc):
                         got += 1
                     else:
                         gone += 1       # client already cancelled
-                self._req_inc("failed", got)
-                self._req_inc("expired", gone)
+                self._req_inc("failed", got, model=_entry.name)
+                self._req_inc("expired", gone, model=_entry.name)
+                if self._canaries:
+                    self._canary_observe(_entry, failed=got + gone)
                 return got > 0
 
             with engine.worker_scope(deliver):
@@ -646,6 +1064,8 @@ class ModelServer:
                     _fault.fire("serving.worker", model=entry.name,
                                 bucket=bucket)
                 self._execute(reqs, entry, bucket)
+            if self._canaries:
+                self.tick_canaries()
 
     def _collect_batch(self):
         with self._cv:
@@ -653,66 +1073,195 @@ class ModelServer:
                 if self._stopping and not self._drain:
                     return None     # stop() fails the remaining queue
                 self._prune_locked()
-                if self._queue:
-                    head = self._queue[0]
+                self._update_brownout_locked()
+                head = self._next_head_locked()
+                if head is not None:
+                    rows_cap = self._rows_cap_locked(head)
                     window = head.t_submit + self._batch_wait_ms - _now_ms()
                     if (not head.solo and not self._stopping and
-                            window > 0 and
+                            not self._brownout and window > 0 and
                             self._rows_queued_locked(head.gkey)
-                            < self._max_batch):
+                            < rows_cap):
                         # hold the head open for co-batchable arrivals
+                        # (brownout dispatches immediately: under
+                        # pressure, latency beats fill)
                         self._cv.wait(window / 1000.0)
                         continue
-                    return self._pop_batch_locked(head)
+                    return self._pop_batch_locked(head, rows_cap)
                 if self._stopping:
                     return None
                 self._cv.wait(0.1)
 
+    def _next_head_locked(self):
+        """Fair scheduling: round-robin over the MODELS with queued
+        work (strict FIFO lets one tenant's deep backlog starve
+        everyone else's shallow one), then the highest-priority oldest
+        request of the chosen model."""
+        if not self._queue:
+            return None
+        names = sorted({r.entry.name for r in self._queue})
+        chosen = next((n for n in names if n > self._rr_last), names[0])
+        self._rr_last = chosen
+        return min((r for r in self._queue if r.entry.name == chosen),
+                   key=lambda r: (r.priority, r.t_submit))
+
+    def _rows_cap_locked(self, head):
+        """Coalescing cap for this dispatch: the ladder max, shrunk to
+        MXNET_SERVING_BROWNOUT_MAX_BATCH during brownout (smaller
+        programs turn the queue over faster when the server is
+        saturated).  A single oversized request still dispatches at
+        its own size — requests are never split."""
+        cap = self._max_batch
+        if self._brownout and self._brownout_max_batch > 0:
+            cap = min(cap, self._brownout_max_batch)
+        return max(cap, head.rows)
+
+    def _exec_estimates_ms(self):
+        """Per-model batch-execute estimates for the doomed test —
+        medians CACHED by ``_execute`` when a sample lands (the prune
+        path runs under ``_cv`` on every batcher wakeup; recomputing
+        np.median there would tax every submitting client).  No
+        history -> no estimate -> never doomed (cold start must not
+        shed)."""
+        with self._mlock:
+            return dict(self._exec_est)
+
     def _prune_locked(self):
-        """Drop cancelled/expired requests before they cost a dispatch."""
+        """Drop cancelled/expired requests before they cost a dispatch,
+        and — under brownout — SHED already-doomed ones: a queued
+        request whose remaining deadline is under its model's measured
+        execute time can only expire AFTER spending accelerator rows,
+        so shedding it helps every request behind it.  Scoped to
+        brownout because the estimate is a whole-batch median: at low
+        load a small request would ride a much cheaper dispatch than
+        the median batch, and mis-shedding meetable work is worse than
+        letting the deadline machinery handle it."""
         now = _now_ms()
-        keep = []
+        est = (self._exec_estimates_ms()
+               if self._queue and self._brownout else {})
+        keep, removed = [], []
         for r in self._queue:
+            name = r.entry.name
             if r.future.cancelled():
-                self._req_inc("expired")
+                self._req_inc("expired", model=name)
+                removed.append(r)
                 continue
             if r.future._expired(now):
                 r.future._set_exception(DeadlineExceeded(
                     "deadline passed while queued",
-                    retry_after_s=self._retry_after_s(len(self._queue))))
-                self._req_inc("expired")
+                    retry_after_s=self._retry_after_s(
+                        name, depth=self._depths.get(name, 0))))
+                self._req_inc("expired", model=name)
+                removed.append(r)
+                continue
+            doom = est.get(name)
+            if doom is not None and not r.solo \
+                    and (r.future._deadline - now) < doom:
+                r.future._set_exception(DeadlineExceeded(
+                    "shed: deadline unmeetable (%.0f ms left, model "
+                    "executes in ~%.0f ms)"
+                    % (r.future._deadline - now, doom),
+                    retry_after_s=self._retry_after_s(
+                        name, depth=self._depths.get(name, 0))))
+                self._req_inc("shed", model=name)
+                self._shed_inc(name, r.priority, "doomed")
+                removed.append(r)
                 continue
             keep.append(r)
-        if len(keep) != len(self._queue):
+        if removed:
             self._queue[:] = keep
+            self._note_removed_locked(removed)
+
+    def _update_brownout_locked(self):
+        """Hysteresis watermarks over the global queue depth; entering
+        brownout additionally sheds queued requests of the reject
+        classes (newest first — they would be rejected at submit now
+        anyway, and the oldest accepted work has waited longest)."""
+        depth = len(self._queue)
+        if not self._brownout and depth >= self._brownout_high:
+            self._brownout = True
+            self._brownout_entered += 1
+            self._t_brownout.set(1)
+            telemetry.counter(
+                "mxnet_serving_brownout_transitions_total",
+                "brownout mode entries/exits by direction"
+            ).labels(dir="enter").inc()
+        elif self._brownout and depth <= self._brownout_low:
+            self._brownout = False
+            self._t_brownout.set(0)
+            telemetry.counter(
+                "mxnet_serving_brownout_transitions_total",
+                "brownout mode entries/exits by direction"
+            ).labels(dir="exit").inc()
+        if not self._brownout or depth <= self._brownout_high:
+            return
+        sheddable = sorted(
+            (r for r in self._queue
+             if not r.solo and r.priority >= self._brownout_reject_class),
+            key=lambda r: -r.t_submit)
+        removed = []
+        for r in sheddable:
+            if len(self._queue) - len(removed) <= self._brownout_high:
+                break
+            name = r.entry.name
+            # DeadlineExceeded, not QueueFull: this request WAS
+            # accepted (QueueFull's contract is "never enqueued", and
+            # the submit-retry loop could never catch an exception
+            # raised from result()) — like a doomed shed, the request
+            # is gone and the hint prices a FRESH submission
+            r.future._set_exception(DeadlineExceeded(
+                "brownout: shed from queue (priority class %d)"
+                % r.priority,
+                retry_after_s=self._retry_after_s(
+                    name, depth=self._depths.get(name, 0))))
+            self._req_inc("shed", model=name)
+            self._shed_inc(name, r.priority, "brownout_queue")
+            removed.append(r)
+        if removed:
+            gone = {id(r) for r in removed}
+            self._queue[:] = [r for r in self._queue if id(r) not in gone]
+            self._note_removed_locked(removed)
+
+    def _note_removed_locked(self, reqs):
+        """Queue-depth bookkeeping for every removal path."""
+        for r in reqs:
+            name = r.entry.name
+            left = self._depths.get(name, 0) - 1
+            if left > 0:
+                self._depths[name] = left
+            else:
+                self._depths.pop(name, None)
+            self._t_queue_depth.labels(model=name).set(max(0, left))
+        self._q_counter.set_value(len(self._queue))
+        self._t_queue_depth.set(len(self._queue))
 
     def _rows_queued_locked(self, gkey):
         return sum(r.rows for r in self._queue if r.gkey == gkey)
 
-    def _pop_batch_locked(self, head):
+    def _pop_batch_locked(self, head, rows_cap):
         if head.solo:            # exactly this request, exactly its bucket
             self._queue.remove(head)
-            self._q_counter.set_value(len(self._queue))
-            self._t_queue_depth.set(len(self._queue))
+            self._note_removed_locked([head])
             return [head], head.entry, pick_bucket(head.rows, self._buckets)
+        cands = sorted(
+            (r for r in self._queue if not r.solo and r.gkey == head.gkey),
+            key=lambda r: (r.priority, r.t_submit))
         taken, rows = [], 0
-        rest = []
-        for r in self._queue:
-            if (not r.solo and r.gkey == head.gkey
-                    and rows + r.rows <= self._max_batch):
+        for r in cands:
+            if rows + r.rows <= rows_cap:
                 taken.append(r)
                 rows += r.rows
-            else:
-                rest.append(r)
-        self._queue[:] = rest
-        self._q_counter.set_value(len(rest))
-        self._t_queue_depth.set(len(rest))
+        gone = {id(r) for r in taken}
+        self._queue[:] = [r for r in self._queue if id(r) not in gone]
+        self._note_removed_locked(taken)
         return taken, head.entry, pick_bucket(rows, self._buckets)
 
     def _execute(self, reqs, entry, bucket):
         rows_total = sum(r.rows for r in reqs)
-        span_args = {"model": entry.name, "version": entry.version,
+        name = entry.name
+        span_args = {"model": name, "version": entry.version,
                      "bucket": bucket, "rows": rows_total}
+        t_exec0 = _now_ms()
         with profiler.scope("serving:batch", cat="serving", args=span_args):
             pred = self.cache.get(entry, bucket)
             feed = {}
@@ -721,27 +1270,75 @@ class ModelServer:
             pred.forward(**feed)
             outs = [pred.get_output(i).asnumpy()
                     for i in range(entry.num_outputs)]
+        if _fault.ACTIVE[0] and self._is_canary_version(name,
+                                                       entry.version):
+            # graftfault: the poisoned-canary site — kind=nan corrupts
+            # this batch's outputs in place (a silently-bad checkpoint),
+            # kind=raise fails the batch (an erroring one); the health
+            # gate below must catch either within its budget.  asnumpy
+            # views of device buffers are read-only, so hand the plan
+            # writable copies (canary batches under an armed plan only)
+            outs = [o.copy() if getattr(o, "flags", None) is not None
+                    and not o.flags.writeable else o for o in outs]
+            _fault.fire("serving.canary.execute", model=name,
+                        version=entry.version, arrays=outs)
         t_done = _now_ms()
+        # the non-finite sentinel runs BEFORE delivery: a client
+        # unblocked by a poisoned result could submit its next request
+        # ahead of the rollback and have it routed to — and rebind —
+        # the doomed version; deciding first closes that window for
+        # serial clients (concurrent already-routed requests still
+        # execute on their held entry, which is correct but costs a
+        # lazy rebind)
+        is_canary = self._canaries and \
+            self._is_canary_version(name, entry.version)
+        if is_canary:
+            nonfinite = any(not np.isfinite(o).all() for o in outs
+                            if getattr(o, "dtype", None) is not None
+                            and o.dtype.kind == "f")
+            if nonfinite:
+                self._canary_observe(entry, nonfinite=True)
+        served_lats = []
         off = 0
         for r in reqs:
             sl = [o[off:off + r.rows] for o in outs]
             off += r.rows
             if r.future._set_result(sl):
                 lat = t_done - r.t_submit
-                self._req_inc("served")
+                self._req_inc("served", model=name)
                 self._t_latency.observe(lat)
+                served_lats.append(lat)
                 with self._mlock:
-                    self._latencies.append(lat)
-                    if len(self._latencies) > self._lat_cap:
-                        del self._latencies[:-self._lat_cap]
+                    hist = self._latencies.setdefault(name, [])
+                    hist.append(lat)
+                    if len(hist) > self._lat_cap:
+                        del hist[:-self._lat_cap]
             else:
-                self._req_inc("expired")
+                self._req_inc("expired", model=name)
         with self._mlock:
             h = self._batch_hist.setdefault(bucket, [0, 0])
             h[0] += 1
             h[1] += rows_total
+            eh = self._exec_ms.setdefault(name, [])
+            eh.append(t_done - t_exec0)
+            if len(eh) > 256:
+                del eh[:-256]
+            self._exec_est[name] = float(np.median(eh[-32:]))
         self._t_batches.labels(bucket=bucket).inc()
         self._t_batch_rows.labels(bucket=bucket).inc(rows_total)
+        # unlocked emptiness probe: no live canary (the overwhelming
+        # steady state) costs one dict truthiness check, no isfinite
+        # sweep and no lock.  The sentinel already ran pre-delivery;
+        # this records serve counts + latencies for the rate/p99 gates.
+        if self._canaries:
+            self._canary_observe(entry, served=len(served_lats),
+                                 latencies=served_lats)
+
+    def _is_canary_version(self, name, version):
+        with self._canary_lock:
+            st = self._canaries.get(name)
+            return (st is not None and st.decision is None
+                    and version == st.canary_version)
 
     # -- observability ------------------------------------------------------
     def plan_spec(self):
@@ -767,15 +1364,33 @@ class ModelServer:
         ``telemetry.snapshot()`` and the Prometheus exposition."""
         with self._cv:
             depth = len(self._queue)
+            depths = dict(self._depths)
+            brownout = {"active": self._brownout,
+                        "entered": self._brownout_entered,
+                        "high_watermark": self._brownout_high,
+                        "low_watermark": self._brownout_low,
+                        "max_batch": (self._brownout_max_batch
+                                      or self._max_batch),
+                        "reject_class": self._brownout_reject_class}
+            quotas = {n: dict(q) for n, q in self._model_quotas.items()}
         with self._mlock:
-            lats = list(self._latencies)
+            all_lats = {n: list(h) for n, h in self._latencies.items()}
             peak = self._queue_peak
+            model_peaks = dict(self._model_queue_peak)
             req = dict(self._req_counts)
+            per_req = {n: dict(c) for n, c in self._model_req.items()}
+            inflight = dict(self._inflight)
+            sheds = dict(self._shed_counts)
             hist = {b: tuple(nr) for b, nr in self._batch_hist.items()}
+        lats = [v for h in all_lats.values() for v in h]
         occupancy = {
             b: {"batches": n, "rows": r,
                 "fill": round(r / float(n * b), 4)}
             for b, (n, r) in sorted(hist.items())}
+
+        def _pct(vals, q):
+            return round(float(np.percentile(vals, q)), 3) if vals else None
+
         snap = {
             "queue": {"depth": depth, "peak": peak,
                       "limit": self._queue_depth},
@@ -785,17 +1400,49 @@ class ModelServer:
                 "failed": req["failed"],
                 "rejected_queue_full": req["rejected_queue_full"],
                 "expired": req["expired"],
-                "retried": req["retried"]},
+                "retried": req["retried"],
+                "shed": req["shed"]},
             "batches": {"count": sum(n for n, _r in hist.values()),
                         "rows": sum(r for _n, r in hist.values()),
                         "occupancy": occupancy},
             "buckets": list(self._buckets),
+            "brownout": brownout,
         }
         snap["latency_ms"] = {
             "count": len(lats),
-            "p50": round(float(np.percentile(lats, 50)), 3) if lats else None,
-            "p99": round(float(np.percentile(lats, 99)), 3) if lats else None,
+            "p50": _pct(lats, 50),
+            "p99": _pct(lats, 99),
         }
+        # per-model sections: one row per tenant this server has seen,
+        # self-contained enough to debug a single tenant's complaint
+        # without grepping the shared series
+        shed_rows = {}
+        for (n, cls, reason), c in sorted(sheds.items()):
+            shed_rows.setdefault(n, []).append(
+                {"class": cls, "reason": reason, "count": c})
+        canaries = self.canary_status()
+        names = (set(per_req) | set(depths) | set(quotas)
+                 | set(all_lats) | set(shed_rows))
+        per_model = {}
+        for n in sorted(names):
+            mh = all_lats.get(n, [])
+            per_model[n] = {
+                "requests": per_req.get(
+                    n, dict.fromkeys(self._req_counts, 0)),
+                "queue_depth": depths.get(n, 0),
+                "queue_peak": model_peaks.get(n, 0),
+                "inflight": inflight.get(n, 0),
+                "quota": quotas.get(n),
+                "sheds": shed_rows.get(n, []),
+                "latency_ms": {"count": len(mh), "p50": _pct(mh, 50),
+                               "p99": _pct(mh, 99)},
+                "retry_after_s": round(
+                    self._retry_after_s(n, depth=depths.get(n, 0)), 4),
+                "canary": canaries["live"].get(n),
+            }
+        snap["per_model"] = per_model
+        snap["sheds_total"] = sum(sheds.values())
+        snap["canaries"] = canaries
         snap["executor_cache"] = self.cache.stats()
         from .. import compile_cache
         # cheap form: counters + last-sweep sizes, no directory walk —

@@ -84,6 +84,46 @@ def test_glob_sites_and_step_addressing():
             hooks.fire("elastic.step")
 
 
+def test_where_scopes_rules_to_ctx():
+    """``where`` filters by the site's ctx kwargs (fnmatch): the
+    multi-tenant form — tenantA's hits fire, tenantB's pass through,
+    and a ctx key the site never publishes never matches."""
+    plan = FaultPlan({"rules": [
+        {"site": "serving.*", "kind": "raise", "times": 0,
+         "where": {"model": "tenantA"}},
+        {"site": "other", "kind": "raise", "times": 0,
+         "where": {"never_published": "*"}},
+    ]})
+    with pytest.raises(FaultInjected):
+        plan.fire("serving.cache.get", model="tenantA")
+    plan.fire("serving.cache.get", model="tenantB")   # scoped out
+    plan.fire("serving.cache.get")                    # no ctx: no match
+    plan.fire("other", model="x")                     # key absent: never
+    with pytest.raises(FaultInjected):
+        plan.fire("serving.worker", model="tenantA", bucket=4)
+    assert plan.injected_count() == 2
+    # where patterns are fnmatch, like sites
+    glob = FaultPlan({"rules": [{"site": "s", "kind": "raise",
+                                 "times": 0, "where": {"model": "ten*"}}]})
+    with pytest.raises(FaultInjected):
+        glob.fire("s", model="tenantZ")
+    glob.fire("s", model="other")
+    with pytest.raises(ValueError, match="where"):
+        FaultPlan({"rules": [{"site": "s", "where": "tenantA"}]})
+
+
+def test_nan_kind_corrupts_float_arrays_only():
+    plan = FaultPlan({"rules": [{"site": "out", "kind": "nan",
+                                 "times": 0}]})
+    f = np.ones((2, 3), np.float32)
+    i = np.ones((2,), np.int64)
+    plan.fire("out", arrays=[f, i])
+    assert np.isnan(f).all(), "float payload must be NaN-corrupted"
+    assert (i == 1).all(), "non-float payload must be untouched"
+    plan.fire("out")              # no arrays ctx: still a clean no-op
+    assert plan.injected_count(kind="nan") == 2
+
+
 def test_seeded_probabilistic_schedule_is_reproducible():
     spec = {"seed": 3, "rules": [{"site": "s", "kind": "raise",
                                   "p": 0.3, "times": 0}]}
