@@ -2,6 +2,7 @@
 checker (see ``docs/faq/static_analysis.md`` for how to add one)."""
 from . import c_api_contract     # noqa: F401
 from . import env_knobs          # noqa: F401
+from . import fault_sites        # noqa: F401
 from . import global_mutation    # noqa: F401
 from . import host_sync          # noqa: F401
 from . import ir_rules           # noqa: F401

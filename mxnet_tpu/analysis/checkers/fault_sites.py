@@ -1,0 +1,153 @@
+"""fault-site-drift — the ``fault.hooks`` site names fired in code and
+the injection-site catalog in ``docs/faq/fault_tolerance.md`` must
+agree, both directions:
+
+- a ``fire("some.site")`` whose site is not cataloged means a drill
+  author cannot discover it — flagged at the fire site;
+- a cataloged site fired nowhere means the docs describe a seam that
+  no longer exists (renamed or deleted) — flagged once, anchored on
+  ``mxnet_tpu/fault/hooks.py`` (the hook surface the catalog
+  documents).
+
+Site names are collected from the AST (docstring examples are string
+constants, not calls, so they are naturally excluded).  A computed
+site of the form ``"prefix." + var`` (the ``kvstore.push``/
+``kvstore.pull`` instrumentation decorator) is treated as the prefix
+pattern ``prefix.*``: it satisfies every cataloged site it covers, and
+the catalog must hold at least one such site for the fire to count as
+documented.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from ..core import Checker, Finding, register
+
+__all__ = ["FaultSiteChecker", "fired_sites", "documented_sites"]
+
+_CATALOG_RE = re.compile(
+    r"###\s*Injection-site catalog\s*\n(.*?)(?:\n#|\Z)", re.S)
+_TOKEN_RE = re.compile(r"`([^`\s]+)`")
+
+
+def documented_sites(doc_path):
+    """Site names from the catalog table's first column: every
+    backticked dotted token (one row may list several, e.g. the
+    ``kvstore.push`` / ``kvstore.pull`` pair)."""
+    with open(doc_path, encoding="utf-8") as f:
+        text = f.read()
+    m = _CATALOG_RE.search(text)
+    if not m:
+        return set()
+    sites = set()
+    for line in m.group(1).splitlines():
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 2:
+            continue
+        first = cells[1]
+        if set(first.strip()) <= {"-"}:
+            continue   # the |---|---| separator row
+        for tok in _TOKEN_RE.findall(first):
+            if "." in tok:
+                sites.add(tok)
+    return sites
+
+
+def _site_of(call):
+    """The site pattern of one ``*.fire(...)`` call: a literal name, a
+    ``"prefix." + var`` prefix pattern (``prefix.*``), or None."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value if "." in arg.value else None
+    if (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+            and isinstance(arg.left, ast.Constant)
+            and isinstance(arg.left.value, str)
+            and arg.left.value.endswith(".")):
+        return arg.left.value + "*"
+    return None
+
+
+def fired_sites(root):
+    """``{pattern: (relpath, line)}`` of every fault-site fire in the
+    package (first occurrence wins)."""
+    from ..core import iter_source_files
+    out = {}
+    for path in iter_source_files([os.path.join(root, "mxnet_tpu")]):
+        if not path.endswith(".py"):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        try:
+            tree = ast.parse(text)
+        except SyntaxError:
+            continue
+        rel = os.path.relpath(path, root).replace("\\", "/")
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "fire"):
+                continue
+            site = _site_of(node)
+            if site is not None and site not in out:
+                out[site] = (rel, node.lineno)
+    return out
+
+
+@register
+class FaultSiteChecker(Checker):
+    rule = "fault-site-drift"
+    severity = "error"
+    suffixes = (".py",)
+
+    def _tables(self, ctx):
+        key = "fault-site-tables"
+        if key not in ctx.memo:
+            doc = os.path.join(ctx.root, "docs", "faq",
+                               "fault_tolerance.md")
+            ctx.memo[key] = (
+                fired_sites(ctx.root),
+                documented_sites(doc) if os.path.exists(doc) else set())
+        return ctx.memo[key]
+
+    def check(self, path, relpath, text, tree, ctx):
+        if tree is None:
+            return []
+        fired, documented = self._tables(ctx)
+        rel = relpath.replace("\\", "/")
+        out = []
+        # code -> docs: every fire in THIS file must be cataloged
+        for pattern, (where, line) in sorted(fired.items()):
+            if where != rel:
+                continue
+            if pattern.endswith("*"):
+                covered = any(d.startswith(pattern[:-1])
+                              for d in documented)
+            else:
+                covered = pattern in documented
+            if not covered:
+                out.append(Finding(
+                    self.rule, self.severity, relpath, line,
+                    "fault site %r is fired here but missing from the "
+                    "docs/faq/fault_tolerance.md injection-site "
+                    "catalog" % pattern, symbol="fire"))
+        # docs -> code: anchored once, on the hook surface the catalog
+        # documents
+        if rel.endswith("mxnet_tpu/fault/hooks.py"):
+            literals = {p for p in fired if not p.endswith("*")}
+            prefixes = [p[:-1] for p in fired if p.endswith("*")]
+            for d in sorted(documented):
+                if d in literals or any(d.startswith(px)
+                                        for px in prefixes):
+                    continue
+                out.append(Finding(
+                    self.rule, self.severity, relpath, 1,
+                    "cataloged injection site %r is fired nowhere in "
+                    "the package — stale docs or a renamed site" % d,
+                    symbol="fire"))
+        return out

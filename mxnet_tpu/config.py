@@ -386,6 +386,30 @@ register_env("MXNET_SERVING_CANARY_TIMEOUT_S", float, 600.0,
              "min_requests within this window is decided on whatever "
              "evidence exists (healthy -> promote, zero traffic -> "
              "rollback)")
+register_env("MXNET_TRANSPORT_SEND_RETRIES", int, 4,
+             "at-least-once resend budget of "
+             "SpoolTransport.send_reliable (parallel/transport.py): "
+             "link faults (partition, lost ack) are retried this many "
+             "times on the shared BackoffPolicy, reusing one message "
+             "id so the receiver's dedup keeps delivery exactly-once")
+register_env("MXNET_TRANSPORT_POLL_S", float, 0.005,
+             "SpoolTransport receive poll interval: how often "
+             "recv_wait re-scans the inbox while empty")
+register_env("MXNET_FLEET_HEALTH_INTERVAL_S", float, 0.2,
+             "replica health-beat period: each fleet replica reports "
+             "its ledger/latency/non-finite evidence to the front "
+             "door this often (serving/fleet.py); the front door "
+             "treats a replica silent for several periods as dead")
+register_env("MXNET_FLEET_PROBE_RETRIES", int, 5,
+             "re-admission probe budget for an ejected fleet replica: "
+             "the front door probes it on BackoffPolicy delays this "
+             "many times before declaring it dead for good")
+register_env("MXNET_FLEET_SUBMIT_RETRIES", int, 3,
+             "front-door resubmit budget per request: replica death, "
+             "link failure or remote QueueFull re-route the SAME "
+             "request id to another replica up to this many times "
+             "(honoring the remote retry_after_s hint); the ledger "
+             "dedups, so a client never sees a duplicate")
 register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,

@@ -21,14 +21,16 @@ from __future__ import annotations
 
 from . import hooks  # noqa: F401
 from .backoff import BackoffPolicy  # noqa: F401
-from .plan import (FaultInjected, FaultPlan, active_plan,  # noqa: F401
-                   install, installed, uninstall)
+from .plan import (FaultInjected, FaultPlan, Reorder,  # noqa: F401
+                   active_plan, install, installed, uninstall)
 
 __all__ = ["hooks", "BackoffPolicy", "FaultPlan", "FaultInjected",
-           "install", "uninstall", "installed", "active_plan",
-           "elastic", "ElasticError", "ElasticSupervisor", "run_elastic"]
+           "Reorder", "install", "uninstall", "installed", "active_plan",
+           "elastic", "ElasticError", "ElasticSupervisor",
+           "ProcessSupervisor", "run_elastic"]
 
-_LAZY = ("elastic", "ElasticError", "ElasticSupervisor", "run_elastic")
+_LAZY = ("elastic", "ElasticError", "ElasticSupervisor",
+         "ProcessSupervisor", "run_elastic")
 
 
 def __getattr__(name):

@@ -21,6 +21,14 @@ Jitter model: each delay is ``base * multiplier**attempt`` clamped to
 reconverge on the same retry instant (the thundering-herd the hint in
 ``QueueFull.retry_after_s`` would otherwise create).  The draw chain
 is ``random.Random(seed)``-owned, so tests assert exact sequences.
+
+Determinism under seeded plans: with no explicit ``seed`` the policy
+asks the armed :class:`~.plan.FaultPlan` for the next link of its
+per-policy chain (``"seed:backoff:N"``, the same idiom as the per-rule
+``p`` chains) — two replays of the same plan hand the Nth policy the
+same jitter stream, so a drill's retry timeline replays identically.
+No plan armed → seed 0, the historical default.  Global ``random`` is
+never consulted.
 """
 from __future__ import annotations
 
@@ -39,7 +47,7 @@ class BackoffPolicy:
     supervisor's rebuild-restore-retry cycle)."""
 
     def __init__(self, retries=None, base_s=None, max_s=None,
-                 multiplier=2.0, jitter=None, seed=0, sleep=time.sleep):
+                 multiplier=2.0, jitter=None, seed=None, sleep=time.sleep):
         from .. import config as _config
         if retries is None:
             retries = _config.get("MXNET_FAULT_RETRIES")
@@ -49,6 +57,12 @@ class BackoffPolicy:
             max_s = _config.get("MXNET_FAULT_BACKOFF_MAX_S")
         if jitter is None:
             jitter = _config.get("MXNET_FAULT_BACKOFF_JITTER")
+        if seed is None:
+            # the armed plan's per-policy chain (module docstring) —
+            # NEVER global random: replayed drills must re-draw the
+            # exact jitter sequence
+            from .plan import backoff_seed
+            seed = backoff_seed()
         self.retries = max(0, int(retries))
         self.base_s = float(base_s)
         self.max_s = float(max_s)

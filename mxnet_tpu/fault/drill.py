@@ -47,7 +47,8 @@ import tempfile
 import threading
 import time
 
-__all__ = ["elastic_kill_drill", "chaos_soak", "multitenant_soak"]
+__all__ = ["elastic_kill_drill", "chaos_soak", "multitenant_soak",
+           "fleet_network_soak", "kv_worker_main"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -186,6 +187,12 @@ def elastic_kill_drill(steps=12, kill_at=(4, 8), widths=(4, 2, 8),
             len(oracle), steps)
 
         # -- elastic chain: kill, shrink, kill, grow ------------------------
+        # the respawn loop is the RUNTIME's (fault.ProcessSupervisor),
+        # not the harness's: each SIGKILL death is classified as
+        # recoverable and the next attempt launches on the next width
+        # in the schedule — the survivor set resharding
+        from .backoff import BackoffPolicy
+        from .elastic import ProcessSupervisor
         ckpt = os.path.join(tmpdir, "ck-e")
         runs = [
             (widths[0], {"rules": [{"site": "elastic.step",
@@ -197,22 +204,30 @@ def elastic_kill_drill(steps=12, kill_at=(4, 8), widths=(4, 2, 8),
             (widths[2], None),
         ]
         logs = []
-        for i, (width, plan) in enumerate(runs):
-            log = os.path.join(tmpdir, "leg%d.jsonl" % i)
+
+        def launch(restart):
+            width, plan = runs[min(restart, len(runs) - 1)]
+            log = os.path.join(tmpdir, "leg%d.jsonl" % restart)
             logs.append(log)
             proc = _run_worker(width, steps, ckpt, log, plan=plan)
-            leg = {"width": width, "rc": proc.returncode,
-                   "killed": proc.returncode == -signal.SIGKILL,
-                   "steps_logged": sorted(_read_loss_log(log))}
-            report["legs"].append(leg)
+            report["legs"].append(
+                {"width": width, "rc": proc.returncode,
+                 "killed": proc.returncode == -signal.SIGKILL,
+                 "steps_logged": sorted(_read_loss_log(log))})
             if plan is not None:
                 assert proc.returncode == -signal.SIGKILL, \
                     "leg %d expected SIGKILL death, got rc=%s:\n%s" % (
-                        i, proc.returncode, proc.stderr[-2000:])
+                        restart, proc.returncode, proc.stderr[-2000:])
             else:
                 assert proc.returncode == 0, \
                     "final leg failed rc=%s:\n%s" % (proc.returncode,
                                                      proc.stderr[-2000:])
+            return proc.returncode
+
+        ProcessSupervisor(
+            retries=len(runs),
+            backoff=BackoffPolicy(retries=0, base_s=0.01, max_s=0.02,
+                                  jitter=0.0, seed=0)).run(launch)
 
         # -- invariants ------------------------------------------------------
         # stitch: later legs win on overlap, but overlapping steps must
@@ -275,7 +290,8 @@ def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
     import numpy as np
     import mxnet_tpu as mx
     from mxnet_tpu import fault, nd, sym
-    from mxnet_tpu.checkpoint import CheckpointManager, IntegrityError
+    from mxnet_tpu.checkpoint import (CheckpointError, CheckpointManager,
+                                      IntegrityError)
     from mxnet_tpu.serving.errors import ServingError
 
     own = tmpdir is None
@@ -322,9 +338,9 @@ def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
             commit_attempts[0] += 1
             try:
                 mgr.save_module(mod, epoch=i, block=True)
-            except OSError:
-                commit_attempts[1] += 1   # injected commit fault; next
-                # period retries — the drill point
+            except (OSError, CheckpointError):
+                commit_attempts[1] += 1   # injected commit/manifest
+                # fault; next period retries — the drill point
             stop.wait(0.15)
 
     def reader():
@@ -340,8 +356,9 @@ def chaos_soak(duration_s=8.0, clients=4, tmpdir=None):
                     mgr.store.read(steps_now[-1], verify=True)
                 except IntegrityError as exc:
                     integrity_failures.append(str(exc))
-                except (OSError, ValueError):
-                    pass   # injected transient weather
+                except (OSError, ValueError, CheckpointError):
+                    pass   # injected transient weather (a manifest
+                    # fault surfaces as CheckpointError, not OSError)
             stop.wait(0.05)
 
     def client(ci):
@@ -701,6 +718,491 @@ def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
 
 
 # ---------------------------------------------------------------------------
+# fleet network soak — serving + training under network-shaped faults
+# ---------------------------------------------------------------------------
+
+# the MAIN process's weather (traced: the replay witness is asserted on
+# this plan).  Sites: the fleet front door's transport (requests out,
+# results in), the dist_async coordinator's arrivals, the checkpoint
+# store.
+FLEET_SOAK_PLAN = {
+    "seed": 23,
+    "rules": [
+        # request link weather: drops, delays, lost acks, reordering —
+        # send_reliable + receiver dedup must keep every request
+        # exactly-once regardless
+        {"site": "transport.send", "kind": "partition", "p": 0.03,
+         "times": 0, "where": {"kind": "infer"}},
+        {"site": "transport.send", "kind": "slow_link",
+         "delay_s": 0.002, "p": 0.12, "times": 0},
+        {"site": "transport.send.ack", "kind": "lost_ack", "p": 0.06,
+         "times": 0},
+        {"site": "transport.recv", "kind": "reorder", "p": 0.06,
+         "times": 0},
+        {"site": "transport.recv", "kind": "slow_link",
+         "delay_s": 0.001, "p": 0.08, "times": 0},
+        # gradient arrivals at the dist_async coordinator ride the same
+        # seam: a receive-side partition leaves them spooled, not lost
+        {"site": "transport.recv", "kind": "partition", "p": 0.03,
+         "times": 0, "where": {"kind": "grad"}},
+        # checkpoint weather rides along (the PR 14 bars)
+        {"site": "checkpoint.store.commit", "kind": "io_error",
+         "p": 0.2, "times": 0},
+        {"site": "checkpoint.store.manifest_read", "kind": "io_error",
+         "p": 0.1, "times": 0},
+    ],
+}
+
+# the kv WORKER process's plan (shipped via MXNET_FAULT_PLAN): its push
+# link takes partitions / slow links / lost acks, and mid-run the plan
+# SIGKILLs the whole process at a push entry — the host-death move the
+# ProcessSupervisor must recover from without double-applying anything.
+KV_WORKER_PLAN = {
+    "seed": 31,
+    "rules": [
+        {"site": "transport.send", "kind": "partition", "p": 0.05,
+         "times": 0},
+        {"site": "transport.send", "kind": "slow_link",
+         "delay_s": 0.002, "p": 0.1, "times": 0},
+        {"site": "transport.send.ack", "kind": "lost_ack", "p": 0.1,
+         "times": 0},
+        {"site": "kvstore.push", "kind": "sigkill", "after": 12,
+         "times": 1},
+    ],
+}
+
+# each replica subprocess gets its own seeded weather on the RESULT
+# link: a lost ack there resends the result under one message id and
+# the front door's dedup must absorb it (duplicates_dropped, never a
+# double delivery)
+def _replica_plan(rank):
+    return {
+        "seed": 40 + rank,
+        "rules": [
+            {"site": "transport.send", "kind": "slow_link",
+             "delay_s": 0.002, "p": 0.05, "times": 0},
+            {"site": "transport.send.ack", "kind": "lost_ack",
+             "p": 0.05, "times": 0},
+        ],
+    }
+
+
+def _write_json(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _kv_report(acked, failed, final):
+    """The kv worker's progress record: persisted after EVERY push so a
+    SIGKILL loses at most the in-flight one, plus (on clean exit) the
+    child's own injection counts and replay witness."""
+    from .plan import installed
+    rec = {"acked": acked, "failed": failed, "final": final}
+    plan = installed()
+    if plan is not None:
+        injected = plan.stats()["injected"]
+        rec["injected"] = len(injected)
+        by_kind = {}
+        for i in injected:
+            by_kind[i["kind"]] = by_kind.get(i["kind"], 0) + 1
+        rec["by_kind"] = by_kind
+        if final:
+            rec["replay_identical"] = (plan.replay() == injected)
+    return rec
+
+
+def kv_worker_main(pushes, report_path):
+    """One dist_async training worker under an env-armed plan: push
+    unit gradients through the transport seam, persisting progress
+    after each push.  A failed push is counted and ABANDONED — a
+    re-push would mint a NEW message id, and if the original actually
+    landed (a ``lost_ack`` publishes before it raises) the coordinator
+    would apply the gradient twice."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kv.create("dist_async")
+    kv.init("w", nd.zeros((4,)))
+    acked = failed = 0
+    for _ in range(int(pushes)):
+        try:
+            kv.push("w", nd.array(np.ones((4,), np.float32)))
+            acked += 1
+        except MXNetError:
+            failed += 1
+        _write_json(report_path, _kv_report(acked, failed, False))
+    _write_json(report_path, _kv_report(acked, failed, True))
+    kv.close()
+    print("kv-worker: %d acked, %d failed of %d" % (acked, failed, pushes))
+
+
+def fleet_network_soak(duration_s=10.0, clients=4, replicas=3,
+                       kv_pushes=30, min_faults=200, tmpdir=None):
+    """The ISSUE 16 chaos-soak leg: network-shaped faults + host kills
+    over serving AND training concurrently.
+
+    - a :class:`~..serving.fleet.FleetFrontDoor` routes live client
+      traffic across ``replicas`` ModelServer PROCESSES; mid-soak one
+      replica is SIGKILLed — in-flight requests resubmit under their
+      original ids and the fleet ledger stays exactly-once (zero lost,
+      zero duplicated);
+    - a dist_async pair trains concurrently: a worker process pushes
+      gradients under :data:`KV_WORKER_PLAN`, which SIGKILLs it
+      mid-push; :class:`~.elastic.ProcessSupervisor` relaunches it and
+      the coordinator's dedup keeps every delivered gradient applied
+      exactly once (weight delta cross-checked);
+    - a checkpoint writer/reader pair runs under commit/manifest IO
+      faults: zero incomplete-checkpoint reads;
+    - the main plan is TRACED: the soak extends itself (bounded) until
+      ``min_faults`` total injections spanning all four network kinds,
+      then asserts ``plan.replay() == plan.stats()["injected"]`` — the
+      same plan + seed replays to the identical fault timeline.
+    """
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, nd
+    from mxnet_tpu.checkpoint import (CheckpointError, CheckpointManager,
+                                      IntegrityError)
+    from mxnet_tpu.serving.errors import ServingError
+    from mxnet_tpu.serving.fleet import FleetFrontDoor, spawn_replica
+    from .backoff import BackoffPolicy
+    from .elastic import ProcessSupervisor
+
+    own = tmpdir is None
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="graftfault-fleet-")
+    fleet_root = os.path.join(tmpdir, "fleet")
+    kv_root = os.path.join(tmpdir, "kv")
+    ckpt_dir = os.path.join(tmpdir, "ck")
+    os.makedirs(fleet_root, exist_ok=True)
+    os.makedirs(kv_root, exist_ok=True)
+
+    plan = fault.FaultPlan(FLEET_SOAK_PLAN, trace=True)
+
+    # -- the serving fleet: front door + N process replicas ------------------
+    world = replicas + 1
+    fd = FleetFrontDoor(fleet_root, world, request_timeout_s=5.0,
+                        health_interval_s=0.1)
+    handles = [fd.add_replica(
+        spawn_replica(fleet_root, r + 1, world, seed=0,
+                      fault_plan=_replica_plan(r + 1)))
+               for r in range(replicas)]
+
+    # -- the dist_async coordinator (training side) --------------------------
+    kv_env = {"MXNET_KVSTORE_ASYNC_DIR": kv_root,
+              "DMLC_WORKER_ID": "0", "DMLC_NUM_WORKER": "2"}
+    saved = {k: os.environ.get(k) for k in kv_env}
+    os.environ.update(kv_env)
+    try:
+        kv = mx.kv.create("dist_async")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    kv._set_updater(lambda i, g, w: w.__isub__(0.1 * g))
+    kv.init("w", nd.zeros((4,)))
+
+    kv_reports = [os.path.join(tmpdir, "kv-worker-%d.json" % i)
+                  for i in range(4)]
+    kv_rcs = []
+    kv_errors = []
+
+    def kv_done():
+        recs = [_read_json(p) for p in kv_reports]
+        return (sum(r.get("acked", 0) for r in recs),
+                sum(r.get("failed", 0) for r in recs))
+
+    def kv_launch(restart):
+        acked, failed = kv_done()
+        remaining = max(0, int(kv_pushes) - acked - failed)
+        if remaining == 0:
+            return 0
+        plan_spec = KV_WORKER_PLAN if restart == 0 else {
+            # the respawned incarnation keeps the link weather but not
+            # the kill — a fresh seed so its fault stream is its own
+            "seed": KV_WORKER_PLAN["seed"] + restart,
+            "rules": [r for r in KV_WORKER_PLAN["rules"]
+                      if r["kind"] != "sigkill"],
+        }
+        env = _worker_env(1, plan_spec)
+        env.update({"MXNET_KVSTORE_ASYNC_DIR": kv_root,
+                    "DMLC_WORKER_ID": "1", "DMLC_NUM_WORKER": "2"})
+        report = kv_reports[min(restart, len(kv_reports) - 1)]
+        proc = subprocess.run(
+            [sys.executable, "-u", "-m", "mxnet_tpu.fault.drill",
+             "--kv-worker", "--pushes", str(remaining),
+             "--report", report],
+            env=env, cwd=_REPO, capture_output=True, text=True,
+            timeout=240)
+        kv_rcs.append(proc.returncode)
+        if proc.returncode > 0:
+            raise AssertionError("kv worker failed deterministically "
+                                 "rc=%s:\n%s" % (proc.returncode,
+                                                 proc.stderr[-2000:]))
+        return proc.returncode
+
+    def kv_fleet():
+        try:
+            ProcessSupervisor(
+                retries=len(kv_reports),
+                backoff=BackoffPolicy(retries=0, base_s=0.01, max_s=0.02,
+                                      jitter=0.0, seed=1)).run(kv_launch)
+        except Exception as exc:   # re-raised on the main thread
+            kv_errors.append(exc)
+
+    # -- checkpoint writer/reader under IO weather ---------------------------
+    mod = _soak_module(seed=0)
+    mgr = CheckpointManager(directory=ckpt_dir, async_save=False,
+                            keep_last=4)
+    stop = threading.Event()
+    commit_attempts = [0, 0]
+    integrity_failures = []
+    reader_polls = [0]
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            commit_attempts[0] += 1
+            try:
+                mgr.save_module(mod, epoch=i, block=True)
+            except (OSError, CheckpointError):
+                # injected commit/manifest weather (a manifest fault can
+                # surface as CheckpointError via the post-save byte
+                # count); the next period retries
+                commit_attempts[1] += 1
+            stop.wait(0.15)
+
+    def reader():
+        while not stop.is_set():
+            steps_now = mgr.store.steps()
+            if steps_now:
+                reader_polls[0] += 1
+                try:
+                    mgr.store.read(steps_now[-1], verify=True)
+                except IntegrityError as exc:
+                    integrity_failures.append(str(exc))
+                except (OSError, ValueError, CheckpointError):
+                    pass   # injected transient weather (a manifest
+                    # fault surfaces as CheckpointError, not OSError)
+            stop.wait(0.05)
+
+    # -- serving clients -----------------------------------------------------
+    counts = {"submitted": 0, "served": 0, "typed_failures": 0}
+    counts_lock = threading.Lock()
+
+    def client(ci):
+        """Every ``fd.infer`` call terminates in exactly one outcome —
+        a result or a typed error (the front door's sliced wait bounds
+        it); a hang would show up as submitted > served + typed."""
+        crng = np.random.RandomState(300 + ci)
+        while not stop.is_set():
+            rows = 1 + int(crng.randint(0, 4))
+            with counts_lock:
+                counts["submitted"] += 1
+            try:
+                outs = fd.infer(
+                    "m", crng.randn(rows, 6).astype(np.float32))
+                assert outs[0].shape[0] == rows
+                with counts_lock:
+                    counts["served"] += 1
+            except ServingError:
+                with counts_lock:
+                    counts["typed_failures"] += 1
+
+    # warm OUTSIDE the plan window: replica subprocesses take seconds
+    # to import; the soak's traced weather starts once they answer
+    warm = np.zeros((1, 6), np.float32)
+    ready = 0
+    deadline = time.monotonic() + 180
+    while ready < replicas and time.monotonic() < deadline:
+        try:
+            fd.infer("m", warm)
+            ready += 1
+        except ServingError:
+            time.sleep(0.2)
+    assert ready >= replicas, \
+        "replica fleet never came up: %r" % (fd.replica_status(),)
+
+    threads = [threading.Thread(target=writer, daemon=True),
+               threading.Thread(target=reader, daemon=True)]
+    threads += [threading.Thread(target=client, args=(ci,), daemon=True)
+                for ci in range(clients)]
+    kv_thread = threading.Thread(target=kv_fleet, daemon=True)
+
+    t0 = time.monotonic()
+    killed_rid = None
+    try:
+        with fault.active_plan(plan):
+            for t in threads:
+                t.start()
+            kv_thread.start()
+            # a third in: SIGKILL one serving replica — the host-death
+            # move; its in-flight requests must resubmit, not vanish
+            time.sleep(duration_s / 3.0)
+            victim = handles[-1]
+            killed_rid = victim.rid
+            victim.kill()
+            time.sleep(duration_s - duration_s / 3.0)
+            # the ≥ min_faults bar self-extends (bounded): fault volume
+            # is traffic-dependent, the bar is not
+            hard_stop = t0 + max(duration_s * 6, 60.0)
+            while len(plan.stats()["injected"]) < min_faults \
+                    and time.monotonic() < hard_stop:
+                time.sleep(1.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            kv_thread.join(timeout=240)
+            # drain: the last grads may still be crossing the seam
+            # (resends waiting in the spool count too — the server
+            # thread scans, dedups, and drops them)
+            assert kv.wait_to_drain(timeout=60), "push spool never drained"
+            settle = time.monotonic() + 15
+            while kv._transport.stats()["received"] \
+                    > len(kv._applied_log) \
+                    and time.monotonic() < settle:
+                time.sleep(0.02)
+    finally:
+        stop.set()
+    wall = time.monotonic() - t0
+    if kv_errors:
+        raise kv_errors[0]
+    fd_stats = fd.stats()
+    fd.close()
+
+    # -- invariants ----------------------------------------------------------
+    # (1) serving exactly-once: every call resolved, fleet ledger
+    # conserved, late duplicates dropped not delivered
+    resolved = counts["served"] + counts["typed_failures"]
+    assert resolved == counts["submitted"], \
+        "lost requests: %d submitted, %d resolved" % (
+            counts["submitted"], resolved)
+    assert counts["served"] > 0, "fleet served nothing"
+    led = {k: fd_stats[k] for k in ("submitted", "served", "failed",
+                                    "expired", "resubmitted", "retried",
+                                    "duplicates_dropped", "ejections",
+                                    "readmissions")}
+    assert led["submitted"] == led["served"] + led["failed"] \
+        + led["expired"], "fleet ledger unbalanced: %s" % led
+    assert led["ejections"] >= 1, \
+        "the killed replica was never ejected: %s" % (
+            fd_stats["replicas"],)
+    # (2) training exactly-once: acked <= applied (a recorded ack WAS
+    # delivered) <= acked + failed (an exhausted push may still have
+    # landed once — never twice: dedup absorbs every resend)
+    acked, failed_pushes = kv_done()
+    applied = kv._transport.stats()["received"]
+    assert acked <= applied <= acked + failed_pushes, \
+        "gradient conservation violated: acked=%d applied=%d failed=%d" \
+        % (acked, applied, failed_pushes)
+    ids = [pf for _k, pf in kv._applied_log]
+    assert len(ids) == len(set(ids)), "a gradient applied twice"
+    got = nd.zeros((4,))
+    kv.pull("w", out=got)
+    assert np.allclose(got.asnumpy(), -0.1 * applied), \
+        "weight drift: %r after %d applies" % (got.asnumpy(), applied)
+    assert any(rc == -signal.SIGKILL for rc in kv_rcs), \
+        "the kv worker was never killed: rcs=%r" % (kv_rcs,)
+    assert kv_rcs[-1] == 0, "kv fleet never completed: %r" % (kv_rcs,)
+    # (3) checkpoints: no reader ever resolved an incomplete one
+    assert not integrity_failures, \
+        "INCOMPLETE checkpoint visible to a reader: %s" % \
+        integrity_failures[:3]
+    # (4) fault volume + coverage (main plan + the kv worker's own)
+    injected = plan.stats()["injected"]
+    by_kind = {}
+    for i in injected:
+        by_kind[i["kind"]] = by_kind.get(i["kind"], 0) + 1
+    kv_recs = [_read_json(p) for p in kv_reports]
+    for rec in kv_recs:
+        for k, v in (rec.get("by_kind") or {}).items():
+            by_kind[k] = by_kind.get(k, 0) + v
+    # the kv worker's injected sigkill cannot appear in its own report
+    # (the process dies AT the injection); its observable effect — the
+    # -SIGKILL exit the supervisor recovered from — is the count
+    by_kind["sigkill"] = by_kind.get("sigkill", 0) + sum(
+        1 for rc in kv_rcs if rc == -signal.SIGKILL)
+    total = sum(by_kind.values())
+    for kind in ("partition", "slow_link", "lost_ack", "reorder"):
+        assert by_kind.get(kind, 0) > 0, \
+            "network kind %r never injected: %s" % (kind, by_kind)
+    assert by_kind.get("sigkill", 0) >= 1, by_kind
+    assert total >= min_faults, \
+        "only %d faults injected (< %d): %s" % (total, min_faults,
+                                                by_kind)
+    # (5) determinism witness: same plan + seed + hit sequence =>
+    # identical fault timeline, in-process and in the drilled child
+    assert plan.replay() == injected, \
+        "replayed fault timeline diverged from the live one"
+    finals = [r for r in kv_recs if r.get("final")]
+    assert finals and all(r.get("replay_identical") for r in finals), \
+        "kv worker replay witness failed: %r" % (kv_recs,)
+
+    kv.close()
+    report = {
+        "duration_s": round(wall, 2),
+        "serving": {
+            "replicas": replicas,
+            "replica_killed": killed_rid,
+            "requests": dict(counts),
+            "req_per_sec": round(counts["served"] / wall, 2),
+            "fleet_ledger": led,
+            "replica_status": {str(r): list(v) for r, v in
+                               fd_stats["replicas"].items()},
+            "transport": fd_stats["transport"],
+        },
+        "training": {
+            "pushes_target": kv_pushes,
+            "acked": acked,
+            "push_failures": failed_pushes,
+            "applied": applied,
+            "worker_exits": kv_rcs,
+            "worker_sigkilled": True,
+            "coordinator_duplicates_dropped":
+                kv._transport.stats()["duplicates_dropped"],
+        },
+        "checkpoints": {
+            "commit_attempts": commit_attempts[0],
+            "commit_failures_injected": commit_attempts[1],
+            "complete_on_disk": len(mgr.store.steps()),
+            "reader_polls": reader_polls[0],
+            "integrity_failures": len(integrity_failures),
+        },
+        "faults_injected": {
+            "total": total,
+            "main_process": len(injected),
+            "kv_worker": total - len(injected),
+            "by_kind": by_kind,
+            "host_kills": {"serving_replica": 1, "kv_worker": sum(
+                1 for rc in kv_rcs if rc == -signal.SIGKILL)},
+        },
+        "zero_lost_requests": True,
+        "zero_duplicated_requests": True,
+        "zero_incomplete_checkpoint_reads": True,
+        "gradients_applied_exactly_once": True,
+        "replay_identical": True,
+    }
+    if own:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return report
+
+
+# ---------------------------------------------------------------------------
 # CLI: worker mode (drill subprocesses) + record mode (MULTICHIP json)
 # ---------------------------------------------------------------------------
 
@@ -708,8 +1210,11 @@ def _main(argv):
     import argparse
     ap = argparse.ArgumentParser(prog="mxnet_tpu.fault.drill")
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--kv-worker", action="store_true")
     ap.add_argument("--width", type=int, default=2)
     ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--pushes", type=int, default=30)
+    ap.add_argument("--report", default=None)
     ap.add_argument("--ckpt", default=None)
     ap.add_argument("--loss-log", default=None)
     ap.add_argument("--record", default=None,
@@ -718,16 +1223,19 @@ def _main(argv):
     if args.worker:
         worker_main(args.width, args.steps, args.ckpt, args.loss_log)
         return 0
+    if args.kv_worker:
+        kv_worker_main(args.pushes, args.report)
+        return 0
     # two drill flavors: same-width kill/restart must be EXACT (atol=0,
     # the reshard guarantee); shrink-then-grow matches to float32
     # reduction noise of the re-topologized collectives
     same_width = elastic_kill_drill(widths=(4, 4, 4))
     reshard = elastic_kill_drill(widths=(4, 2, 8), atol=1e-5)
-    soak = chaos_soak()
+    soak = fleet_network_soak()
     record = {"elastic_kill_drill_same_width": same_width,
               "elastic_kill_drill_reshard": reshard,
-              "chaos_soak": soak}
-    out = args.record or "MULTICHIP_r07.json"
+              "fleet_network_soak": soak}
+    out = args.record or "MULTICHIP_r08.json"
     with open(out, "w") as f:
         json.dump(record, f, indent=1)
         f.write("\n")

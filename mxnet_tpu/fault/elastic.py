@@ -42,8 +42,8 @@ from . import hooks
 from .backoff import BackoffPolicy
 from .plan import FaultInjected
 
-__all__ = ["ElasticError", "ElasticSupervisor", "elastic_fit",
-           "run_elastic", "RECOVERABLE"]
+__all__ = ["ElasticError", "ElasticSupervisor", "ProcessSupervisor",
+           "elastic_fit", "run_elastic", "RECOVERABLE"]
 
 # failure classes worth a restore-and-retry: infrastructure errors,
 # framework errors (a poisoned collective surfaces as MXNetError), and
@@ -139,6 +139,66 @@ class ElasticSupervisor:
                 self.logger.info(
                     "elastic: run completed after %d restart(s)", restart)
             return result
+
+
+class ProcessSupervisor:
+    """:class:`ElasticSupervisor`'s cross-process twin: supervise a
+    whole WORKER PROCESS instead of an in-process attempt.
+
+    The multi-host drills SIGKILL real subprocesses mid-step (a
+    preempted VM takes no cleanup path), and the thing that respawns
+    the survivor set on a new mesh width lives HERE, not in the test
+    harness: ``launch(restart)`` starts attempt ``restart`` — on
+    whatever width the fleet has now — waits for it, and returns its
+    exit code.  Death by signal (``rc < 0``) and the preemption exit
+    (143) are recoverable: sleep the budgeted
+    :class:`~.backoff.BackoffPolicy`, relaunch.  ``rc == 0`` completes;
+    any other exit is a worker BUG and raises :class:`ElasticError`
+    immediately — burning restarts on a deterministic failure only
+    delays the traceback.  Returns the exit-code list (last entry 0).
+    """
+
+    def __init__(self, retries=None, backoff=None, logger=None):
+        from .. import config as _config
+        self.retries = int(_config.get("MXNET_FAULT_RETRIES")
+                           if retries is None else retries)
+        self.backoff = backoff if backoff is not None else BackoffPolicy()
+        self.logger = logger or logging.getLogger("mxnet_tpu.fault")
+
+    @staticmethod
+    def is_recoverable(rc):
+        return rc < 0 or rc == PREEMPTION_EXIT
+
+    def run(self, launch):
+        m = _metrics()
+        rcs = []
+        restart = 0
+        while True:
+            rc = int(launch(restart))
+            rcs.append(rc)
+            if rc == 0:
+                if restart:
+                    m["recoveries"].inc()
+                    self.logger.info(
+                        "elastic: worker fleet completed after %d "
+                        "relaunch(es)", restart)
+                return rcs
+            if not self.is_recoverable(rc):
+                raise ElasticError(
+                    "worker process failed deterministically (rc=%d) — "
+                    "not a preemption, not relaunching" % rc)
+            if restart >= self.retries:
+                m["gave_up"].inc()
+                raise ElasticError(
+                    "elastic fleet gave up after %d relaunch(es); last "
+                    "worker exit rc=%d" % (restart, rc))
+            m["retries"].inc()
+            self.logger.warning(
+                "elastic: worker died rc=%d (signal/preemption); "
+                "relaunch %d/%d after backoff", rc, restart + 1,
+                self.retries)
+            self.backoff.sleep_for(restart)
+            restart += 1
 
 
 # ---------------------------------------------------------------------------

@@ -47,8 +47,29 @@ schedule must not silently drill nothing):
 the poisoned-canary drill: a model version that silently emits
 non-finite outputs, which the serving health gate must catch.
 
+Network-shaped kinds (the multi-host drills — sites live in
+``parallel/transport.py``, addressable per (site, peer) via ``where``
+on the ``peer`` ctx key):
+
+- ``partition`` — ``ConnectionError`` at the site.  At a PRE-delivery
+  site (``transport.send``) the message is dropped on the floor: the
+  link is down.
+- ``slow_link`` — sleeps ``delay_s``: a congested or lossy-and-
+  retransmitting link, latency without loss.
+- ``lost_ack`` — ``ConnectionError`` raised at a POST-delivery site
+  (``transport.send.ack``): the message LANDED but the sender believes
+  it failed, so an at-least-once sender retries and the receiver's
+  dedup must absorb the duplicate — the exactly-once drill.
+- ``reorder`` — raises :class:`Reorder`, a control-flow signal (not an
+  error) the transport catches to hold the message back and deliver it
+  AFTER the next one: a genuine adjacent swap, not just jitter.
+
 Determinism contract: with the same plan, the same sequence of site
 hits and the same published steps, exactly the same faults fire.
+``FaultPlan(spec, trace=True)`` records the live hit sequence and
+:meth:`FaultPlan.replay` re-runs it through a fresh plan of the same
+spec — the witness that a chaos soak's fault timeline is a pure
+function of (plan, hit sequence), replayable from the seed.
 """
 from __future__ import annotations
 
@@ -63,11 +84,13 @@ import time
 
 from . import hooks
 
-__all__ = ["FaultInjected", "FaultPlan", "install", "uninstall",
-           "installed", "active_plan", "KINDS", "EXC_NAMES"]
+__all__ = ["FaultInjected", "Reorder", "FaultPlan", "install",
+           "uninstall", "installed", "active_plan", "backoff_seed",
+           "KINDS", "EXC_NAMES"]
 
 KINDS = ("raise", "io_error", "enospc", "torn_write", "delay",
-         "sigterm", "sigkill", "exit", "nan")
+         "sigterm", "sigkill", "exit", "nan",
+         "partition", "slow_link", "lost_ack", "reorder")
 
 _RULE_KEYS = frozenset(("site", "kind", "after", "every", "times", "step",
                         "p", "exc", "delay_s", "code", "message", "where"))
@@ -81,6 +104,15 @@ class FaultInjected(Exception):
     would, and sites that catch narrow framework errors must not
     accidentally swallow it unless the drill asked them to (pick
     ``exc`` for that)."""
+
+
+class Reorder(Exception):
+    """Control-flow signal of ``kind=reorder`` — NOT a failure.  A
+    transport send site that sees this must hold the message back and
+    deliver it after the next one (an adjacent swap).  Deliberately a
+    bare ``Exception``: nothing classifies it as recoverable weather,
+    so a site that forgets to catch it fails a drill loudly instead of
+    silently converting reordering into retries."""
 
 
 def _exc_names():
@@ -179,7 +211,7 @@ class _Rule:
 class FaultPlan:
     """A parsed, armed-able fault schedule (see module docstring)."""
 
-    def __init__(self, spec):
+    def __init__(self, spec, trace=False):
         if isinstance(spec, str):
             spec = json.loads(spec)
         spec = dict(spec or {})
@@ -188,11 +220,17 @@ class FaultPlan:
             raise ValueError("fault plan has unknown key(s) %s"
                              % sorted(unknown))
         self.seed = int(spec.get("seed", 0))
+        self._spec = {"seed": self.seed,
+                      "rules": [dict(r) for r in spec.get("rules", [])]}
         self._rules = [_Rule(r, i, self.seed)
-                       for i, r in enumerate(spec.get("rules", []))]
+                       for i, r in enumerate(self._spec["rules"])]
         self._lock = threading.Lock()
         self._hits = {}       # guarded-by: _lock — site -> hit count
         self._injected = []   # guarded-by: _lock — (site, kind, rule idx)
+        self._backoff_seq = 0  # guarded-by: _lock — BackoffPolicy chain
+        # hit trace (drills): (site, step, str-projected ctx) per fire,
+        # in decision order — the replay witness's input
+        self._trace = [] if trace else None
 
     @classmethod
     def from_env(cls):
@@ -205,7 +243,10 @@ class FaultPlan:
         if raw.startswith("@"):
             with open(raw[1:]) as f:
                 raw = f.read()
-        return cls(raw)
+        # env-armed processes are DRILLED processes: always carry the
+        # hit trace so a surviving worker can report the replay witness
+        # (plan.replay() == stats()["injected"]) before it exits
+        return cls(raw, trace=True)
 
     # -- the hot entry (bound to hooks.fire while installed) -----------------
     def fire(self, site, **ctx):
@@ -213,18 +254,32 @@ class FaultPlan:
         action may sleep, raise, or kill the process, and must never do
         so while holding plan state."""
         step = hooks.STEP[0]
-        actions = []
         with self._lock:
-            n = self._hits.get(site, 0) + 1
-            self._hits[site] = n
-            for rule in self._rules:
-                if rule.wants(site, n, step, ctx):
-                    rule.fired += 1
-                    self._injected.append((site, rule.kind, rule.index))
-                    actions.append(rule)
+            actions = self._decide_locked(site, step, ctx)
         for rule in actions:
             self._count(site, rule.kind)
             self._act(rule, site, ctx)
+
+    def _decide_locked(self, site, step, ctx):
+        """The pure decision half of :meth:`fire` (caller holds
+        ``_lock``): count the hit, match rules, log injections, record
+        the trace.  Shared verbatim by the live path and
+        :meth:`replay` so the witness replays the real logic, not a
+        reimplementation."""
+        n = self._hits.get(site, 0) + 1
+        self._hits[site] = n
+        if self._trace is not None:
+            self._trace.append(
+                (site, step,
+                 {k: str(v) for k, v in ctx.items()
+                  if isinstance(v, (str, int, float, bool))}))
+        actions = []
+        for rule in self._rules:
+            if rule.wants(site, n, step, ctx):
+                rule.fired += 1
+                self._injected.append((site, rule.kind, rule.index))
+                actions.append(rule)
+        return actions
 
     @staticmethod
     def _count(site, kind):
@@ -239,9 +294,18 @@ class FaultPlan:
         tag = rule.message or (
             "graftfault: injected %s at site %r (rule %d)"
             % (rule.kind, site, rule.index))
-        if rule.kind == "delay":
+        if rule.kind in ("delay", "slow_link"):
             time.sleep(rule.delay_s)
             return
+        if rule.kind in ("partition", "lost_ack"):
+            # the site's placement carries the semantics: pre-delivery
+            # (transport.send) drops the message, post-delivery
+            # (transport.send.ack) makes the sender retry a LANDED one
+            peer = ctx.get("peer")
+            raise ConnectionError(
+                tag + (" (peer %s)" % peer if peer is not None else ""))
+        if rule.kind == "reorder":
+            raise Reorder(tag)
         if rule.kind == "nan":
             # corrupt the site's float payload in place — silent bad
             # outputs, the failure mode a health gate's non-finite
@@ -297,6 +361,37 @@ class FaultPlan:
                        if (site is None or fnmatch.fnmatchcase(s, site))
                        and (kind is None or k == kind))
 
+    def trace(self):
+        """The recorded hit sequence (``trace=True`` plans only):
+        ``[(site, step, ctx), ...]`` in decision order."""
+        with self._lock:
+            return list(self._trace or ())
+
+    def replay(self, trace=None):
+        """Re-run a hit trace through a FRESH plan of the same spec and
+        return its injected log — the determinism witness: a live soak's
+        thread timing decides WHICH hits happen in what order, but given
+        that hit sequence the fault timeline is a pure function of the
+        plan, so ``plan.replay() == plan.stats()["injected"]`` must hold
+        exactly.  (Traced ctx is str-projected; ``where`` matching strs
+        its operands anyway, so decisions replay faithfully.)"""
+        if trace is None:
+            trace = self.trace()
+        fresh = FaultPlan(self._spec)
+        for site, step, ctx in trace:
+            with fresh._lock:
+                fresh._decide_locked(site, step, ctx)
+        return fresh.stats()["injected"]
+
+    def next_backoff_seed(self):
+        """Per-plan seed chain for :class:`~.backoff.BackoffPolicy`
+        instances created while this plan is armed: the Nth policy of a
+        replayed drill gets the same jitter stream both times (same
+        ``"seed:backoff:index"`` idiom as the per-rule ``p`` chains)."""
+        with self._lock:
+            self._backoff_seq += 1
+            return "%d:backoff:%d" % (self.seed, self._backoff_seq)
+
 
 # ---------------------------------------------------------------------------
 # arming
@@ -336,6 +431,15 @@ def installed():
     """The armed plan, or None."""
     with _STATE_LOCK:
         return _STATE["plan"]
+
+
+def backoff_seed():
+    """Default seed for a :class:`~.backoff.BackoffPolicy` created with
+    no explicit seed: the armed plan's per-policy chain (so two replays
+    of one seeded plan produce identical drill timelines), or 0 when no
+    plan is armed (the historical default)."""
+    plan = installed()
+    return plan.next_backoff_seed() if plan is not None else 0
 
 
 class active_plan:

@@ -23,7 +23,6 @@ import os
 import pickle
 import threading
 import time as _time
-import zipfile
 
 from .base import MXNetError
 from .fault import hooks as _fault
@@ -525,6 +524,13 @@ class KVStoreDist(KVStoreTPU):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # graftfault: dist_sync's collective traffic crosses ONE named
+        # seam per reduce program — a plan can partition or slow the
+        # whole step (peer="all": there is no single victim link in an
+        # all-reduce, the step either completes everywhere or nowhere)
+        if _fault.ACTIVE[0]:
+            _fault.fire("transport.collective", peer="all",
+                        keys=len(arrs))
         if jax.process_count() == 1:
             return list(arrs)
         mesh = self._global_mesh()
@@ -614,12 +620,17 @@ class KVStoreDistAsync(KVStore):
     TPU-native redesign: XLA collectives are inherently synchronous, so
     async staleness cannot ride the compiled data plane.  Instead the
     coordinator (worker 0) runs a server THREAD applying updates in
-    arrival order, and transport is a shared filesystem spool
-    (``MXNET_KVSTORE_ASYNC_DIR``; a temp dir when unset, which covers
-    single-host multi-process via the launcher).  ``push`` returns
-    without waiting for the update to land — callers overlap compute
-    with parameter-server latency exactly as the reference's async
-    worker does.
+    arrival order, and gradients ride the fault-addressable
+    :class:`~.parallel.transport.SpoolTransport` seam over a shared
+    filesystem root (``MXNET_KVSTORE_ASYNC_DIR``; a temp dir when
+    unset, which covers single-host multi-process via the launcher).
+    ``push`` returns without waiting for the update to land — callers
+    overlap compute with parameter-server latency exactly as the
+    reference's async worker does.  An armed
+    :class:`~.fault.FaultPlan` can partition / slow / lose-ack /
+    reorder the gradient link at the ``transport.*`` sites; pushes
+    retry with one message id, the server's dedup absorbs resends, so
+    delivery stays exactly-once under link weather.
     """
 
     def __init__(self, kv_type="dist_async"):
@@ -628,6 +639,7 @@ class KVStoreDistAsync(KVStore):
         import threading
 
         from . import config as _config
+        from .parallel.transport import SpoolTransport
 
         self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
         self._world = int(os.environ.get("DMLC_NUM_WORKER", "1"))
@@ -644,7 +656,15 @@ class KVStoreDistAsync(KVStore):
         self._w_dir = os.path.join(root, "weights")
         os.makedirs(self._push_dir, exist_ok=True)
         os.makedirs(self._w_dir, exist_ok=True)
-        self._push_seq = 0
+        # every worker sends to the coordinator (rank 0), whose inbox
+        # keeps the historical push/ layout; the capacity cap and
+        # backpressure timeout ride the transport's exact flock
+        # admission protocol (formerly _spool_admit here)
+        cap = _config.get("MXNET_KVSTORE_ASYNC_MAX_PENDING")
+        self._transport = SpoolTransport(
+            root, self._rank, self._world,
+            cap=cap if cap and cap > 0 else None,
+            inbox=lambda r: "push")
         self._key_by_name = {}   # str(key) -> store key (int keys survive
                                  # the npz spool as strings)
         self._lock = threading.Lock()
@@ -663,31 +683,23 @@ class KVStoreDistAsync(KVStore):
                 time.sleep(0.01)
 
     def _spool_files(self):
-        """Completed spool files in arrival order — the one scan
-        predicate shared by the server, backpressure, and drain (it must
-        mirror push()'s temp naming: '.'+name+'.tmp' -> .tmp.npz)."""
-        try:
-            return sorted(n for n in os.listdir(self._push_dir)
-                          if n.endswith(".npz")
-                          and not n.startswith(".")
-                          and not n.endswith(".tmp.npz"))
-        except OSError:
-            return []
+        """Completed spool files in arrival order (the transport's scan
+        of the coordinator inbox) — shared by drain and tests."""
+        return self._transport._spool_files(0)
 
     def _apply_arrivals(self):
-        """Apply every spooled push in arrival order; True if any."""
-        import numpy as _np
-        names = self._spool_files()
-        did = False
-        for name in names:
-            path = os.path.join(self._push_dir, name)
-            try:
-                with _np.load(path, allow_pickle=False) as z:
-                    k = str(z["key"])
-                    grad = z["grad"]
-            except (OSError, ValueError, KeyError, EOFError,
-                    zipfile.BadZipFile):
-                continue  # partially-written file; next scan gets it
+        """Apply every delivered push in arrival order; True if any.
+
+        The transport's recv drops duplicate message ids, so a
+        link-fault resend (``lost_ack``) never double-applies a
+        gradient; a fault raised at ``transport.recv`` leaves the
+        message spooled for the next scan."""
+        msgs = self._transport.recv()
+        for msg in msgs:
+            k = str(msg.meta.get("key"))
+            grad = msg.arrays.get("grad")
+            if grad is None:
+                continue
             with self._lock:
                 k = self._key_by_name.get(k, k)
                 if k in self._store:
@@ -700,14 +712,11 @@ class KVStoreDistAsync(KVStore):
                         self._store[k] += g
                     if len(self._applied_log) >= 1000:
                         del self._applied_log[:500]  # debug ring buffer
-                    self._applied_log.append((k, name))
+                    self._applied_log.append(
+                        (k, "%d:%d:%d" % (msg.sender, msg.epoch,
+                                          msg.seq)))
                     self._publish(k)
-            try:
-                os.remove(path)
-            except OSError:
-                pass  # a concurrent scan won the race; nothing to redo
-            did = True
-        return did
+        return bool(msgs)
 
     def _publish(self, k):
         """Atomically expose the current weight for workers to pull."""
@@ -758,121 +767,27 @@ class KVStoreDistAsync(KVStore):
         except (OSError, ValueError):
             raise MXNetError("dist_async: cannot read weight %r" % (k,))
 
-    def _spool_lock(self, deadline):
-        """flock-based lock serializing scan+publish across workers on
-        the shared spool directory.  Returns a context manager; raises
-        MXNetError past ``deadline``.
-
-        ``fcntl.flock`` on a persistent lockfile is the whole protocol:
-        the kernel releases the lock when the holder exits or dies, so
-        there is no stale-lock breaking and therefore no
-        check-then-break TOCTOU window — at most one holder exists at
-        any instant, which is what makes the spool cap EXACT.  (The
-        earlier O_EXCL+mtime-staleness design could steal a freshly
-        re-created lock under clock skew.)"""
-        import contextlib
-        import fcntl
-        import time
-
-        lock_path = os.path.join(self._push_dir, ".spool.lock")
-
-        @contextlib.contextmanager
-        def _held():
-            fd = os.open(lock_path, os.O_CREAT | os.O_WRONLY)
-            try:
-                while True:
-                    try:
-                        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-                        break
-                    except OSError:
-                        if time.time() > deadline:
-                            raise MXNetError(
-                                "dist_async: spool lock held past the "
-                                "backpressure timeout")
-                        time.sleep(0.002)
-                try:
-                    yield
-                finally:
-                    fcntl.flock(fd, fcntl.LOCK_UN)
-            finally:
-                os.close(fd)
-
-        return _held()
-
-    def _spool_admit(self, pairs):
-        """Publish spooled temp files under the capacity cap — EXACTLY.
-
-        The capacity scan and the publishing renames happen under one
-        spool lockfile, so concurrent workers cannot overshoot: pending
-        never exceeds MXNET_KVSTORE_ASYNC_MAX_PENDING (the r4 bound was
-        cap + workers - 1 from the unlocked check-then-write; reference
-        analogue: the request queue bound in
-        src/kvstore/kvstore_dist_server.h:261).  Blocks while full;
-        raises after MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT — a spool
-        pinned at capacity that long means the server thread is dead,
-        not merely behind."""
-        import time
-
-        from . import config as _config
-        cap = _config.get("MXNET_KVSTORE_ASYNC_MAX_PENDING")
-        if not cap or cap <= 0:
-            for tmp, final in pairs:
-                os.replace(tmp, final)
-            return
-        deadline = time.time() + \
-            _config.get("MXNET_KVSTORE_ASYNC_BACKPRESSURE_TIMEOUT")
-        i = 0
-        while i < len(pairs):
-            with self._spool_lock(deadline):
-                room = cap - len(self._spool_files())
-                while room > 0 and i < len(pairs):
-                    os.replace(*pairs[i])
-                    i += 1
-                    room -= 1
-            if i < len(pairs):
-                if time.time() > deadline:
-                    raise MXNetError(
-                        "dist_async: push spool held %d pending "
-                        "gradients past the backpressure timeout — is "
-                        "the coordinator server thread alive?"
-                        % len(self._spool_files()))
-                time.sleep(0.005)
-
     @_instrumented("push")
     def push(self, key, value, priority=0):
-        """Spool the merged gradient and RETURN — no barrier, no wait;
-        the server applies it on arrival.  A full spool blocks first
-        (``_spool_admit``)."""
-        import numpy as _np
+        """Send the merged gradient across the transport seam and
+        RETURN — no barrier, no wait; the server applies it on arrival.
+        A full coordinator inbox blocks first (the transport's
+        exact-capacity flock admission), then raises past the
+        backpressure timeout — a spool pinned at capacity that long
+        means the server thread is dead, not merely behind.  Injected
+        link faults (``partition``/``lost_ack``) are retried under one
+        message id; the server's dedup keeps delivery exactly-once."""
         keys, vals = _ctype_key_value(key, value)
-        pairs = []
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError("key %r has not been initialized" % (k,))
             merged = self._reduce(k, vlist)
-            with self._lock:  # push may be called from several threads
-                self._push_seq += 1
-                seq = self._push_seq
-            name = "%013d-%03d-%06d-%s" % (
-                _now_ms(), self._rank, seq, _san(k))
-            # temp name must NOT match the server's *.npz scan (it would
-            # race the rename); savez appends .npz, so park it under a
-            # .tmp.npz suffix the scan filters out
-            tmp = os.path.join(self._push_dir, "." + name + ".tmp")
-            _np.savez(tmp, key=_np.str_(k), grad=merged.asnumpy())
-            pairs.append((tmp + ".npz",
-                          os.path.join(self._push_dir, name + ".npz")))
-        try:
-            self._spool_admit(pairs)
-        except MXNetError:
-            # don't orphan unpublished temp files in the shared spool
-            # when admission times out (the caller may retry forever)
-            for tmp, _final in pairs:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-            raise
+            try:
+                self._transport.send_reliable(
+                    0, "grad", meta={"key": str(k)},
+                    arrays={"grad": merged.asnumpy()})
+            except ConnectionError as exc:
+                raise MXNetError("dist_async push: %s" % (exc,))
 
     @_instrumented("pull")
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -908,6 +823,7 @@ class KVStoreDistAsync(KVStore):
         self._stop.set()
         if self._server is not None:
             self._server.join(timeout=5)
+        self._transport.close()
 
     @property
     def rank(self):
@@ -925,11 +841,6 @@ def _san(k):
     s = str(k)
     safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in s)
     return "%s-%08x" % (safe, zlib.crc32(s.encode()))
-
-
-def _now_ms():
-    import time
-    return int(time.time() * 1000)
 
 
 def is_worker_node():

@@ -19,3 +19,4 @@ from .moe import switch_moe, stack_experts  # noqa: F401
 from .distributed import (  # noqa: F401
     init_distributed, rank, num_workers, is_initialized,
 )
+from .transport import InboxFull, Message, SpoolTransport  # noqa: F401

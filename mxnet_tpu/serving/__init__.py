@@ -16,6 +16,9 @@ from .cache import ExecutorCache  # noqa: F401
 from .canary import CanaryState  # noqa: F401
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
                      QueueFull, ServerClosed, ServingError)
+from .fleet import (FleetFrontDoor, ReplicaHandle,  # noqa: F401
+                    decode_error, encode_error, local_replica,
+                    replica_loop, spawn_replica)
 from .manifest import WarmupManifest  # noqa: F401
 from .registry import (CheckpointWatcher, ModelRegistry,  # noqa: F401
                        ModelVersion)
@@ -25,4 +28,6 @@ __all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
            "InferenceFuture", "CanaryState", "ServingError",
            "ModelNotFound", "QueueFull", "DeadlineExceeded", "ServerClosed",
            "BadRequest", "CheckpointWatcher", "WarmupManifest",
-           "shape_buckets", "pick_bucket"]
+           "shape_buckets", "pick_bucket", "FleetFrontDoor",
+           "ReplicaHandle", "replica_loop", "local_replica",
+           "spawn_replica", "encode_error", "decode_error"]

@@ -60,6 +60,7 @@ only enqueue and wait on futures.
 """
 from __future__ import annotations
 
+import contextlib
 import random as _random
 import threading
 import time
@@ -511,12 +512,13 @@ class ModelServer:
         if superseded is not None:
             # same cleanup as a gate-decided rollback: an abandoned
             # candidate's bound executors and params must not linger
-            # against the tenant's own cache quota
-            self.cache.invalidate(name, superseded.canary_version)
+            # against the tenant's own cache quota (unload first, same
+            # ordering constraint as _maybe_decide_canary's apply)
             try:
                 self.registry.unload(name, superseded.canary_version)
             except ModelNotFound:
                 pass   # operator raced us; nothing to free
+            self.cache.invalidate(name, superseded.canary_version)
         self._t_canary.labels(model=name).set(1)
         del entry
         return st
@@ -601,11 +603,18 @@ class ModelServer:
             if decision == "promoted":
                 self.registry.set_default(st.name, st.canary_version)
             else:
-                self.cache.invalidate(st.name, st.canary_version)
+                # unload BEFORE invalidate: a request already routed to
+                # the doomed version can miss the cache the instant its
+                # executors drop, and _execute classifies that rebind
+                # as last-ride cold work by observing the entry is gone
+                # from the registry — invalidate-first would leave a
+                # window where the rebind looks like a steady-state
+                # recompile (flaky san-recompile in the audit gate)
                 try:
                     self.registry.unload(st.name, st.canary_version)
                 except ModelNotFound:
                     pass   # already unloaded (operator raced us)
+                self.cache.invalidate(st.name, st.canary_version)
         # contain-and-retry: the decision runs on the batcher thread
         # inside _execute — an injected/transient promotion failure
         # must fail the PROMOTION (stamp reverted below, retried on
@@ -1262,14 +1271,31 @@ class ModelServer:
         span_args = {"model": name, "version": entry.version,
                      "bucket": bucket, "rows": rows_total}
         t_exec0 = _now_ms()
+        # a request routed to a canary that rolled back mid-flight still
+        # executes on its held entry (those are the weights it was
+        # routed to), but the rebind+compile that may cost is last-ride
+        # cold work on an unloaded version, not a steady-state
+        # regression — exempt it exactly like a warmup plan.  The
+        # registry probe only runs while a region sanitizer is armed;
+        # production batches pay nothing.
+        doomed = False
+        if _san_hooks.region_sanitizers_active():
+            try:
+                doomed = self.registry.get(name, entry.version) is not entry
+            except ModelNotFound:
+                doomed = True
+        cold_cm = _san_hooks.suspended() if doomed \
+            else contextlib.nullcontext()
         with profiler.scope("serving:batch", cat="serving", args=span_args):
-            pred = self.cache.get(entry, bucket)
-            feed = {}
-            for k in entry.input_names:
-                feed[k], _ = pad_batch([r.inputs[k] for r in reqs], bucket)
-            pred.forward(**feed)
-            outs = [pred.get_output(i).asnumpy()
-                    for i in range(entry.num_outputs)]
+            with cold_cm:
+                pred = self.cache.get(entry, bucket)
+                feed = {}
+                for k in entry.input_names:
+                    feed[k], _ = pad_batch(
+                        [r.inputs[k] for r in reqs], bucket)
+                pred.forward(**feed)
+                outs = [pred.get_output(i).asnumpy()
+                        for i in range(entry.num_outputs)]
         if _fault.ACTIVE[0] and self._is_canary_version(name,
                                                        entry.version):
             # graftfault: the poisoned-canary site — kind=nan corrupts

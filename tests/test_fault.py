@@ -454,6 +454,35 @@ def test_backoff_floor_honors_server_hint():
     assert slept == [0.5]
 
 
+def test_backoff_seed_chain_replays_under_armed_plan():
+    """Policies created under an armed plan draw jitter from the
+    plan's per-policy ``"seed:backoff:N"`` chain: two arms of the same
+    seed hand the Nth policy the same stream, so a replayed drill's
+    retry timeline is identical — and global ``random`` is never
+    consulted."""
+    import random as _random
+
+    spec = {"seed": 21, "rules": []}
+
+    def timeline():
+        with fault.active_plan(spec):
+            pols = [BackoffPolicy(retries=2, base_s=0.5, max_s=4.0,
+                                  jitter=0.9, sleep=lambda s: None)
+                    for _ in range(3)]
+            return [[p.delay(a) for a in range(4)] for p in pols]
+
+    _random.seed(123)
+    first = timeline()
+    _random.seed(456)               # global seed must be irrelevant
+    assert timeline() == first
+    assert first[0] != first[1]     # distinct chain links per policy
+    # no plan armed: seed falls back to 0 — still not global random
+    state = _random.getstate()
+    BackoffPolicy(retries=1, base_s=0.5, max_s=4.0, jitter=0.9,
+                  sleep=lambda s: None).delay(0)
+    assert _random.getstate() == state
+
+
 def test_knob_defaults_flow_into_policy(monkeypatch):
     monkeypatch.setenv("MXNET_FAULT_RETRIES", "7")
     monkeypatch.setenv("MXNET_FAULT_BACKOFF_BASE_S", "0.125")
@@ -832,3 +861,24 @@ def test_chaos_soak_zero_lost_zero_incomplete():
     assert report["zero_incomplete_checkpoint_reads"]
     assert report["faults_injected"]["total"] > 0
     assert report["checkpoints"]["versions_hot_swapped"] >= 1
+
+
+@pytest.mark.slow
+def test_fleet_network_soak_bars(tmp_path):
+    """The multi-host chaos leg: serving fleet + dist_async training +
+    checkpoints under all four network kinds, a replica SIGKILL and a
+    kv-worker SIGKILL — the MULTICHIP_r08 bars at test scale."""
+    from mxnet_tpu.fault.drill import fleet_network_soak
+    report = fleet_network_soak(duration_s=6.0, clients=3, replicas=2,
+                                kv_pushes=16, min_faults=80,
+                                tmpdir=str(tmp_path))
+    assert report["zero_lost_requests"]
+    assert report["zero_duplicated_requests"]
+    assert report["zero_incomplete_checkpoint_reads"]
+    assert report["gradients_applied_exactly_once"]
+    assert report["replay_identical"]
+    fi = report["faults_injected"]
+    assert fi["total"] >= 80
+    assert set(fi["by_kind"]) >= {"partition", "slow_link", "lost_ack",
+                                  "reorder", "sigkill"}
+    assert report["serving"]["fleet_ledger"]["ejections"] >= 1

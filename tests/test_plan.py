@@ -356,6 +356,46 @@ def test_reshard_compat_across_mesh_zero_and_codec():
                for p in verdict["problems"])
 
 
+def test_restore_compat_width_one_to_n_and_back():
+    """The cross-width elastic drills lean on these edges: scale OUT
+    (1 -> N) and scale IN (N -> 1) are both legal reshards, and the
+    plan-level verdict names the width change in both directions."""
+    from mxnet_tpu.checkpoint import check_restore_compat
+    net = _make_net()
+    t1 = _trainer(0, width=1, net=net)
+    t8 = _trainer(0, width=8, net=net)
+    v_out = check_restore_compat(t1.state_dict(), t8)
+    assert v_out["compatible"], v_out["problems"]
+    v_in = check_restore_compat(t8.state_dict(), t1)
+    assert v_in["compatible"], v_in["problems"]
+    s1, s8 = PlanSpec.from_trainer(t1), PlanSpec.from_trainer(t8)
+    assert any("1 -> 8" in n for n in reshard_compat(s1, s8)["notes"])
+    assert any("8 -> 1" in n for n in reshard_compat(s8, s1)["notes"])
+
+
+def test_restore_compat_refuses_non_dividing_width():
+    """Restore onto a mesh width that divides neither the bucket pad
+    nor a sharded dim must refuse loudly — never reshard garbage."""
+    def spec(width, pspec=None):
+        return PlanSpec(
+            name="t%d" % width, kind="trainer", origin="test",
+            mesh=MeshSpec([("dp", width)]),
+            params=[{"name": "w", "shape": [8, 4], "dtype_size": 4,
+                     "trainable": True, "spec": pspec}],
+            optimizer={"slots": ["momentum"], "scalar_slots": []},
+            buckets=[{"index": 0, "padded_n": 40}])
+
+    saved = spec(8)
+    good = reshard_compat(saved, spec(4))       # 40 % 4 == 0: legal
+    assert good["compatible"], good["problems"]
+    bad = reshard_compat(saved, spec(3, pspec=[["dp"], None]))
+    assert not bad["compatible"]
+    assert all(p["contract"] == "divisibility" for p in bad["problems"])
+    details = " ".join(p["detail"] for p in bad["problems"])
+    assert "does not divide" in details
+    assert any("bucket" in p["detail"] for p in bad["problems"])
+
+
 def test_reshard_incompat_surfaces_as_collective_mismatch():
     saved = PlanSpec(
         name="saved", kind="trainer", origin="x.py",

@@ -259,6 +259,60 @@ def test_sanitized_smoke_fit_and_serving_burst(san):
     assert san.region_names() == []
 
 
+def test_rolled_back_canary_rebind_is_exempt_cold_work(san):
+    """A request already routed to a canary version can execute AFTER
+    the rollback unloaded that version: it still runs on its held entry
+    (the weights it was routed to), and the lazy rebind+compile that
+    costs is last-ride cold work — NOT a steady-state recompile.  This
+    pins the race the audit gate used to lose flakily: rollback
+    invalidating the cache mid-flight made the doomed batch's rebind
+    look like a hot-path regression."""
+    san.install(rules=("recompile",))
+    san.reset()
+    rng = np.random.RandomState(3)
+
+    def params():
+        return ({"fc_weight": nd.array(rng.randn(2, 6).astype(np.float32)),
+                 "fc_bias": nd.zeros((2,))}, {})
+    net = sym.softmax(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="prob")
+    srv = mx.serving.ModelServer(max_batch=4, batch_wait_ms=1.0,
+                                 default_timeout_ms=30000.0)
+    a1, x1 = params()
+    srv.add_model("c", net, a1, x1, {"data": (1, 6)})
+    srv.warmup("c")                     # inline; opens the region
+    assert san.region_names() == ["serving"]
+    a2, x2 = params()
+    v2 = srv.add_model("c", net, a2, x2, {"data": (1, 6)})
+    srv.begin_canary("c", v2, fraction=1.0, min_requests=1000)
+    # batcher down: the submit routes to the canary (fraction 1.0) and
+    # parks in the queue holding the v2 entry
+    fut = srv.infer_async("c", rng.randn(1, 6).astype(np.float32))
+    # the gate's rollback apply, in its fixed order: unload from the
+    # registry FIRST, then drop the executors — so a doomed miss is
+    # always observable as "entry no longer registered"
+    with srv._canary_lock:
+        st = srv._canaries["c"]
+        st.decide("rolled_back", "drill")
+        srv._finish_canary_locked(st)
+    srv.registry.unload("c", v2)
+    srv.cache.invalidate("c", v2)
+    pre_misses = srv.cache.misses
+    srv.start()
+    try:
+        assert fut.wait(30.0)
+        out = fut.result()
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+    assert np.isfinite(out[0]).all()
+    # the rebind really happened (this test would prove nothing if the
+    # executor had still been cached) ...
+    assert srv.cache.misses == pre_misses + 1
+    # ... and was classified as cold work, not a steady-state recompile
+    assert san.findings() == [], [f.to_dict() for f in san.findings()]
+
+
 # -- disabled fast path ------------------------------------------------------
 
 def test_disabled_fast_path_overhead(san):
