@@ -17,7 +17,7 @@ line-free fingerprint is stable.
 | ``spmd-divisibility``  | a sharded dim that does not divide its mesh axes, a bucket that does not pad to the mesh, a batch that does not divide its sharding axes |
 | ``collective-mismatch`` | a reduce-scatter with no later all-gather (sharded update never re-broadcast), or an incompatible reshard-on-restore pair |
 | ``oom-risk``           | predicted per-chip peak bytes over the ``MXNET_PLAN_HBM_BYTES`` budget |
-| ``bucket-plan-waste``  | serving-ladder rungs with predicted fill below ``MXNET_PLAN_BUCKET_FILL_MIN``, or shadowed rungs ``pick_bucket`` can never select |
+| ``bucket-plan-waste``  | serving-ladder rungs with predicted fill below ``MXNET_PLAN_BUCKET_FILL_MIN``, or shadowed rungs ``pick_bucket`` can never select — including generative deployments' prefill batch/length ladders and window-vs-budget geometry |
 """
 from __future__ import annotations
 
@@ -110,6 +110,14 @@ class BucketPlanWasteChecker(_PlanChecker):
             out.extend(self._finding(
                 report, "manifest working set %s: %s"
                 % (tag, p["detail"]))
+                for p in rep.get("problems", ()))
+        # generative deployments carry TWO ladders (prefill batch x
+        # length) plus window-vs-budget geometry, all priced by
+        # contracts.generative_report
+        for name, rep in sorted((report.get("generative")
+                                 or {}).items()):
+            out.extend(self._finding(
+                report, "generative %s: %s" % (name, p["detail"]))
                 for p in rep.get("problems", ()))
         return out
 

@@ -106,6 +106,36 @@ def _serving_config(name):
     return spec, None, None
 
 
+def _generative_config(name):
+    """A generative serving deployment (the in-tree TransformerLM
+    stock through ``serving/generate``): decode/prefill ladders + KV
+    geometry, judged by ``contracts.generative_report``.  Built
+    directly from a ``GenerativeModel`` — params materialize eagerly
+    but no serving program (prefill/admit/decode) is ever bound: the
+    spec needs geometry and byte counts, not compiled code."""
+    from mxnet_tpu.gluon.contrib.transformer import TransformerLM
+    from mxnet_tpu.serving.generate import GenerativeModel
+    blk = TransformerLM(vocab_size=64, units=32, hidden_size=64,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_len=64)
+    blk.initialize()
+    gm = GenerativeModel("transformer-lm", blk, max_len=64,
+                         prefill_batch=4)
+    spec = PlanSpec(
+        name=name, kind="serving",
+        origin="mxnet_tpu/serving/generate/model.py",
+        generative={"transformer-lm": {
+            "slots": 8,
+            "max_len": gm.max_len,
+            "max_new_tokens": gm.max_len,
+            "batch_ladder": list(gm.batch_ladder),
+            "len_ladder": list(gm.len_ladder),
+            "kv_bytes_per_slot": gm.kv_bytes_per_slot(),
+            "param_bytes": gm.param_bytes(),
+        }})
+    return spec, None, gm
+
+
 def in_tree_live(width=None):
     """``[(spec, measured_or_None, live_or_None), ...]`` for every
     in-tree configuration — the live object (trainer / bound executor)
@@ -126,6 +156,7 @@ def in_tree_live(width=None):
                         width, zero=2, compression="bf16",
                         bucket_bytes=2048),
         _serving_config("serving/warmup-ladder"),
+        _generative_config("serving/generative-lm"),
         _program_config("program/convnet"),
     ]
 

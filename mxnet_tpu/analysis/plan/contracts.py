@@ -10,7 +10,7 @@ the in-tree configuration catalog.
 from __future__ import annotations
 
 __all__ = ["check_divisibility", "check_schedule", "ladder_report",
-           "reshard_compat"]
+           "generative_report", "reshard_compat"]
 
 
 def check_divisibility(spec):
@@ -117,6 +117,52 @@ def ladder_report(ladder, fill_min=0.6):
                                                  fill_min)})
         prev = b
     return {"rungs": rungs, "problems": problems}
+
+
+def generative_report(gen, fill_min=0.6):
+    """Predicted economics of one generative deployment (an entry of
+    ``ModelServer.plan_spec()["generative"]``).
+
+    Both prefill axes are ladders and both are judged by the SAME
+    uniform-arrival model as the one-shot batch ladder: a shadowed
+    prefill LENGTH rung (one ``pick_grid_bucket`` can never select) is
+    a finding, and a low-fill rung is padding waste multiplied across
+    every batch rung it grids with.  The KV-cache is priced into the
+    per-chip memory story: ``kv_bytes_total = kv_bytes_per_slot x
+    slots`` is resident for the server's whole lifetime — the
+    interpreter folds it into ``report["memory"]["activations"]`` so
+    the ``oom-risk`` budget sees decode state, not just weights."""
+    batch = ladder_report(gen.get("batch_ladder") or [],
+                          fill_min=fill_min)
+    length = ladder_report(gen.get("len_ladder") or [],
+                           fill_min=fill_min)
+    problems = []
+    for axis, rep in (("batch", batch), ("length", length)):
+        for p in rep["problems"]:
+            q = dict(p)
+            q["contract"] = "generative-plan"
+            q["detail"] = "prefill %s ladder: %s" % (axis, p["detail"])
+            problems.append(q)
+    slots = int(gen.get("slots") or 0)
+    kv_slot = int(gen.get("kv_bytes_per_slot") or 0)
+    n_cells = (len(batch["rungs"]) * len(length["rungs"]))
+    max_len = int(gen.get("max_len") or 0)
+    max_new = int(gen.get("max_new_tokens") or 0)
+    if max_len and max_new > max_len:
+        problems.append({
+            "contract": "generative-plan",
+            "detail": "default generation budget %d exceeds the "
+                      "%d-token KV window: most of a default-length "
+                      "generation attends through ring wrap-around "
+                      "(sliding window) — raise max_len or lower "
+                      "MXNET_SERVING_GEN_MAX_NEW_TOKENS"
+                      % (max_new, max_len)})
+    return {"batch_ladder": batch, "len_ladder": length,
+            "slots": slots, "prefill_programs": n_cells,
+            "kv_bytes_per_slot": kv_slot,
+            "kv_bytes_total": kv_slot * slots,
+            "param_bytes": int(gen.get("param_bytes") or 0),
+            "problems": problems}
 
 
 def _slot_names(spec):

@@ -11,7 +11,8 @@ reshard-on-restore verdict in.
 from __future__ import annotations
 
 from .contracts import (check_divisibility, check_schedule,
-                        ladder_report, reshard_compat)
+                        generative_report, ladder_report,
+                        reshard_compat)
 from .memory import predict_memory, predict_opt_state
 from .schedule import build_schedule, predict_comm
 
@@ -34,6 +35,8 @@ def analyze(spec, restore_from=None, fill_min=None):
       exact vs ``optimizer_state_bytes()`` (oom-risk reads ``total``);
     - ``ladder``         — serving-ladder fill/shadowing economics
       (bucket-plan-waste);
+    - ``generative``     — per-deployment decode/prefill ladder
+      economics + KV-cache pricing (also folded into ``memory``);
     - ``restore``        — reshard-on-restore verdict when
       ``restore_from`` is given.
     """
@@ -46,7 +49,8 @@ def analyze(spec, restore_from=None, fill_min=None):
               "hbm_budget": spec.hbm_budget,
               "divisibility": [], "schedule": [],
               "schedule_problems": [], "comm": None, "memory": None,
-              "ladder": None, "manifest_ladders": None, "restore": None}
+              "ladder": None, "manifest_ladders": None,
+              "generative": None, "restore": None}
     if spec.kind in ("trainer", "program"):
         report["divisibility"] = check_divisibility(spec)
         report["memory"] = predict_memory(spec)
@@ -61,6 +65,21 @@ def analyze(spec, restore_from=None, fill_min=None):
         report["manifest_ladders"] = {
             tag: ladder_report(ladder, **kw)
             for tag, ladder in sorted(spec.manifest_ladders.items())}
+    if spec.generative:
+        report["generative"] = {
+            name: generative_report(gen, **kw)
+            for name, gen in sorted(spec.generative.items())}
+        # KV-cache state is resident for the server's lifetime: fold
+        # it into the per-chip memory model (as "activations" — live
+        # non-param bytes) so the oom-risk budget prices decode slots,
+        # not just weights
+        params = sum(g["param_bytes"]
+                     for g in report["generative"].values())
+        kv = sum(g["kv_bytes_total"]
+                 for g in report["generative"].values())
+        report["memory"] = {"params": params, "opt_state": 0,
+                            "staging": 0, "activations": kv,
+                            "total": params + kv}
     if restore_from is not None:
         report["restore"] = reshard_compat(restore_from, spec)
     return report

@@ -113,13 +113,13 @@ class PlanSpec:
     FIELDS = ("name", "kind", "origin", "mesh", "params", "zero",
               "optimizer", "buckets", "codec", "batch", "param_gather",
               "graph", "graph_inputs", "ladder", "manifest_ladders",
-              "hbm_budget")
+              "generative", "hbm_budget")
 
     def __init__(self, name, kind, origin, mesh=None, params=(),
                  zero=0, optimizer=None, buckets=(), codec=None,
                  batch=None, param_gather=True, graph=None,
                  graph_inputs=None, ladder=None, manifest_ladders=None,
-                 hbm_budget=None):
+                 generative=None, hbm_budget=None):
         self.name = str(name)
         self.kind = str(kind)          # trainer | serving | program
         self.origin = str(origin)      # repo-relative finding anchor
@@ -140,6 +140,11 @@ class PlanSpec:
         # warms THOSE buckets)
         self.manifest_ladders = {str(k): list(v) for k, v
                                  in (manifest_ladders or {}).items()}
+        # {model: entry} — ModelServer.plan_spec()["generative"]: the
+        # decode/prefill ladders and KV-cache geometry of generative
+        # deployments, judged by contracts.generative_report
+        self.generative = {str(k): dict(v) for k, v
+                           in (generative or {}).items()}
         self.hbm_budget = None if hbm_budget is None else int(hbm_budget)
 
     # -- plain-data round trip (test fixtures ride this) --------------------
@@ -182,13 +187,17 @@ class PlanSpec:
     @classmethod
     def from_server(cls, server, name="serving"):
         """Capture a :class:`~mxnet_tpu.serving.ModelServer`'s bucket
-        ladder AND the warmup manifest's recorded working sets
-        (``server.plan_spec()``) — bucket-plan-waste judges both."""
+        ladder, the warmup manifest's recorded working sets, AND any
+        generative deployments' decode/prefill ladders
+        (``server.plan_spec()``) — bucket-plan-waste judges all of
+        them, and the generative KV-cache bytes enter the memory
+        model."""
         d = server.plan_spec()
         return cls(name=name, kind="serving",
                    origin="mxnet_tpu/serving/server.py",
                    ladder=d["ladder"],
-                   manifest_ladders=d.get("manifest_ladders"))
+                   manifest_ladders=d.get("manifest_ladders"),
+                   generative=d.get("generative"))
 
     @classmethod
     def from_ladder(cls, ladder, name="serving/ladder",

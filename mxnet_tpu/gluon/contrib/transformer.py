@@ -11,11 +11,76 @@ the model-level consumers of the TPU-native attention stack:
   axis; ``example/long-context/transformer_lm.py`` shows the handoff.
 
 Pre-LN residual blocks (the variant that trains stably without warmup).
+
+Generative serving (``mxnet_tpu.serving.generate``) consumes this file
+as the in-tree model stock through two seams:
+
+- :func:`cached_attention_step` / :func:`causal_attention` — the pure
+  attention math of the KV-cache decode path: a single-token query
+  attends against a preallocated fixed-shape cache with a validity
+  mask, so every decode step is ONE compiled program regardless of the
+  sequence position (the reference's per-length bucketed executors,
+  collapsed to one);
+- :meth:`TransformerLM.generative_spec` — the trained block's weights
+  extracted as plain device arrays + the architecture config, the feed
+  ``serving/generate/model.py`` compiles its prefill/decode programs
+  from.
 """
 from ..block import HybridBlock
 from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+from ..parameter import DeferredInitializationError
 
-__all__ = ["MultiHeadAttention", "TransformerEncoderCell", "TransformerLM"]
+__all__ = ["MultiHeadAttention", "TransformerEncoderCell", "TransformerLM",
+           "causal_attention", "cached_attention_step"]
+
+
+def causal_attention(q, k, v):
+    """Pure-jax causal attention over full sequences — the PREFILL
+    path's math (einsum + mask formulation, numerically the non-flash
+    reference the Pallas kernel is parity-tested against).
+
+    ``q``: ``[B, T, H, D]``; ``k``/``v``: ``[B, T, Hkv, D]`` with
+    ``H % Hkv == 0`` (GQA repeats KV head groups).  Returns
+    ``[B, T, H, D]``."""
+    import jax.numpy as jnp
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, T, Hkv, g, D) * (D ** -0.5)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal[None, None, None], scores, -1e30)
+    p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v)
+    return out.reshape(B, T, H, D)
+
+
+def cached_attention_step(q, k_cache, v_cache, n_valid):
+    """One DECODE step against a preallocated KV-cache — the
+    fixed-shape program at the heart of incremental generation.
+
+    ``q``: ``[S, H, D]`` (one query token per decode slot);
+    ``k_cache``/``v_cache``: ``[S, Hkv, M, D]`` (``M`` = cache
+    capacity); ``n_valid``: ``[S]`` int — how many cache positions hold
+    real history per slot (the ring's fill level).  Positions
+    ``>= n_valid`` are masked out, so the SAME compiled program serves
+    every slot at every sequence position; causality is structural (the
+    cache only ever holds past tokens plus the current one).  Returns
+    ``[S, H, D]``."""
+    import jax.numpy as jnp
+    S, H, D = q.shape
+    Hkv, M = k_cache.shape[1], k_cache.shape[2]
+    g = H // Hkv
+    qg = q.reshape(S, Hkv, g, D) * (D ** -0.5)
+    scores = jnp.einsum("shgd,shmd->shgm", qg, k_cache)
+    valid = jnp.arange(M)[None, None, None, :] \
+        < n_valid[:, None, None, None]
+    scores = jnp.where(valid, scores, -1e30)
+    p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("shgm,shmd->shgd", p, v_cache)
+    return out.reshape(S, H, D)
 
 
 class MultiHeadAttention(HybridBlock):
@@ -96,6 +161,12 @@ class TransformerLM(HybridBlock):
                  **kwargs):
         super().__init__(**kwargs)
         self._max_len = max_len
+        self._vocab_size = vocab_size
+        self._units = units
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._num_kv_heads = num_kv_heads or num_heads
         with self.name_scope():
             self.embed = Embedding(vocab_size, units)
             self.pos_embed = Embedding(max_len, units)
@@ -120,3 +191,58 @@ class TransformerLM(HybridBlock):
         x = x + pos_e
         x = self.blocks(x)
         return self.head(self.ln_f(x))
+
+    def generative_spec(self):
+        """The decode-path export for ``mxnet_tpu.serving.generate``:
+        ``{"config": {...}, "params": {...}}`` with every weight a raw
+        device array (the gluon wrapper stripped), so the generative
+        engine can jit fixed-shape prefill/decode programs over a plain
+        pytree.  Param layout follows the block's own math — Dense
+        stores ``(units, in_units)`` (``y = x @ W.T + b``).
+
+        Deferred parameters are materialized by one dummy forward, so
+        an untrained (initialized-only) block exports cleanly for
+        warmup/benchmark use."""
+        from ... import ndarray as _nd
+
+        def _raw(param):
+            try:
+                return param.data()._data
+            except DeferredInitializationError:
+                self(_nd.zeros((1, 2)))
+                return param.data()._data
+
+        layers = []
+        for cell in self.blocks._children.values():
+            ffn = list(cell.ffn._children.values())
+            layers.append({
+                "ln1_g": _raw(cell.ln1.gamma),
+                "ln1_b": _raw(cell.ln1.beta),
+                "wq": _raw(cell.attn.q_proj.weight),
+                "wk": _raw(cell.attn.k_proj.weight),
+                "wv": _raw(cell.attn.v_proj.weight),
+                "wo": _raw(cell.attn.out_proj.weight),
+                "ln2_g": _raw(cell.ln2.gamma),
+                "ln2_b": _raw(cell.ln2.beta),
+                "w1": _raw(ffn[0].weight), "b1": _raw(ffn[0].bias),
+                "w2": _raw(ffn[1].weight), "b2": _raw(ffn[1].bias),
+            })
+        params = {
+            "embed": _raw(self.embed.weight),
+            "pos_embed": _raw(self.pos_embed.weight),
+            "layers": layers,
+            "ln_f_g": _raw(self.ln_f.gamma),
+            "ln_f_b": _raw(self.ln_f.beta),
+            "head_w": _raw(self.head.weight),
+            "head_b": _raw(self.head.bias),
+        }
+        config = {
+            "vocab_size": self._vocab_size,
+            "units": self._units,
+            "hidden_size": self._hidden_size,
+            "num_layers": self._num_layers,
+            "num_heads": self._num_heads,
+            "num_kv_heads": self._num_kv_heads,
+            "max_len": self._max_len,
+        }
+        return {"config": config, "params": params}

@@ -8,10 +8,14 @@ backpressure, worker fault isolation, and a /stats metrics snapshot.
 Multi-tenant hardening: per-model admission quotas + executor-cache
 reservations, priority-classed SLO load-shedding with a declared
 brownout mode, and canary staged promotion with health-gated
-auto-rollback (``canary.py``).
+auto-rollback (``canary.py``).  Generative serving (``generate/``):
+KV-cache incremental decode with continuous batching, sequence-bucket
+prefill through the same executor cache, and streaming SLOs
+(``ModelServer.infer_stream``).
 See ``docs/faq/serving.md`` for architecture and knobs.
 """
-from .bucketing import pick_bucket, shape_buckets  # noqa: F401
+from .bucketing import (pick_bucket, pick_grid_bucket,  # noqa: F401
+                        prefill_grid, seq_buckets, shape_buckets)
 from .cache import ExecutorCache  # noqa: F401
 from .canary import CanaryState  # noqa: F401
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
@@ -19,6 +23,8 @@ from .errors import (BadRequest, DeadlineExceeded, ModelNotFound,  # noqa: F401
 from .fleet import (FleetFrontDoor, ReplicaHandle,  # noqa: F401
                     decode_error, encode_error, local_replica,
                     replica_loop, spawn_replica)
+from .generate import (DecodeScheduler, DecodeState,  # noqa: F401
+                       GenerativeModel, TokenStream)
 from .manifest import WarmupManifest  # noqa: F401
 from .registry import (CheckpointWatcher, ModelRegistry,  # noqa: F401
                        ModelVersion)
@@ -28,6 +34,8 @@ __all__ = ["ModelServer", "ModelRegistry", "ModelVersion", "ExecutorCache",
            "InferenceFuture", "CanaryState", "ServingError",
            "ModelNotFound", "QueueFull", "DeadlineExceeded", "ServerClosed",
            "BadRequest", "CheckpointWatcher", "WarmupManifest",
-           "shape_buckets", "pick_bucket", "FleetFrontDoor",
+           "shape_buckets", "pick_bucket", "seq_buckets", "prefill_grid",
+           "pick_grid_bucket", "GenerativeModel", "DecodeScheduler",
+           "DecodeState", "TokenStream", "FleetFrontDoor",
            "ReplicaHandle", "replica_loop", "local_replica",
            "spawn_replica", "encode_error", "decode_error"]

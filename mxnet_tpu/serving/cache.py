@@ -75,7 +75,16 @@ class ExecutorCache:
             "set exceeds MXNET_SERVING_EXECUTOR_CACHE and steady-state "
             "traffic is recompiling")
 
-    def get(self, entry, bucket):
+    @staticmethod
+    def _norm_bucket(bucket):
+        """Bucket keys are ints (batch rungs) or int tuples (the
+        generative prefill grid's (batch, length) cells) — one cache,
+        one LRU/quota policy, for both working sets."""
+        if isinstance(bucket, (tuple, list)):
+            return tuple(int(b) for b in bucket)
+        return int(bucket)
+
+    def get(self, entry, bucket, binder=None):
         """The bound predictor for ``entry`` (a ModelVersion) at
         ``bucket`` rows, binding (compiling) on miss.
 
@@ -85,14 +94,20 @@ class ExecutorCache:
         requests would then hit — old weights would serve new traffic
         silently.  The cached value holds the entry itself, so the id
         in a live key can never be recycled onto a different
-        ModelVersion by the allocator."""
+        ModelVersion by the allocator.
+
+        ``binder`` overrides the miss-path bind: the generative engine
+        caches jitted prefill programs keyed on (batch, length) grid
+        cells through the SAME machinery (LRU, per-model quotas,
+        manifest miss hook) — a miss is a compile there too."""
         # graftfault: a failed lookup/bind poisons only the batch that
         # needed it (worker_scope delivers to its futures); the batcher
         # and every cached entry keep serving
+        bucket = self._norm_bucket(bucket)
         if _fault.ACTIVE[0]:
             _fault.fire("serving.cache.get", model=entry.name,
-                        bucket=int(bucket))
-        key = (entry.name, entry.version, id(entry), int(bucket))
+                        bucket=bucket)
+        key = (entry.name, entry.version, id(entry), bucket)
         with self._lock:
             cached = self._entries.get(key)
             if cached is not None:
@@ -104,9 +119,12 @@ class ExecutorCache:
                 return cached[1]
         # bind OUTSIDE the lock: a compile can take seconds and must not
         # stall concurrent lookups of already-cached buckets
-        pred = Predictor.from_parts(entry.symbol, entry.arg_params,
-                                    entry.aux_params,
-                                    entry.full_shapes(bucket))
+        if binder is not None:
+            pred = binder()
+        else:
+            pred = Predictor.from_parts(entry.symbol, entry.arg_params,
+                                        entry.aux_params,
+                                        entry.full_shapes(bucket))
         with self._lock:
             race = self._entries.get(key)
             if race is not None:        # another thread bound it first

@@ -1,0 +1,28 @@
+"""mxnet_tpu.serving.generate — generative inference engine.
+
+Turns the one-shot ``ModelServer`` into an autoregressive token
+service, reproducing the reference ``BucketingModule`` story
+TPU-natively and extending it past one-shot inference:
+
+- ``kv_cache.DecodeState`` — preallocated ring-buffer KV-cache so the
+  decode loop is ONE compiled program at every sequence position;
+- ``model.GenerativeModel`` — the prefill grid / admit / decode
+  program families over a ``TransformerLM.generative_spec()`` export,
+  prefill cells bound through the server's ``ExecutorCache`` +
+  ``WarmupManifest``;
+- ``scheduler.DecodeScheduler`` — continuous batching (slots
+  join/leave per STEP), priority classes, per-tenant slot quotas,
+  token-priced brownout, per-tenant exactly-once ledgers;
+- ``stream.TokenStream`` — the ``infer_stream`` handle: iterate tokens
+  as they decode, with TTFT / per-token SLO stamps.
+
+Entry points on ``ModelServer``: ``add_generative_model(...)`` then
+``infer_stream(...)``; ``docs/faq/serving.md`` has the walk-through.
+"""
+from .kv_cache import DecodeState  # noqa: F401
+from .model import GenerativeModel  # noqa: F401
+from .scheduler import DecodeScheduler  # noqa: F401
+from .stream import TokenStream  # noqa: F401
+
+__all__ = ["DecodeState", "GenerativeModel", "DecodeScheduler",
+           "TokenStream"]

@@ -1,0 +1,300 @@
+"""GenerativeModel — the compiled-program surface of generation.
+
+Three program families, each deliberately fixed-shape:
+
+- **prefill** — full causal forward over a padded ``(batch, length)``
+  grid cell, returning the first sampled token and the prompt's
+  per-layer K/V history.  One program per grid cell
+  (``bucketing.prefill_grid``), bound THROUGH the server's
+  ``ExecutorCache`` via the ``binder`` seam: prefill programs share
+  the same LRU, per-model quota, miss counter (miss == recompile) and
+  ``WarmupManifest`` miss hook as the one-shot models' executors — a
+  restarted replica re-warms exactly the grid cells live traffic used.
+- **admit** — copy one prompt's K/V rows into a decode slot
+  (``lax.dynamic_update_slice`` at a traced slot index).  One program
+  per LENGTH rung (the slot index is data, not shape).
+- **decode** — ONE jitted step for the whole slot pool: embed the last
+  token of every slot, write this position's K/V at ``cursor %
+  max_len``, attend via
+  ``gluon.contrib.transformer.cached_attention_step`` (validity-masked
+  ring), greedy-sample the next token.  Sequence position is data
+  (``cursor`` vector), so the program never recompiles as generations
+  advance — the jit-cache-flatness the bench asserts.
+
+Weights are traced arguments (a pytree), not closed-over constants:
+a hot-swapped checkpoint of the same architecture reuses every
+compiled program, which is what keeps ``symbol_sha`` — a hash of the
+ARCHITECTURE, not the weights — the right manifest identity (same
+contract as ``serving/manifest.py``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+from ...gluon.contrib.transformer import (cached_attention_step,
+                                          causal_attention)
+from ..bucketing import (pick_grid_bucket, prefill_grid, seq_buckets,
+                         shape_buckets)
+from .kv_cache import DecodeState
+
+__all__ = ["GenerativeModel"]
+
+
+def _ln(x, g, b):
+    import jax.numpy as jnp
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-5) * g + b
+
+
+def _jit_compiles(fn):
+    """Compiled-variant count of a jitted callable — the same exact
+    probe ``executor.py`` uses (``_cache_size``); 0 when the jax
+    version hides it (flatness checks then lean on the executor-cache
+    miss counter alone)."""
+    size = getattr(fn, "_cache_size", None)
+    try:
+        return int(size()) if size is not None else 0
+    except Exception:
+        return 0        # probe is diagnostic only; never poison serving
+
+
+class GenerativeModel:
+    """One generative deployment: weights + ladders + programs.
+
+    ``spec`` is ``TransformerLM.generative_spec()`` (or a block, which
+    is exported on the spot).  Duck-types the slice of ``ModelVersion``
+    the executor cache and warmup manifest key on (``name``,
+    ``version``, ``symbol_sha``, ``sample_shapes``).
+    """
+
+    def __init__(self, name, spec, max_len=None, prefill_batch=None,
+                 eos_id=None, version=1):
+        from ... import config as _cfg
+        if hasattr(spec, "generative_spec"):
+            spec = spec.generative_spec()
+        self.name = str(name)
+        self.version = int(version)
+        self.config = dict(spec["config"])
+        self.params = spec["params"]
+        self.eos_id = eos_id
+        # the KV window: prompts and attention history are capped here;
+        # defaults to the model's positional table so ring wrap-around
+        # is opt-in (a window shorter than the table slides)
+        self.max_len = int(max_len if max_len is not None
+                           else self.config["max_len"])
+        if prefill_batch is None:
+            prefill_batch = _cfg.get("MXNET_SERVING_GEN_PREFILL_BATCH")
+        self.batch_ladder = shape_buckets(int(prefill_batch))
+        self.len_ladder = seq_buckets(self.max_len)
+        self.symbol_sha = self._arch_sha(self.config)
+        self.sample_shapes = {"tokens": (1, 1)}
+        self._decode_jit = None         # guarded-by: _lock
+        self._admit_jits = {}           # guarded-by: _lock — rung -> jit
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _arch_sha(config):
+        doc = json.dumps(config, sort_keys=True).encode("utf-8")
+        return hashlib.sha256(doc).hexdigest()
+
+    # -- geometry ----------------------------------------------------
+
+    @property
+    def head_dim(self):
+        return self.config["units"] // self.config["num_heads"]
+
+    def make_state(self, slots):
+        return DecodeState(slots, self.config["num_layers"],
+                           self.config["num_kv_heads"], self.max_len,
+                           self.head_dim)
+
+    def kv_bytes_per_slot(self):
+        return DecodeState.kv_bytes(self.config["num_layers"],
+                                    self.config["num_kv_heads"],
+                                    self.max_len, self.head_dim)
+
+    def param_bytes(self):
+        import numpy as np
+        total = 0
+        for leaf in self._leaves(self.params):
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        return total
+
+    @classmethod
+    def _leaves(cls, tree):
+        if isinstance(tree, dict):
+            for v in tree.values():
+                yield from cls._leaves(v)
+        elif isinstance(tree, (list, tuple)):
+            for v in tree:
+                yield from cls._leaves(v)
+        else:
+            yield tree
+
+    def grid(self):
+        return prefill_grid(self.batch_ladder, self.len_ladder)
+
+    def pick_cell(self, rows, length):
+        return pick_grid_bucket(rows, length, self.batch_ladder,
+                                self.len_ladder)
+
+    # -- prefill (through the ExecutorCache) -------------------------
+
+    def prefill(self, exec_cache, cell, tokens_padded, lengths):
+        """Run one padded prefill through the server's executor cache.
+
+        ``tokens_padded``: int32 ``[cell_b, cell_t]``; ``lengths``:
+        int32 ``[cell_b]`` (real prompt lengths; padded rows carry 0s
+        and a length of 1 so their garbage stays finite and ignored).
+        Returns ``(first_tokens [b], k_hist, v_hist)`` with the
+        histories ``[layers, b, kv_heads, cell_t, head_dim]``."""
+        fn = exec_cache.get(self, cell, binder=self._bind_prefill)
+        return fn(self.params, tokens_padded, lengths)
+
+    def _bind_prefill(self):
+        # a FRESH jit object per grid cell: the cache entry owns its
+        # compiled program outright, so eviction really frees it and a
+        # re-bind really recompiles — the miss counter stays an honest
+        # recompile counter
+        import jax
+        return jax.jit(self._prefill_impl)
+
+    def _prefill_impl(self, params, tokens, lengths):
+        import jax.numpy as jnp
+        cfg = self.config
+        H, Hkv = cfg["num_heads"], cfg["num_kv_heads"]
+        D = self.head_dim
+        B, T = tokens.shape
+        pos = jnp.minimum(jnp.arange(T), cfg["max_len"] - 1)
+        x = params["embed"][tokens] + params["pos_embed"][pos][None]
+        ks, vs = [], []
+        for L in params["layers"]:
+            h = _ln(x, L["ln1_g"], L["ln1_b"])
+            q = (h @ L["wq"].T).reshape(B, T, H, D)
+            k = (h @ L["wk"].T).reshape(B, T, Hkv, D)
+            v = (h @ L["wv"].T).reshape(B, T, Hkv, D)
+            ks.append(k.transpose(0, 2, 1, 3))
+            vs.append(v.transpose(0, 2, 1, 3))
+            o = causal_attention(q, k, v).reshape(B, T, -1)
+            x = x + o @ L["wo"].T
+            h = _ln(x, L["ln2_g"], L["ln2_b"])
+            h = jnp.maximum(h @ L["w1"].T + L["b1"], 0.0)
+            x = x + h @ L["w2"].T + L["b2"]
+        x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+        last = x[jnp.arange(B), lengths - 1]
+        logits = last @ params["head_w"].T + params["head_b"]
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return first, jnp.stack(ks), jnp.stack(vs)
+
+    # -- admit -------------------------------------------------------
+
+    def admit(self, state, slot, k_row, v_row):
+        """Write one prompt's K/V history (``[layers, kv_heads, t,
+        head_dim]``) into decode slot ``slot`` — one compiled program
+        per length rung (the slot index is a traced scalar)."""
+        import numpy as np
+        rung = int(k_row.shape[2])
+        with self._lock:
+            fn = self._admit_jits.get(rung)
+            if fn is None:
+                import jax
+                fn = jax.jit(self._admit_impl)
+                self._admit_jits[rung] = fn
+        state.k, state.v = fn(state.k, state.v, k_row, v_row,
+                              np.int32(slot))
+
+    def _admit_impl(self, k_cache, v_cache, k_row, v_row, slot):
+        import jax
+        return (jax.lax.dynamic_update_slice(
+                    k_cache, k_row[:, None], (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                    v_cache, v_row[:, None], (0, slot, 0, 0, 0)))
+
+    # -- decode ------------------------------------------------------
+
+    def decode_step(self, state):
+        """One continuous-batching step over the WHOLE slot pool:
+        every active slot advances one token; free slots ride along as
+        masked lanes (their lanes are ignored, and keeping them in the
+        batch is what keeps the program count at one).  Returns the
+        next token per slot as int32 numpy ``[slots]``; host-side
+        cursor commits are the scheduler's job (per-slot fault
+        isolation decides which lanes actually advance)."""
+        import numpy as np
+        with self._lock:
+            if self._decode_jit is None:
+                import jax
+                self._decode_jit = jax.jit(self._decode_impl)
+            fn = self._decode_jit
+        nxt, state.k, state.v = fn(self.params, state.k, state.v,
+                                   state.tokens, state.cursor)
+        return np.asarray(nxt)
+
+    def _decode_impl(self, params, k, v, tokens, cursor):
+        import jax.numpy as jnp
+        cfg = self.config
+        H, Hkv = cfg["num_heads"], cfg["num_kv_heads"]
+        D = self.head_dim
+        M = self.max_len
+        S = tokens.shape[0]
+        x = params["embed"][tokens]
+        # position is DATA: clamp at the table edge past the window
+        # (ring approximation documented in kv_cache.py)
+        x = x + params["pos_embed"][jnp.minimum(cursor,
+                                                cfg["max_len"] - 1)]
+        write = (cursor % M).astype(jnp.int32)
+        n_valid = jnp.minimum(cursor + 1, M)
+        s_idx = jnp.arange(S)[:, None]
+        h_idx = jnp.arange(Hkv)[None, :]
+        w_idx = write[:, None]
+        for li, L in enumerate(params["layers"]):
+            h = _ln(x, L["ln1_g"], L["ln1_b"])
+            q = (h @ L["wq"].T).reshape(S, H, D)
+            kn = (h @ L["wk"].T).reshape(S, Hkv, D)
+            vn = (h @ L["wv"].T).reshape(S, Hkv, D)
+            k = k.at[li, s_idx, h_idx, w_idx].set(kn)
+            v = v.at[li, s_idx, h_idx, w_idx].set(vn)
+            o = cached_attention_step(q, k[li], v[li], n_valid)
+            x = x + o.reshape(S, -1) @ L["wo"].T
+            h = _ln(x, L["ln2_g"], L["ln2_b"])
+            h = jnp.maximum(h @ L["w1"].T + L["b1"], 0.0)
+            x = x + h @ L["w2"].T + L["b2"]
+        x = _ln(x, params["ln_f_g"], params["ln_f_b"])
+        logits = x @ params["head_w"].T + params["head_b"]
+        return jnp.argmax(logits, -1).astype(jnp.int32), k, v
+
+    # -- warmup + accounting -----------------------------------------
+
+    def warmup(self, exec_cache, state, grid=None):
+        """Compile the full working set up front: every prefill grid
+        cell (through the executor cache, so the manifest records
+        them), one admit program per length rung, and the decode step.
+        ``grid`` narrows to a manifest-replayed working set."""
+        import numpy as np
+        cells = list(grid) if grid is not None else self.grid()
+        for (b, t) in cells:
+            toks = np.zeros((b, t), np.int32)
+            lens = np.ones(b, np.int32)
+            first, k_hist, v_hist = self.prefill(exec_cache, (b, t),
+                                                 toks, lens)
+            self.admit(state, 0, np.asarray(k_hist)[:, 0],
+                       np.asarray(v_hist)[:, 0])
+        state.release(0)
+        self.decode_step(state)
+        return len(cells)
+
+    def compile_stats(self):
+        """Compiled-variant counts of the decode/admit programs — what
+        the bench snapshots before and after 1k steps to assert
+        jit-cache flatness (prefill compiles are the executor cache's
+        miss counter)."""
+        with self._lock:
+            decode = (_jit_compiles(self._decode_jit)
+                      if self._decode_jit is not None else 0)
+            admit = sum(_jit_compiles(f)
+                        for f in self._admit_jits.values())
+            return {"decode_compiles": decode, "admit_compiles": admit,
+                    "admit_programs": len(self._admit_jits)}

@@ -69,8 +69,18 @@ class WarmupManifest:
                     self.path, exc)
 
     @staticmethod
-    def _key(entry):
-        return (entry["model"], entry["symbol_sha256"], int(entry["bucket"]),
+    def _bucket(value):
+        """Bucket keys mirror the executor cache's: an int batch rung,
+        or an int tuple for the generative prefill grid's (batch,
+        length) cells (serialized as a JSON list)."""
+        if isinstance(value, (tuple, list)):
+            return tuple(int(v) for v in value)
+        return int(value)
+
+    @classmethod
+    def _key(cls, entry):
+        return (entry["model"], entry["symbol_sha256"],
+                cls._bucket(entry["bucket"]),
                 entry.get("dtype", "float32"), entry.get("backend", ""))
 
     def record(self, entry, bucket, backend=None, dtype="float32"):
@@ -79,12 +89,14 @@ class WarmupManifest:
         was."""
         if backend is None:
             backend = _default_backend()
+        bucket = self._bucket(bucket)
         rec = {
             "model": entry.name,
             "version": entry.version,
             "symbol_sha256": entry.symbol_sha,
-            "bucket": int(bucket),
-            "batch": int(bucket),
+            "bucket": list(bucket) if isinstance(bucket, tuple)
+                      else bucket,
+            "batch": bucket[0] if isinstance(bucket, tuple) else bucket,
             "dtype": dtype,
             "backend": backend,
             "sample_shapes": {k: list(s)
@@ -104,9 +116,10 @@ class WarmupManifest:
 
     def _commit_locked(self):
         doc = {"schema": _SCHEMA,
-               "entries": sorted(self._entries.values(),
-                                 key=lambda e: (e["model"], e["bucket"],
-                                                e["backend"]))}
+               "entries": sorted(
+                   self._entries.values(),
+                   key=lambda e: (e["model"], self._sort_bucket(e["bucket"]),
+                                  e["backend"]))}
         try:
             atomic_write(self.path,
                          json.dumps(doc, indent=1).encode("utf-8"))
@@ -118,32 +131,74 @@ class WarmupManifest:
         with self._lock:
             return [dict(e) for e in self._entries.values()]
 
+    @classmethod
+    def _sort_bucket(cls, value):
+        """Total order over mixed bucket kinds: int rungs first, then
+        grid cells, each in natural order."""
+        b = cls._bucket(value)
+        return (1, b) if isinstance(b, tuple) else (0, (b,))
+
     def buckets_for(self, name, symbol_sha, backend=None):
-        """Sorted buckets recorded for this (model name, program) —
+        """Sorted INT buckets recorded for this (model name, program) —
         what a restarted replica should warm.  ``backend`` narrows to
         entries recorded on that backend (None accepts any: a manifest
         written on TPU still names the right buckets on CPU; only the
-        disk-cache hit is lost)."""
+        disk-cache hit is lost).  Generative (batch, length) grid cells
+        live in :meth:`grid_for`."""
         with self._lock:
-            return sorted({e["bucket"] for e in self._entries.values()
+            return sorted({self._bucket(e["bucket"])
+                           for e in self._entries.values()
                            if e["model"] == name
                            and e["symbol_sha256"] == symbol_sha
+                           and not isinstance(e["bucket"], (tuple, list))
+                           and (backend is None
+                                or e["backend"] == backend)})
+
+    def grid_for(self, name, symbol_sha, backend=None):
+        """Sorted (batch, length) grid cells recorded for this (model
+        name, program) — the prefill working set a restarted generative
+        replica should warm."""
+        with self._lock:
+            return sorted({self._bucket(e["bucket"])
+                           for e in self._entries.values()
+                           if e["model"] == name
+                           and e["symbol_sha256"] == symbol_sha
+                           and isinstance(e["bucket"], (tuple, list))
                            and (backend is None
                                 or e["backend"] == backend)})
 
     def ladders(self):
-        """Every recorded working set as a ladder:
+        """Every recorded INT working set as a ladder:
         ``{"model@sha12": sorted buckets}`` — the graftplan feed
         (``ModelServer.plan_spec``), so bucket-plan-waste judges the
         ladders a restarted replica will actually warm, not just the
-        configured default."""
+        configured default.  Grid cells are the generative working set,
+        reported separately by :meth:`grid_ladders`."""
         with self._lock:
             out = {}
             for e in self._entries.values():
+                if isinstance(e["bucket"], (tuple, list)):
+                    continue
                 key = "%s@%s" % (e["model"],
                                  str(e["symbol_sha256"])[:12])
                 out.setdefault(key, set()).add(int(e["bucket"]))
         return {k: sorted(v) for k, v in sorted(out.items())}
+
+    def grid_ladders(self):
+        """Every recorded (batch, length) working set:
+        ``{"model@sha12": sorted [batch, length] cells}`` — the
+        generative counterpart of :meth:`ladders`, judged by the plan
+        checkers' generative economics pass."""
+        with self._lock:
+            out = {}
+            for e in self._entries.values():
+                if not isinstance(e["bucket"], (tuple, list)):
+                    continue
+                key = "%s@%s" % (e["model"],
+                                 str(e["symbol_sha256"])[:12])
+                out.setdefault(key, set()).add(self._bucket(e["bucket"]))
+        return {k: [list(c) for c in sorted(v)]
+                for k, v in sorted(out.items())}
 
     def __len__(self):
         with self._lock:

@@ -280,6 +280,8 @@ class ModelServer:
             config.get("MXNET_SERVING_BROWNOUT_REJECT_CLASS"))
         self._brownout = False          # guarded-by: _cv
         self._brownout_entered = 0      # guarded-by: _cv
+        # -- generative serving (serving/generate/) ------------------------
+        self._generative = {}           # guarded-by: _cv — name -> sched
         # -- canary staged promotion ---------------------------------------
         self._canary_fraction = float(
             canary_fraction if canary_fraction is not None
@@ -674,6 +676,13 @@ class ModelServer:
             self._drain = bool(drain)
             self._cv.notify_all()
             t = self._thread
+            gens = list(self._generative.values())
+        # generative decode loops stop alongside the batcher; their
+        # pending/running streams settle terminally (failed) so the
+        # per-tenant ledgers balance across a stop, same contract as
+        # the leftover sweep below
+        for sched in gens:
+            sched.stop(drain=drain)
         if t is not None:
             t.join(timeout=60.0)
         with self._cv:
@@ -975,6 +984,106 @@ class ModelServer:
             if recorded:
                 bucket_list = recorded
         return self._warm([(entry, bucket_list)], timeout_ms)
+
+    # -- generative serving (serving/generate/) -----------------------------
+
+    def add_generative_model(self, name, spec, slots=None, max_len=None,
+                             prefill_batch=None, eos_id=None,
+                             queue_depth=None, brownout_ms=None,
+                             version=1):
+        """Register a generative deployment: ``spec`` is a
+        ``TransformerLM`` block (or its ``generative_spec()`` export).
+        Allocates the slot pool's KV-cache up front and wires the
+        model's prefill grid through THIS server's executor cache and
+        warmup manifest — generative and one-shot tenants share one
+        LRU, one quota policy, one recompile counter, one restart
+        working set.  Returns the model's ``DecodeScheduler``.
+
+        Call :meth:`warmup_generative` (or let the first requests pay
+        the compiles) before latency-sensitive traffic."""
+        from .generate import DecodeScheduler, GenerativeModel
+        if max_len is None:
+            knob = int(config.get("MXNET_SERVING_GEN_MAX_LEN"))
+            max_len = knob if knob > 0 else None
+        gm = GenerativeModel(name, spec, max_len=max_len,
+                             prefill_batch=prefill_batch, eos_id=eos_id,
+                             version=version)
+        sched = DecodeScheduler(gm, self.cache, slots=slots,
+                                queue_depth=queue_depth,
+                                brownout_ms=brownout_ms)
+        with self._cv:
+            if name in self._generative:
+                raise ValueError(
+                    "generative model %r already registered; stop it "
+                    "first (one scheduler owns one slot pool)" % name)
+            if self._stopping:
+                raise ServerClosed("server is stopping")
+            self._generative[name] = sched
+        return sched
+
+    def _gen_sched(self, name):
+        with self._cv:
+            sched = self._generative.get(name)
+        if sched is None:
+            raise ModelNotFound(
+                "no generative model %r (add_generative_model first; "
+                "one-shot models use infer/infer_async)" % name)
+        return sched
+
+    def infer_stream(self, name, prompt, max_new_tokens=None,
+                     priority=None, tenant="default", timeout_ms=None):
+        """Submit one generation; returns a ``TokenStream`` yielding
+        token ids as decode steps commit them (``for tok in stream``)
+        or collecting the sequence with ``stream.result()``.
+
+        ``priority`` uses the PR 15 classes (0 = most important;
+        higher classes shed first under brownout), ``tenant`` scopes
+        the exactly-once ledger and any decode-slot quota, and
+        ``timeout_ms`` is an end-to-end deadline — a generation that
+        overruns it mid-decode frees its slot and the stream raises
+        ``DeadlineExceeded`` semantics via its terminal state."""
+        return self._gen_sched(name).submit(
+            prompt, max_new_tokens=max_new_tokens, priority=priority,
+            tenant=tenant, timeout_ms=timeout_ms)
+
+    def set_slot_quota(self, name, tenant, slots):
+        """Cap ``tenant``'s concurrently-held decode slots on
+        generative model ``name`` — the slot-pool member of the quota
+        family (queue/inflight/cache quotas: :meth:`set_quota`)."""
+        self._gen_sched(name).set_slot_quota(tenant, slots)
+
+    def warmup_generative(self, name=None, from_manifest=False):
+        """Compile every generative program before traffic: the
+        prefill (batch, length) grid — through the executor cache, so
+        cells land in the warmup manifest — plus the admit-per-rung
+        and single decode-step programs.  ``from_manifest=True``
+        narrows prefill to the grid cells a previous run recorded
+        (``WarmupManifest.grid_for``), the generative analogue of
+        :meth:`warmup_from_manifest`.  Returns ``{name: cells
+        warmed}``."""
+        with self._cv:
+            items = {n: s for n, s in sorted(self._generative.items())
+                     if name is None or n == name}
+        if name is not None and not items:
+            raise ModelNotFound("no generative model %r" % name)
+        warmed = {}
+        for n, sched in items.items():
+            grid = None
+            if from_manifest and self.manifest is not None:
+                recorded = self.manifest.grid_for(
+                    n, sched.model.symbol_sha)
+                on_grid = [c for c in recorded
+                           if c in set(sched.model.grid())]
+                dropped = sorted(set(recorded) - set(on_grid))
+                if dropped:
+                    import logging
+                    logging.warning(
+                        "manifest grid cells %s for generative model "
+                        "%r are off the current grid (ladder drift); "
+                        "skipping them", dropped, n)
+                grid = on_grid or None
+            warmed[n] = sched.warmup(grid=grid)
+        return warmed
 
     def _enter_steady_state(self):
         """After a completed warmup plan the server is steady-state by
@@ -1377,9 +1486,27 @@ class ModelServer:
         ``stats()["batches"]["occupancy"]``."""
         manifest_ladders = (self.manifest.ladders()
                             if self.manifest is not None else {})
+        with self._cv:
+            gens = dict(self._generative)
+        generative = {}
+        for n, sched in sorted(gens.items()):
+            gm = sched.model
+            generative[n] = {
+                "slots": int(sched.slots),
+                "max_len": int(gm.max_len),
+                "max_new_tokens": int(sched.default_new_tokens),
+                "batch_ladder": list(gm.batch_ladder),
+                "len_ladder": list(gm.len_ladder),
+                "kv_bytes_per_slot": int(gm.kv_bytes_per_slot()),
+                "param_bytes": int(gm.param_bytes()),
+            }
         return {"ladder": list(self._buckets),
                 "max_batch": int(self._max_batch),
-                "manifest_ladders": manifest_ladders}
+                "manifest_ladders": manifest_ladders,
+                "generative": generative,
+                "manifest_grid_ladders": (
+                    self.manifest.grid_ladders()
+                    if self.manifest is not None else {})}
 
     def stats(self):
         """One consistent /stats snapshot (all counters since start).
@@ -1480,4 +1607,9 @@ class ModelServer:
             "entries": len(self.manifest),
         } if self.manifest is not None else None
         snap["models"] = self.registry.describe()
+        with self._cv:
+            gens = dict(self._generative)
+        if gens:
+            snap["generative"] = {n: s.stats()
+                                  for n, s in sorted(gens.items())}
         return snap

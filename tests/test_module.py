@@ -271,3 +271,57 @@ def test_bucketing_disables_exec_fusion():
     plain.init_params(mx.init.Xavier())
     plain.init_optimizer(kvstore="tpu", optimizer="sgd")
     assert plain._fused_exec_update is True
+
+
+def test_bucketing_shared_executor_state_no_cross_eviction():
+    """The shared-executor ownership seam (module.py init_optimizer):
+    per-length buckets each own a compiled program but SHARE optimizer
+    state and parameter buffers — revisiting a bucket must reuse its
+    program (no cross-eviction between buckets' jit caches) and an
+    update through one bucket must be visible through the other."""
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        pooled = mx.sym.mean(data, axis=1, keepdims=True)
+        fc = mx.sym.FullyConnected(pooled, name="fc", num_hidden=4)
+        return (mx.sym.SoftmaxOutput(fc, name="softmax"),
+                ["data"], ["softmax_label"])
+
+    def batch(seq_len):
+        return mx.io.DataBatch(
+            data=[nd.ones((4, seq_len))], label=[nd.zeros((4,))],
+            bucket_key=seq_len,
+            provide_data=[("data", (4, seq_len))],
+            provide_label=[("softmax_label", (4,))])
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    for seq_len in (8, 4, 8, 4):
+        mod.forward_backward(batch(seq_len))
+        mod.update()
+    mods = mod._buckets
+    assert set(mods) == {8, 4}
+    # ONE optimizer/updater for all buckets (borrow_optimizer), so
+    # momentum state is keyed by parameter, not by bucket
+    assert mods[4]._optimizer is mods[8]._optimizer
+    assert mods[4]._updater is mods[8]._updater
+    # each bucket's executor is its own jit cache entry; revisiting
+    # must not have recompiled or evicted the other bucket's program
+    execs = {k: m._exec_group.execs[0] for k, m in mods.items()}
+    assert execs[4] is not execs[8]
+    sizes = {k: e._jit_fb._cache_size() for k, e in execs.items()}
+    for seq_len in (8, 4, 8, 4):
+        mod.forward_backward(batch(seq_len))
+        mod.update()
+    assert {k: e._jit_fb._cache_size()
+            for k, e in execs.items()} == sizes
+    assert mod._buckets[4] is mods[4] and mod._buckets[8] is mods[8]
+    # shared weight buffers: the update stream through alternating
+    # buckets left ONE coherent set of params
+    w4 = mods[4].get_params()[0]["fc_weight"].asnumpy()
+    w8 = mods[8].get_params()[0]["fc_weight"].asnumpy()
+    assert (w4 == w8).all()

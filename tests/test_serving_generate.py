@@ -1,0 +1,460 @@
+"""Generative serving (ISSUE 17) — serving/generate/: KV-cache decode,
+sequence buckets, continuous batching with streaming SLOs.
+
+Reference analogues: vLLM/Orca-style iteration-level scheduling (admit
+into free slots every decode step — no convoying behind a long
+generation), TF-Serving's padded-bucket contract extended to the
+(batch, length) prefill grid, and the threaded engine's exception
+isolation (a poisoned slot fails its own stream; the pool survives).
+
+The tier-1 pins: greedy decode through the ring-buffer KV cache
+matches the step-by-step gluon oracle token for token; short requests
+admitted beside a long generation all complete while it is STILL in
+flight (the deterministic no-convoy proof); the executor-cache miss
+count and the decode/admit jit caches stay FLAT after warmup; typed
+rejections (BadRequest / QueueFull / DeadlineExceeded) keep per-tenant
+ledgers exactly-once balanced.  The slow leg drills
+``serving.decode.step`` and asserts poisoned-slot isolation.
+"""
+import time
+import types
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import fault, nd
+from mxnet_tpu.gluon.contrib.transformer import (TransformerLM,
+                                                 cached_attention_step,
+                                                 causal_attention)
+from mxnet_tpu.serving import (BadRequest, DeadlineExceeded, DecodeScheduler,
+                               DecodeState, ExecutorCache, GenerativeModel,
+                               ModelNotFound, ModelServer, QueueFull,
+                               ServerClosed, pick_grid_bucket, prefill_grid,
+                               seq_buckets)
+
+VOCAB = 32
+MAXLEN = 16
+
+
+def _block(max_len=MAXLEN):
+    blk = TransformerLM(vocab_size=VOCAB, units=16, hidden_size=32,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        max_len=max_len)
+    blk.initialize()
+    return blk
+
+
+def _server(slots=4, max_len=MAXLEN, prefill_batch=2, warm=True, **kw):
+    srv = ModelServer(cache_size=64)
+    srv.add_generative_model("lm", _block(max_len), slots=slots,
+                             max_len=max_len, prefill_batch=prefill_batch,
+                             **kw)
+    if warm:
+        srv.warmup_generative()
+    return srv
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(
+        1, VOCAB - 1, size=n).astype(np.int32)
+
+
+def _ref_greedy(blk, prompt, n_new):
+    """The oracle: full forward over the growing sequence, greedy
+    argmax of the last position (valid while len stays in-window)."""
+    toks = list(int(t) for t in prompt)
+    out = []
+    for _ in range(n_new):
+        logits = blk(nd.array(np.array([toks], np.int32))).asnumpy()
+        nxt = int(logits[0, -1].argmax())
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# -- ladders and the prefill grid ---------------------------------------------
+def test_seq_bucket_ladder_and_grid():
+    assert seq_buckets(512) == [1, 2, 4, 8, 16, 32, 64, 128, 256, 512]
+    assert seq_buckets(48) == [1, 2, 4, 8, 16, 32, 48]  # capped rung
+    grid = prefill_grid([1, 4], [8, 16])
+    assert grid == [(1, 8), (1, 16), (4, 8), (4, 16)]
+    assert pick_grid_bucket(3, 9, [1, 4], [8, 16]) == (4, 16)
+    assert pick_grid_bucket(5, 8, [1, 4], [8, 16]) is None   # off-grid
+
+
+# -- the ring-buffer KV cache -------------------------------------------------
+def test_decode_state_ring_semantics():
+    st = DecodeState(slots=2, num_layers=1, num_kv_heads=2, max_len=4,
+                     head_dim=2)
+    assert st.free_slots() == [0, 1] and st.busy() == 0
+    st.occupy(0, prompt_len=3, first_token=7)
+    assert st.busy() == 1 and st.free_slots() == [1]
+    assert int(st.cursor[0]) == 3 and int(st.tokens[0]) == 7
+    # advance past the window: the cursor stays MONOTONIC (it is the
+    # total-written count; the write index is cursor % max_len)
+    for i, tok in enumerate((1, 2, 3)):
+        st.advance(0, tok)
+        assert int(st.cursor[0]) == 4 + i
+    assert int(st.cursor[0]) % 4 == 2      # wrapped
+    assert st.n_generated(0, prompt_len=3) == 3
+    with pytest.raises(RuntimeError):
+        st.occupy(0, 1, 0)                 # already occupied
+    with pytest.raises(ValueError):
+        st.occupy(1, 5, 0)                 # prompt exceeds the window
+    st.release(0)
+    assert st.free_slots() == [0, 1]
+    # KV bytes: 2 (k+v) * L * S * Hkv * M * D * itemsize
+    assert DecodeState.kv_bytes(num_layers=2, num_kv_heads=2, max_len=8,
+                                head_dim=4, slots=3) == 2 * 2 * 3 * 2 * 8 * 4 * 4
+
+
+def test_cached_attention_matches_full_causal():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(3)
+    B, T, H, HKV, D, M = 1, 6, 4, 2, 3, 8
+    q = rng.randn(B, T, H, D).astype(np.float32)
+    k = rng.randn(B, T, HKV, D).astype(np.float32)
+    v = rng.randn(B, T, HKV, D).astype(np.float32)
+    full = np.asarray(causal_attention(jnp.array(q), jnp.array(k),
+                                       jnp.array(v)))
+    # cache layout: [slots, heads, max_len, dim], valid prefix of T
+    kc = np.zeros((B, HKV, M, D), np.float32)
+    vc = np.zeros((B, HKV, M, D), np.float32)
+    kc[:, :, :T] = k.transpose(0, 2, 1, 3)
+    vc[:, :, :T] = v.transpose(0, 2, 1, 3)
+    step = np.asarray(cached_attention_step(
+        jnp.array(q[:, -1]), jnp.array(kc), jnp.array(vc),
+        jnp.full((B,), T, np.int32)))
+    np.testing.assert_allclose(step, full[:, -1], rtol=1e-5, atol=1e-5)
+
+
+# -- decode correctness -------------------------------------------------------
+def test_greedy_parity_with_gluon_oracle():
+    blk = _block()
+    srv = ModelServer(cache_size=64)
+    srv.add_generative_model("lm", blk, slots=2, max_len=MAXLEN,
+                             prefill_batch=2)
+    try:
+        prompt = _prompt(5, seed=11)
+        got = srv.infer_stream("lm", prompt, max_new_tokens=8).result(
+            timeout=120)
+        want = _ref_greedy(blk, prompt, 8)      # 5 + 8 = 13 <= window
+        assert got == want, (got, want)
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+def test_streaming_iteration_yields_incrementally():
+    srv = _server(slots=2, warm=False)
+    try:
+        st = srv.infer_stream("lm", _prompt(3), max_new_tokens=5)
+        toks = list(st)                    # consumer-side iteration
+        assert len(toks) == 5
+        assert st.state == "served" and st.done()
+        assert st.ttft_s is not None and st.ttft_s > 0
+        # one inter-token gap per token after the first
+        assert len(st.token_latencies_s) == 4
+        assert st.result(timeout=1) == toks
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+# -- continuous batching: the no-convoy pin -----------------------------------
+def test_no_convoy_shorts_finish_while_long_generation_in_flight():
+    srv = _server(slots=4, prefill_batch=2)
+    sched = srv._gen_sched("lm")
+    miss0 = srv.cache.misses
+    jit0 = sched.model.compile_stats()
+    try:
+        long_st = srv.infer_stream("lm", _prompt(4), max_new_tokens=48,
+                                   tenant="long")
+        shorts = [srv.infer_stream("lm", _prompt(3, seed=s),
+                                   max_new_tokens=4, tenant="short")
+                  for s in range(6)]
+        for s in shorts:
+            assert len(s.result(timeout=120)) == 4
+        # every short completed while the 48-token generation still
+        # held its slot: per-step join/leave, no convoy
+        assert not long_st.done()
+        assert len(long_st.result(timeout=120)) == 48
+        # steady state compiled NOTHING: the warmed grid + admit rungs
+        # + the one decode program served every request above
+        assert srv.cache.misses == miss0
+        assert sched.model.compile_stats() == jit0
+        led = sched.ledgers()
+        for tenant, counts in led.items():
+            assert counts["submitted"] == (
+                counts["served"] + counts["failed"]
+                + counts["expired"] + counts["shed"]), led
+        assert led["short"]["served"] == 6
+        assert led["long"]["served"] == 1
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+def test_warmup_covers_grid_and_second_warmup_is_free():
+    srv = _server(warm=False)
+    sched = srv._gen_sched("lm")
+    warmed = srv.warmup_generative()["lm"]
+    assert warmed == len(sched.model.grid())
+    miss0 = srv.cache.misses
+    assert srv.warmup_generative()["lm"] == warmed
+    assert srv.cache.misses == miss0     # the grid was already resident
+    srv.stop(drain=False)
+    srv.cache.clear()
+
+
+# -- typed rejections + ledgers -----------------------------------------------
+def test_bad_request_rejections():
+    srv = _server(slots=1, warm=False)
+    try:
+        with pytest.raises(BadRequest):
+            srv.infer_stream("lm", np.zeros(0, np.int32))
+        with pytest.raises(BadRequest):
+            srv.infer_stream("lm", _prompt(MAXLEN + 1))   # > KV window
+        with pytest.raises(BadRequest):
+            srv.infer_stream("lm", _prompt(2), max_new_tokens=0)
+        with pytest.raises(ModelNotFound):
+            srv.infer_stream("nope", _prompt(2))
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+def test_queue_full_rejection_carries_retry_hint():
+    gm = GenerativeModel("lm", _block(), max_len=MAXLEN, prefill_batch=2)
+    sched = DecodeScheduler(gm, ExecutorCache(capacity=8), slots=1,
+                            queue_depth=2)
+    sched._thread = types.SimpleNamespace(   # park the decode loop
+        join=lambda timeout=None: None)
+    sched.submit(_prompt(2), max_new_tokens=4)
+    sched.submit(_prompt(2), max_new_tokens=4)
+    with pytest.raises(QueueFull) as ei:
+        sched.submit(_prompt(2), max_new_tokens=4)
+    assert ei.value.retry_after_s > 0
+    sched.stop(drain=False)
+    led = sched.ledgers()["default"]
+    # the rejected submit never entered the ledger: exactly-once means
+    # submitted == settled even across typed rejections
+    assert led == {"submitted": 2, "served": 0, "failed": 2,
+                   "expired": 0, "shed": 0}
+    assert sched.stats()["rejected_queue_full"] == 1
+
+
+def test_deadline_expired_in_queue_is_typed_and_ledgered():
+    gm = GenerativeModel("lm", _block(), max_len=MAXLEN, prefill_batch=2)
+    sched = DecodeScheduler(gm, ExecutorCache(capacity=8), slots=1)
+    sched._thread = types.SimpleNamespace(   # park the decode loop
+        join=lambda timeout=None: None)
+    st = sched.submit(_prompt(2), max_new_tokens=4, tenant="impatient",
+                      timeout_ms=10.0)
+    time.sleep(0.05)
+    with sched._cv:                # the loop's own expiry sweep
+        sched._expire_locked(time.monotonic())
+    with pytest.raises(DeadlineExceeded):
+        st.result(timeout=5)
+    assert st.state == "expired"
+    led = sched.ledgers()["impatient"]
+    assert led == {"submitted": 1, "served": 0, "failed": 0,
+                   "expired": 1, "shed": 0}
+    sched.stop(drain=False)
+
+
+def test_stop_fails_pending_and_running_with_server_closed():
+    srv = _server(slots=1)
+    hog = srv.infer_stream("lm", _prompt(2), max_new_tokens=500,
+                           tenant="hog")
+    queued = srv.infer_stream("lm", _prompt(2), max_new_tokens=4)
+    srv.stop(drain=False)
+    for st in (hog, queued):
+        with pytest.raises(ServerClosed):
+            st.result(timeout=30)
+    with pytest.raises(ServerClosed):
+        srv._gen_sched("lm").submit(_prompt(2))
+    led = srv._gen_sched("lm").ledgers()
+    assert sum(c["failed"] for c in led.values()) == 2
+    srv.cache.clear()
+
+
+# -- SLO machinery: quotas, priorities, brownout ------------------------------
+def test_slot_quota_admits_one_slot_per_tenant_per_round():
+    blk = _block()
+    gm = GenerativeModel("lm", blk, max_len=MAXLEN, prefill_batch=4)
+    sched = DecodeScheduler(gm, ExecutorCache(capacity=8), slots=4)
+    # park the loop: a fake thread object keeps submit() from starting
+    # the real one, so admission choices are observable synchronously
+    sched._thread = types.SimpleNamespace(
+        join=lambda timeout=None: None)
+    sched.set_slot_quota("a", 1)
+    for i in range(3):
+        sched.submit(_prompt(2, seed=i), max_new_tokens=4, tenant="a")
+    sched.submit(_prompt(2, seed=9), max_new_tokens=4, tenant="b")
+    with sched._cv:
+        adm = sched._pick_admissions_locked()
+        picked = [s.tenant for s, _ in adm["batch"]]
+        # tenant a is capped at ONE concurrent slot; b rides beside it
+        assert picked == ["a", "b"]
+        assert len(sched._pending) == 2
+        assert all(s.tenant == "a" for s, _ in sched._pending)
+    sched.stop(drain=False)
+
+
+def test_priority_orders_admission_within_a_rung():
+    gm = GenerativeModel("lm", _block(), max_len=MAXLEN, prefill_batch=2)
+    sched = DecodeScheduler(gm, ExecutorCache(capacity=8), slots=4)
+    sched._thread = types.SimpleNamespace(
+        join=lambda timeout=None: None)
+    sched.submit(_prompt(2, seed=0), max_new_tokens=4, priority=1,
+                 tenant="batchy")
+    sched.submit(_prompt(2, seed=1), max_new_tokens=4, priority=0,
+                 tenant="interactive")
+    with sched._cv:
+        adm = sched._pick_admissions_locked()
+        assert [s.tenant for s, _ in adm["batch"]] == ["interactive",
+                                                       "batchy"]
+    sched.stop(drain=False)
+
+
+def test_brownout_sheds_low_class_at_the_door():
+    srv = _server(slots=2, warm=False)
+    sched = srv._gen_sched("lm")
+    with sched._cv:
+        sched._brownout = True     # brownout_ms=0 -> never recomputed
+    try:
+        shed = srv.infer_stream("lm", _prompt(2), max_new_tokens=4,
+                                priority=2, tenant="batchy")
+        assert shed.state == "shed"
+        with pytest.raises(QueueFull) as ei:
+            shed.result(timeout=1)
+        assert ei.value.retry_after_s > 0
+        # protected class still admitted and served through brownout
+        kept = srv.infer_stream("lm", _prompt(2), max_new_tokens=4,
+                                priority=0, tenant="interactive")
+        assert len(kept.result(timeout=120)) == 4
+        led = sched.ledgers()
+        assert led["batchy"]["shed"] == 1
+        assert led["interactive"]["served"] == 1
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+# -- telemetry ----------------------------------------------------------------
+def test_generative_telemetry_round_trips_exposition():
+    from mxnet_tpu import telemetry
+    srv = _server(slots=2, warm=False)
+    try:
+        srv.infer_stream("lm", _prompt(3), max_new_tokens=4).result(
+            timeout=120)
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+    text = telemetry.prometheus_text()
+    telemetry.validate_exposition(text)          # the round-trip gate
+    snap = telemetry.snapshot()
+    for fam in ("mxnet_serving_ttft_seconds",
+                "mxnet_serving_per_token_seconds"):
+        vals = snap[fam]["values"]
+        assert any(v["labels"].get("model") == "lm" for v in vals), fam
+    slot_vals = snap["mxnet_serving_decode_slots"]["values"]
+    states = {(v["labels"]["model"], v["labels"]["state"])
+              for v in slot_vals}
+    assert {("lm", "busy"), ("lm", "free")} <= states
+
+
+# -- graftplan satellite ------------------------------------------------------
+def test_generative_report_prices_ladders_and_window():
+    from mxnet_tpu.analysis.plan.contracts import generative_report
+    rep = generative_report({
+        "slots": 4, "max_len": 16, "max_new_tokens": 64,
+        "batch_ladder": [2, 4, 4], "len_ladder": [1, 2, 4, 8, 16],
+        "kv_bytes_per_slot": 1024, "param_bytes": 4096})
+    assert rep["kv_bytes_total"] == 4096
+    assert rep["prefill_programs"] == 3 * 5
+    details = [p["detail"] for p in rep["problems"]]
+    # the duplicate batch rung is shadowed (pick_bucket never picks it)
+    assert any("shadow" in d for d in details), details
+    # a token budget past the KV window means ring wrap-around
+    assert any("window" in d or "wrap" in d for d in details), details
+    clean = generative_report({
+        "slots": 4, "max_len": 16, "max_new_tokens": 16,
+        "batch_ladder": [1, 2, 4], "len_ladder": [1, 2, 4, 8, 16],
+        "kv_bytes_per_slot": 1024, "param_bytes": 4096})
+    assert clean["problems"] == []
+
+
+def test_server_plan_spec_feeds_generative_analysis():
+    from mxnet_tpu.analysis.plan import PlanSpec, analyze
+    srv = _server(slots=2, warm=False)
+    try:
+        d = srv.plan_spec()
+        gen = d["generative"]["lm"]
+        assert gen["slots"] == 2 and gen["max_len"] == MAXLEN
+        assert gen["kv_bytes_per_slot"] == DecodeState.kv_bytes(
+            num_layers=2, num_kv_heads=2, max_len=MAXLEN, head_dim=4)
+        spec = PlanSpec.from_server(srv, name="t")
+        report = analyze(spec)
+        assert report["generative"]["lm"]["kv_bytes_total"] == \
+            2 * gen["kv_bytes_per_slot"]
+        mem = report["memory"]
+        assert mem["total"] == mem["params"] + mem["activations"]
+        assert mem["activations"] == 2 * gen["kv_bytes_per_slot"]
+    finally:
+        srv.stop(drain=False)
+        srv.cache.clear()
+
+
+def test_generative_knobs_registered_and_documented():
+    """Env-drift guard for the MXNET_SERVING_GEN_* knob family (same
+    single-source-of-truth checker as the other serving knob tests)."""
+    from mxnet_tpu.analysis.checkers import env_knobs
+    rep = env_knobs.drift_report(prefix="MXNET_SERVING_GEN_")
+    assert {"MXNET_SERVING_GEN_SLOTS", "MXNET_SERVING_GEN_MAX_LEN",
+            "MXNET_SERVING_GEN_MAX_NEW_TOKENS",
+            "MXNET_SERVING_GEN_PREFILL_BATCH",
+            "MXNET_SERVING_GEN_QUEUE_DEPTH",
+            "MXNET_SERVING_GEN_SLOT_QUOTA",
+            "MXNET_SERVING_GEN_BROWNOUT_MS"} <= set(rep["used"])
+    assert not rep["unregistered"], rep["unregistered"]
+    assert not rep["undocumented"], \
+        "generative knobs missing from docs/faq/env_var.md: %s" \
+        % rep["undocumented"]
+
+
+# -- fault drill (slow soak) --------------------------------------------------
+@pytest.mark.slow
+def test_decode_fault_poisons_only_the_victim_slot():
+    plan = fault.FaultPlan({"rules": [
+        {"site": "serving.decode.step", "kind": "raise", "times": 1,
+         "where": {"tenant": "victim"}}]})
+    srv = _server(slots=4, prefill_batch=2)
+    fault.install(plan)
+    try:
+        victim = srv.infer_stream("lm", _prompt(3), max_new_tokens=32,
+                                  tenant="victim")
+        healthy = [srv.infer_stream("lm", _prompt(3, seed=s),
+                                    max_new_tokens=8, tenant="t%d" % s)
+                   for s in range(3)]
+        with pytest.raises(fault.FaultInjected):
+            victim.result(timeout=120)
+        for st in healthy:
+            assert len(st.result(timeout=120)) == 8
+        fault.uninstall()
+        # the pool survives: the freed slot serves new traffic
+        again = srv.infer_stream("lm", _prompt(2), max_new_tokens=4,
+                                 tenant="victim")
+        assert len(again.result(timeout=120)) == 4
+        led = srv._gen_sched("lm").ledgers()
+        assert led["victim"] == {"submitted": 2, "served": 1,
+                                 "failed": 1, "expired": 0, "shed": 0}
+        for s in range(3):
+            assert led["t%d" % s]["served"] == 1
+            assert led["t%d" % s]["failed"] == 0
+        assert plan.injected_count() == 1
+    finally:
+        fault.uninstall()
+        srv.stop(drain=False)
+        srv.cache.clear()
