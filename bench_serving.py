@@ -26,6 +26,12 @@ throughput/p99, the canary rollback latency, and the isolation
 evidence (zero cross-tenant evictions, per-tenant exactly-once
 ledgers, quotas respected).
 
+Since ISSUE 18 a **tracing A/B leg** (``--tracing`` standalone)
+measures the graftrace request-tracing cost: the same concurrency-8
+burst with tracing disarmed vs armed at the default tail-sample rate,
+recording both throughputs and asserting the armed overhead stays
+within 3% req/s (the disarmed path is one boolean check per seam).
+
 Methodology mirrors bench.py: warmup excluded from measurement (every
 bucket compiled by ``warmup()`` before the clock starts), ONE JSON
 line on stdout win or lose, details written incrementally to
@@ -266,6 +272,119 @@ def _multitenant_only():
     sys.stdout.flush()
 
 
+def _measure_tracing_ab(symb, arg_params, aux_params):
+    """The ISSUE-18 leg: the same concurrency-8 burst against the
+    bench's model of record with tracing disarmed vs armed
+    (tail-sampled at the default rate, spans exported between passes).
+    Acceptance: armed throughput within 3% of disarmed — the off path
+    is one boolean per seam, and the armed per-request bookkeeping
+    must disappear into real model time."""
+    from mxnet_tpu.serving import ModelServer
+    from mxnet_tpu.telemetry import tracing
+
+    srv = ModelServer(max_batch=MAX_BATCH, queue_depth=1024,
+                      default_timeout_ms=300000.0)
+    srv.add_model("resnet", symb, arg_params, aux_params,
+                  {"data": (1,) + IMAGE_SHAPE})
+    srv.start()
+    srv.warmup("resnet")
+
+    conc, per_client, passes = 8, 16, 3
+
+    def burst():
+        lat = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(conc + 1)
+
+        def client(tid):
+            crng = np.random.RandomState(2000 + tid)
+            mine = []
+            barrier.wait()
+            for _ in range(per_client):
+                x = crng.rand(1, *IMAGE_SHAPE).astype(np.float32)
+                t1 = time.perf_counter()
+                srv.infer("resnet", {"data": x}, timeout_ms=300000.0)
+                mine.append((time.perf_counter() - t1) * 1000.0)
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(conc)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        return {"req_per_sec": round(conc * per_client / wall, 2),
+                "p99_ms": _percentile(lat, 99)}
+
+    trace_dir = tempfile.mkdtemp(prefix="mxnet-bench-trace-")
+    legs = {"off": [], "on": []}
+    try:
+        burst()                          # warm-in pass, discarded
+        for _ in range(passes):          # interleaved A/B: shared drift
+            tracing.disable()
+            legs["off"].append(burst())
+            tracing.reset()
+            tracing.enable(trace_dir=trace_dir)  # default tail sample
+            legs["on"].append(burst())
+            tracing.export_jsonl()
+        sample = tracing.stats()["sample"]
+    finally:
+        tracing.disable()
+        tracing.reset()
+        srv.stop(drain=False)
+        srv.cache.clear()
+        shutil.rmtree(trace_dir, ignore_errors=True)
+    best_off = max(p["req_per_sec"] for p in legs["off"])
+    best_on = max(p["req_per_sec"] for p in legs["on"])
+    overhead = round((best_off - best_on) / best_off * 100.0, 2)
+    leg = {
+        "concurrency": conc,
+        "requests_per_pass": conc * per_client,
+        "sample": sample,
+        "off": {"req_per_sec": best_off,
+                "p99_ms": min(p["p99_ms"] for p in legs["off"]),
+                "passes": [p["req_per_sec"] for p in legs["off"]]},
+        "on": {"req_per_sec": best_on,
+               "p99_ms": min(p["p99_ms"] for p in legs["on"]),
+               "passes": [p["req_per_sec"] for p in legs["on"]]},
+        "overhead_pct": overhead,
+        "bound_pct": 3.0,
+        "ok": overhead <= 3.0,
+    }
+    if not leg["ok"]:
+        raise AssertionError(
+            "tracing overhead %.2f%% exceeds the 3%% bar: off %.2f "
+            "req/s vs on %.2f req/s" % (overhead, best_off, best_on))
+    return leg
+
+
+def _tracing_only():
+    """--tracing: run just the tracing A/B leg and merge it into an
+    existing BENCH_SERVING.json (or a fresh skeleton)."""
+    try:
+        with open(OUT_PATH) as f:
+            result = json.load(f)
+    except (OSError, ValueError):
+        result = {}
+    leg = _measure_tracing_ab(*_build_model())
+    result["tracing_ab"] = leg
+    with open(OUT_PATH, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({
+        "metric": "serving_tracing_overhead_pct",
+        "value": leg["overhead_pct"],
+        "unit": "%",
+        "off_req_per_sec": leg["off"]["req_per_sec"],
+        "on_req_per_sec": leg["on"]["req_per_sec"],
+        "ok": leg["ok"],
+    }))
+    sys.stdout.flush()
+
+
 def _measure_generative():
     """The ISSUE-17 leg: generative serving through
     ``serving/generate`` — decode throughput, TTFT percentiles under
@@ -483,6 +602,15 @@ def main():
     except Exception as exc:   # noqa: BLE001
         _fail("multi-tenant leg failed: %r" % (exc,), 7)
 
+    # tracing A/B leg: the ISSUE-18 bar — request tracing armed at the
+    # default tail-sample rate costs <= 3% req/s vs disarmed
+    try:
+        result["tracing_ab"] = _measure_tracing_ab(symb, arg_params,
+                                                   aux_params)
+        checkpoint()
+    except Exception as exc:   # noqa: BLE001
+        _fail("tracing A/B leg failed: %r" % (exc,), 8)
+
     seq = result["sequential"]["req_per_sec"]
     c64 = [leg for leg in result["serving"]
            if leg.get("concurrency") == 64]
@@ -502,6 +630,7 @@ def main():
         "warmup_warm_s": result["warmup_warm_s"],
         "multitenant_rollback_s":
             result["multitenant"]["canary"]["rollback_wall_s"],
+        "tracing_overhead_pct": result["tracing_ab"]["overhead_pct"],
     }))
     sys.stdout.flush()
 
@@ -513,5 +642,7 @@ if __name__ == "__main__":
         _multitenant_only()
     elif "--generative" in sys.argv[1:]:
         _generative_only()
+    elif "--tracing" in sys.argv[1:]:
+        _tracing_only()
     else:
         main()

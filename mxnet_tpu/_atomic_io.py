@@ -28,11 +28,23 @@ from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import uuid
 
 from .fault import hooks as _fault
 
 __all__ = ["atomic_writer", "atomic_write"]
+
+
+def _span(name, **tags):
+    """A tracing span WITHOUT importing telemetry: this module must
+    stay a dependency-free leaf, and tracing can only be ACTIVE after
+    someone imported it — so an absent module means a guaranteed
+    no-op."""
+    tracing = sys.modules.get(__package__ + ".telemetry.tracing")
+    if tracing is None or not tracing.ACTIVE[0]:
+        return contextlib.nullcontext()
+    return tracing.span(name, **tags)
 
 
 def _temp_name(path):
@@ -59,12 +71,13 @@ def atomic_writer(path, mode="wb"):
         # window this module exists to close; the target must stay
         # untouched (tests/test_fault.py holds legacy nd.save /
         # Symbol.save to that)
-        if _fault.ACTIVE[0]:
-            _fault.fire("atomic_io.commit", file=f, path=path)
-        f.flush()
-        os.fsync(f.fileno())
-        f.close()
-        os.replace(tmp, path)
+        with _span("atomic_io.commit", path=path):
+            if _fault.ACTIVE[0]:
+                _fault.fire("atomic_io.commit", file=f, path=path)
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            os.replace(tmp, path)
         committed = True
     finally:
         if not committed:

@@ -13,6 +13,7 @@ from . import pallas_fallback    # noqa: F401
 from . import plan_rules         # noqa: F401
 from . import recompile_hazard   # noqa: F401
 from . import replicated_state   # noqa: F401
+from . import span_discipline    # noqa: F401
 from . import stale_suppression  # noqa: F401
 from . import swallowed_exception  # noqa: F401
 from . import tracer_escape      # noqa: F401

@@ -1,0 +1,191 @@
+"""span-discipline — request-tracing spans must not leak, and every
+cataloged fault-injection seam must be traced.  Two directions:
+
+- a span begun with ``start_span`` and bound to a local must be closed
+  on ALL paths: either used as a context manager, finished inside a
+  ``try``'s ``finally`` block, or handed off (stored on an object /
+  into a container, passed to a call, returned) to an owner whose
+  terminal paths finish it.  A local that does none of these keeps its
+  trace's root open forever on an exception path — the trace never
+  exports and the ring silently pins it;
+- every ``fault.hooks`` fire site named in the injection-site catalog
+  (``docs/faq/fault_tolerance.md``) must sit lexically inside some
+  ``with ...span(...)`` block: an injected fault at an untraced seam
+  is invisible to the incident flight recorder, which defeats the
+  reason the seam is drillable at all.
+
+The with-item match accepts any callee whose terminal name ends in
+``span`` (``span``, ``tracing.span``, ``_span`` helpers) so
+dependency-free leaves like ``_atomic_io`` can wrap the site without
+importing telemetry.  Suppress with ``# graftlint:
+disable=span-discipline`` where ownership really does transfer through
+a path the AST cannot see.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Checker, Finding, register
+from .fault_sites import _site_of, documented_sites
+
+__all__ = ["SpanDisciplineChecker"]
+
+
+def _callee_name(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_start_span(node):
+    return (isinstance(node, ast.Call)
+            and _callee_name(node.func) == "start_span")
+
+
+def _is_span_item(item):
+    """Does one ``withitem`` open a tracing span?"""
+    expr = item.context_expr
+    if not isinstance(expr, ast.Call):
+        return False
+    name = _callee_name(expr.func)
+    return bool(name) and name.endswith("span")
+
+
+def _finally_nodes(func):
+    """Every AST node lexically inside some ``finally`` block of
+    ``func`` (where a leak-proof ``finish`` must live)."""
+    out = set()
+    for node in ast.walk(func):
+        if isinstance(node, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    out.add(sub)
+    return out
+
+
+def _function_leaks(func):
+    """Direction one, per function: ``(name, line)`` for every local
+    ``x = start_span(...)`` that never escapes, is never a context
+    manager, and has no ``x.finish`` in a ``finally``; plus
+    ``(None, line)`` for a bare ``start_span(...)`` whose result is
+    dropped on the floor."""
+    nested = set()
+    for node in ast.walk(func):
+        if node is not func and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested.update(ast.walk(node))
+    own = [n for n in ast.walk(func) if n not in nested]
+    parents = {}
+    for node in own:
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+
+    tracked = {}   # name -> assignment line
+    leaks = []
+    for node in own:
+        if isinstance(node, ast.Expr) and _is_start_span(node.value):
+            leaks.append((None, node.lineno))
+        if (isinstance(node, ast.Assign) and _is_start_span(node.value)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            tracked.setdefault(node.targets[0].id, node.lineno)
+
+    if not tracked:
+        return leaks
+    finally_set = _finally_nodes(func)
+    for name, line in sorted(tracked.items(), key=lambda kv: kv[1]):
+        closed = escaped = False
+        for node in own:
+            if not (isinstance(node, ast.Name) and node.id == name
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.withitem):
+                closed = True           # ``with x:`` — __exit__ finishes
+            elif isinstance(parent, ast.Attribute):
+                if parent.attr == "finish" and node in finally_set:
+                    closed = True       # try/finally ownership
+            else:
+                escaped = True          # handed off: new owner closes
+        if not (closed or escaped):
+            leaks.append((name, line))
+    return leaks
+
+
+def _untraced_fires(tree):
+    """Direction two: ``(site, line)`` for every resolvable fault-site
+    fire NOT lexically inside a span with-block."""
+    out = []
+
+    def visit(node, in_span):
+        if isinstance(node, ast.With) and any(
+                _is_span_item(it) for it in node.items):
+            in_span = True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "fire"):
+            site = _site_of(node)
+            if site is not None and not in_span:
+                out.append((site, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_span)
+
+    visit(tree, False)
+    return out
+
+
+@register
+class SpanDisciplineChecker(Checker):
+    rule = "span-discipline"
+    severity = "error"
+    suffixes = (".py",)
+
+    def _documented(self, ctx):
+        key = "span-discipline-catalog"
+        if key not in ctx.memo:
+            doc = os.path.join(ctx.root, "docs", "faq",
+                               "fault_tolerance.md")
+            ctx.memo[key] = (documented_sites(doc)
+                             if os.path.exists(doc) else set())
+        return ctx.memo[key]
+
+    def check(self, path, relpath, text, tree, ctx):
+        rel = relpath.replace("\\", "/")
+        if tree is None or not rel.startswith("mxnet_tpu/"):
+            return []
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for name, line in _function_leaks(node):
+                if name is None:
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, line,
+                        "start_span(...) result is dropped — the span "
+                        "can never be finished; use `with span(...)` "
+                        "or keep the handle", symbol=node.name))
+                else:
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, line,
+                        "span %r is neither finished in a try/finally, "
+                        "used as a context manager, nor handed off — "
+                        "it leaks open on an exception path" % name,
+                        symbol=node.name))
+        documented = self._documented(ctx)
+        for site, line in _untraced_fires(tree):
+            if site.endswith("*"):
+                known = any(d.startswith(site[:-1]) for d in documented)
+            else:
+                known = site in documented
+            if known:
+                out.append(Finding(
+                    self.rule, self.severity, relpath, line,
+                    "cataloged fault site %r fires outside any tracing "
+                    "span — an injected fault here is invisible to the "
+                    "flight recorder; wrap the site in `with "
+                    "span(...)`" % site, symbol="fire"))
+        return out

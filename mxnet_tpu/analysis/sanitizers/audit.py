@@ -415,6 +415,11 @@ def builtin_workload():
         # -- rollback — the ISSUE 15 paths run under the probe so any
         # -- suppression they carry is runtime-classified ---------------
         _multitenant_leg(mod)
+
+        # -- graftrace leg: a fully-sampled traced burst + an incident
+        # -- dump, driving BOTH of the flight recorder's never-raise
+        # -- swallows so their suppressions are runtime-confirmed -------
+        _tracing_leg(mod, tmp)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -562,6 +567,88 @@ def _multitenant_leg(mod):
         "audit multi-tenant leg: canary never decided"
     srv.stop(drain=False)
     srv.cache.clear()
+
+
+def _tracing_leg(mod, tmp):
+    """Drive the graftrace paths (ISSUE 18): a fully-sampled traced
+    serving burst with an injected victim fault (anomaly mark + the
+    flight ring's fault breadcrumb), an incident dump, and BOTH of the
+    flight recorder's never-raise swallows:
+
+    - ``flight._configure_locked`` under an injected config outage —
+      the defaults must hold and the event still lands;
+    - ``flight.record`` handed a field whose ``str()`` raises — the
+      recorder absorbs it (observability must never take down the path
+      it observes)."""
+    import numpy as _np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import config as _config, fault
+    from mxnet_tpu.telemetry import flight, tracing
+
+    trace_dir = os.path.join(tmp, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    tracing.reset()
+    flight.reset()
+    tracing.enable(sample=1.0, seed=0, ring=512, trace_dir=trace_dir,
+                   p99_factor=1e9)
+    try:
+        # (a) first touch after reset() happens under a config outage:
+        # _configure_locked must swallow and keep the defaults
+        real_get = _config.get
+
+        def _outage(key):
+            raise RuntimeError("graftfault: injected config outage")
+
+        _config.get = _outage
+        try:
+            flight.record("probe", leg="tracing")
+        finally:
+            _config.get = real_get
+        assert flight.events()[-1]["kind"] == "probe", \
+            "audit tracing leg: record lost under a config outage"
+
+        # (b) a hostile field: record must swallow, never raise
+        class _Hostile:
+            def __str__(self):
+                raise ValueError("graftfault: hostile repr")
+
+        flight.record("probe", bad=_Hostile())
+
+        # (c) a traced burst with one injected victim fault: the span
+        # tree forms, the trace is marked anomalous, the fault
+        # breadcrumb lands in the ring, and the incident dump attaches
+        # all of it
+        srv = mx.serving.ModelServer(max_batch=4, batch_wait_ms=1.0,
+                                     default_timeout_ms=30000.0)
+        mod.export_serving("traced", srv)
+        srv.start()
+        srv.warmup("traced", buckets=[2])
+        rng = _np.random.RandomState(13)
+        from mxnet_tpu.serving.errors import ServingError
+        with fault.active_plan({"rules": [
+                {"site": "serving.cache.get", "kind": "raise",
+                 "exc": "RuntimeError", "times": 1,
+                 "where": {"model": "traced"}}]}):
+            for _ in range(6):
+                try:
+                    srv.infer("traced",
+                              rng.randn(2, 8).astype(_np.float32),
+                              retries=2)
+                except (RuntimeError, ServingError):
+                    pass   # a delivered typed failure is a fine outcome
+        srv.stop(drain=False)
+        srv.cache.clear()
+        assert tracing.anomalous(), \
+            "audit tracing leg: injected fault marked no trace"
+        path = flight.incident("audit_probe", leg="tracing")
+        assert path is not None and os.path.exists(path), \
+            "audit tracing leg: incident dump missing"
+        tracing.export_jsonl()
+    finally:
+        tracing.disable()
+        tracing.reset()
+        flight.reset()
 
 
 def run_audit(workload=None, root=None):

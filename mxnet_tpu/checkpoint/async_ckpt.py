@@ -126,10 +126,12 @@ class AsyncCheckpointer:
             # poison global sync points — the containment this scope
             # exists to prove
             from ..fault import hooks as _fault
-            if _fault.ACTIVE[0]:
-                _fault.fire("checkpoint.async.worker", step=step)
-            write_checkpoint(self.store, step, arrays, blobs=blobs,
-                             meta=meta, retention=self.retention)
+            from ..telemetry import tracing as _tracing
+            with _tracing.span("checkpoint.async.worker", step=int(step)):
+                if _fault.ACTIVE[0]:
+                    _fault.fire("checkpoint.async.worker", step=step)
+                write_checkpoint(self.store, step, arrays, blobs=blobs,
+                                 meta=meta, retention=self.retention)
 
     def _deliver(self, exc):
         """Failure surface: the error is recorded here (telemetry

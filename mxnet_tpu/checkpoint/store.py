@@ -50,6 +50,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..fault import hooks as _fault
+from ..telemetry import tracing as _tracing
 
 __all__ = ["CheckpointError", "IntegrityError", "CheckpointStore",
            "RetentionPolicy", "MANIFEST_NAME", "MANIFEST_FORMAT",
@@ -160,7 +161,9 @@ class CheckpointStore:
                 arr = np.ascontiguousarray(arr)
                 data = arr.tobytes()
                 fname = _shard_file(name, used=used_names)
-                with open(os.path.join(tmp, fname), "wb") as f:
+                with _tracing.span("checkpoint.store.shard_write",
+                                   shard=name, step=step), \
+                        open(os.path.join(tmp, fname), "wb") as f:
                     f.write(data)
                     # graftfault: torn-write/ENOSPC while the shard is
                     # still inside .tmp-* — the temp dir must stay
@@ -195,10 +198,12 @@ class CheckpointStore:
             # SIGKILL) lands in the widest window — everything written,
             # nothing committed; recovery must see no ckpt-N and one
             # orphan temp dir
-            if _fault.ACTIVE[0]:
-                _fault.fire("checkpoint.store.commit", step=step, tmp=tmp)
-            os.replace(tmp, final)
-            self._fsync_root()
+            with _tracing.span("checkpoint.store.commit", step=step):
+                if _fault.ACTIVE[0]:
+                    _fault.fire("checkpoint.store.commit", step=step,
+                                tmp=tmp)
+                os.replace(tmp, final)
+                self._fsync_root()
             return final
         finally:
             with _ACTIVE_LOCK:
@@ -249,10 +254,13 @@ class CheckpointStore:
             # graftfault: transient manifest-read failures (flaky NFS,
             # mid-rename rack move) — consumers (watcher, restore walk,
             # elastic driver) must retry or fall back, never crash
-            if _fault.ACTIVE[0]:
-                _fault.fire("checkpoint.store.manifest_read", step=step)
-            with open(path) as f:
-                return json.load(f)
+            with _tracing.span("checkpoint.store.manifest_read",
+                               step=int(step)):
+                if _fault.ACTIVE[0]:
+                    _fault.fire("checkpoint.store.manifest_read",
+                                step=step)
+                with open(path) as f:
+                    return json.load(f)
         except (OSError, ValueError) as exc:
             raise CheckpointError("checkpoint step %d has no readable "
                                   "manifest (%s)" % (int(step), exc))

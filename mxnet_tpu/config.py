@@ -445,3 +445,44 @@ register_env("MXNET_BENCH_SKIP_NHWC", str, None,
              "set to 1 to skip bench.py's secondary NHWC layout leg")
 register_env("MXNET_BENCH_SKIP_RIDERS", str, None,
              "set to 1 to skip bench.py's rider benchmark legs")
+register_env("MXNET_TRACE", bool, False,
+             "master switch for graftrace request tracing + the flight "
+             "recorder (telemetry/tracing.py): off, every span call "
+             "site costs one boolean check; on, request-scoped spans "
+             "land in the per-process ring and cross process "
+             "boundaries as _trace headers on transport frames")
+register_env("MXNET_TRACE_SAMPLE", float, 0.01,
+             "tail-sampling keep rate for HEALTHY traces at export; "
+             "anomalous traces (shed, failed, deadline-exceeded, "
+             "canary-routed, fault-injected, resubmitted, "
+             "p99-exceeding) are always retained regardless")
+register_env("MXNET_TRACE_SEED", int, 0,
+             "seed of the per-trace sampling hash — the keep decision "
+             "is pure in (seed, trace_id), so runs and processes agree "
+             "on which healthy traces survive")
+register_env("MXNET_TRACE_RING", int, 4096,
+             "finished-span ring capacity per process; spans of traces "
+             "whose root has not finished stay ringed until flush, "
+             "oldest spill first")
+register_env("MXNET_TRACE_DIR", str, None,
+             "directory for JSONL trace shards (trace-<pid>.jsonl, "
+             "appended by flush()/atexit) and flight-recorder incident "
+             "dumps; unset disables export but not in-ring tracing")
+register_env("MXNET_TRACE_P99_FACTOR", float, 3.0,
+             "a finished root span slower than this multiple of its "
+             "name's running p99 estimate marks the trace anomalous "
+             "(p99_exceeded) for tail retention")
+register_env("MXNET_TRACE_FLIGHT_RING", int, 512,
+             "flight-recorder ring capacity: last N control-plane "
+             "events (shed/brownout transitions, canary decisions, "
+             "quota rejections, fault injections, elastic retries) "
+             "kept for incident dumps")
+register_env("MXNET_TRACE_FLIGHT_DUMPS", int, 8,
+             "max flight-recorder incident dumps per process — a "
+             "crash-looping trigger cannot fill the disk")
+register_env("MXNET_TELEMETRY_LABEL_CAP", int, 256,
+             "label-cardinality cap per metric family: past this many "
+             "distinct label sets, new ones collapse into the "
+             "__overflow__ child and "
+             "mxnet_telemetry_label_overflow_total{metric=...} counts "
+             "the spill (0 = uncapped)")

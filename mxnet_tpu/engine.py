@@ -123,6 +123,11 @@ def worker_scope(deliver=None):
             except Exception:
                 delivered = False
         if not delivered:
+            # an orphaned worker failure is an incident: nothing owns
+            # it until the next sync point, so dump the flight ring now
+            from .telemetry import flight as _flight
+            _flight.incident("worker_exception",
+                             error="%s: %s" % (type(exc).__name__, exc))
             record_exception(exc)
 
 

@@ -35,7 +35,11 @@ _LAZY = ("elastic", "ElasticError", "ElasticSupervisor",
 
 def __getattr__(name):
     if name in _LAZY:
-        from . import elastic
+        # import_module, NOT ``from . import elastic``: the from-import
+        # probes this package's attribute first, which re-enters this
+        # __getattr__ before the submodule binds — infinite recursion
+        import importlib
+        elastic = importlib.import_module(__name__ + ".elastic")
         if name == "elastic":
             return elastic
         return getattr(elastic, name)

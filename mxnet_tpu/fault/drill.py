@@ -529,6 +529,20 @@ def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="graftfault-mt-")
     ckpt_dir = os.path.join(tmpdir, "ck")
 
+    # graftrace rides the soak: full-sample tracing plus the flight
+    # recorder, so the rollback below must leave a self-contained
+    # post-mortem artifact — invariant (6) reads it back
+    from mxnet_tpu.telemetry import flight, tracing
+    trace_dir = os.path.join(tmpdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    trace_was_on = tracing.enabled()
+    tracing.reset()
+    flight.reset()
+    # p99_factor sky-high: anomaly must come ONLY from injected faults
+    # and failed requests, so the bystander-stays-clean trace assertion
+    # cannot trip on scheduling-latency noise
+    tracing.enable(sample=1.0, trace_dir=trace_dir, p99_factor=1e9)
+
     mod_v = _soak_module(seed=0)      # the victim (checkpoint source)
     mod_b = _soak_module(seed=1)      # the bystander
 
@@ -627,6 +641,16 @@ def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
     finally:
         if not stop.is_set():
             stop.set()
+        # harvest the trace evidence BEFORE disarming (incident dumps
+        # are already on disk; the anomalous set lives in the ring)
+        trace_spans = tracing.snapshot()
+        trace_anomalous = tracing.anomalous()
+        tracing.export_jsonl()
+        tracing.disable()
+        tracing.reset()
+        flight.reset()
+        if trace_was_on:
+            tracing.enable()   # restore the caller's env-armed state
 
     # -- invariants ----------------------------------------------------------
     stats = srv.stats()
@@ -674,6 +698,46 @@ def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
     nan_hits = plan.injected_count(site="serving.canary.execute",
                                    kind="nan")
     assert nan_hits >= 1, "the canary was never poisoned"
+    # (6) graftrace: the incident flight dump ALONE explains the
+    # rollback — the gate's inputs, the decision chain in the event
+    # ring, the victim's tail-retained anomalous traces — and the
+    # bystander appears in none of it
+    dumps = sorted(n for n in os.listdir(trace_dir)
+                   if n.startswith("incident-canary_rollback-"))
+    assert dumps, "rollback never dumped the flight recorder"
+    with open(os.path.join(trace_dir, dumps[0])) as f:
+        dump = json.load(f)
+    det = dump["detail"]
+    assert det["decision"] == "rolled_back" \
+        and det["reason"] == "nonfinite_outputs" \
+        and det["nonfinite_batches"] >= 1, det
+    kinds = {e["kind"] for e in dump["events"]}
+    assert "canary_decision" in kinds and "fault" in kinds, \
+        "flight ring missing the decision chain: %s" % sorted(kinds)
+
+    def _span_models(spans):
+        return {(rec.get("tags") or {}).get("model") for rec in spans}
+
+    assert any(VICTIM in _span_models(sp)
+               for sp in dump["traces"].values()), \
+        "no victim trace retained in the incident dump"
+    for tid, sp in dump["traces"].items():
+        assert BYSTANDER not in _span_models(sp), \
+            "bystander trace %s retained as anomalous" % tid
+    # the post-soak anomalous set agrees: victims only, never the
+    # bystander (fault marks + failed roots; p99 noise was disarmed)
+    by_trace = {}
+    for rec in trace_spans:
+        by_trace.setdefault(rec["trace"], []).append(rec)
+    victim_anomalous = 0
+    for tid in trace_anomalous:
+        models = _span_models(by_trace.get(tid, ()))
+        assert BYSTANDER not in models, \
+            "bystander trace %s marked anomalous" % tid
+        if VICTIM in models:
+            victim_anomalous += 1
+    assert victim_anomalous >= 1, \
+        "no anomalous victim trace survived to the post-soak ring"
 
     wall = time.monotonic() - t_start
     report = {
@@ -710,6 +774,13 @@ def multitenant_soak(duration_s=8.0, clients_victim=3, clients_bystander=1,
         "zero_cross_tenant_evictions": True,
         "quotas_respected": True,
         "rolled_back_to_baseline": True,
+        "tracing": {
+            "incident_dump": dumps[0],
+            "flight_events": len(dump["events"]),
+            "anomalous_traces": len(trace_anomalous),
+            "victim_traces_retained": victim_anomalous,
+            "bystander_traces_clean": True,
+        },
     }
     if own:
         import shutil

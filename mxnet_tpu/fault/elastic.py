@@ -38,6 +38,8 @@ from __future__ import annotations
 import logging
 
 from ..base import MXNetError
+from ..telemetry import flight as _flight
+from ..telemetry import tracing as _trace
 from . import hooks
 from .backoff import BackoffPolicy
 from .plan import FaultInjected
@@ -120,11 +122,16 @@ class ElasticSupervisor:
                         "elastic: retry budget exhausted after %d "
                         "restart(s); giving up (%s: %s)", restart,
                         type(exc).__name__, exc)
+                    _flight.incident(
+                        "elastic_error", restarts=restart,
+                        error="%s: %s" % (type(exc).__name__, exc))
                     raise ElasticError(
                         "elastic training gave up after %d restart(s); "
                         "last failure: %s: %s"
                         % (restart, type(exc).__name__, exc)) from exc
                 m["retries"].inc()
+                _flight.record("elastic_retry", restart=restart + 1,
+                               error=type(exc).__name__)
                 self.logger.warning(
                     "elastic: recoverable failure (%s: %s); restore-and-"
                     "retry %d/%d after backoff", type(exc).__name__, exc,
@@ -184,15 +191,20 @@ class ProcessSupervisor:
                         "relaunch(es)", restart)
                 return rcs
             if not self.is_recoverable(rc):
+                _flight.incident("elastic_error", rc=rc,
+                                 deterministic=True)
                 raise ElasticError(
                     "worker process failed deterministically (rc=%d) — "
                     "not a preemption, not relaunching" % rc)
             if restart >= self.retries:
                 m["gave_up"].inc()
+                _flight.incident("elastic_error", restarts=restart,
+                                 rc=rc)
                 raise ElasticError(
                     "elastic fleet gave up after %d relaunch(es); last "
                     "worker exit rc=%d" % (restart, rc))
             m["retries"].inc()
+            _flight.record("elastic_retry", restart=restart + 1, rc=rc)
             self.logger.warning(
                 "elastic: worker died rc=%d (signal/preemption); "
                 "relaunch %d/%d after backoff", rc, restart + 1,
@@ -316,6 +328,8 @@ def run_elastic(trainer_factory, data_fn, num_steps, manager,
         if state is not None:
             verdict = check_restore_compat(state, trainer)
             if not verdict["compatible"]:
+                _flight.incident("elastic_error", step=step_id,
+                                 problems=verdict["problems"])
                 raise ElasticError(
                     "checkpoint step %s cannot restore onto the new "
                     "topology: %s" % (step_id, verdict["problems"]))
@@ -331,12 +345,13 @@ def run_elastic(trainer_factory, data_fn, num_steps, manager,
                 verdict.get("notes", []))
         for step in range(start, int(num_steps)):
             hooks.set_step(step)
-            if hooks.ACTIVE[0]:
-                # the drill's kill switch: plans address this site by
-                # step to die at an exact batch
-                hooks.fire("elastic.step", step=step)
-            x, y = data_fn(step)
-            loss = trainer.step(x, y)
+            with _trace.span("elastic.step", step=step):
+                if hooks.ACTIVE[0]:
+                    # the drill's kill switch: plans address this site
+                    # by step to die at an exact batch
+                    hooks.fire("elastic.step", step=step)
+                x, y = data_fn(step)
+                loss = trainer.step(x, y)
             # deliberate per-step sync: the loss curve IS the drill's
             # product (compared against the oracle), and the blocking
             # read also bounds how far the loop can run ahead of the

@@ -258,6 +258,7 @@ class FaultPlan:
             actions = self._decide_locked(site, step, ctx)
         for rule in actions:
             self._count(site, rule.kind)
+            self._record(site, rule, ctx)
             self._act(rule, site, ctx)
 
     def _decide_locked(self, site, step, ctx):
@@ -289,6 +290,23 @@ class FaultPlan:
             "faults injected by the armed MXNET_FAULT_PLAN, by site "
             "and kind (docs/faq/fault_tolerance.md)"
         ).labels(site=site, kind=kind).inc()
+
+    @staticmethod
+    def _record(site, rule, ctx):
+        """Anomaly breadcrumbs BEFORE acting: a kill-kind action never
+        returns, and the marked trace + flight-recorder event are what
+        the post-mortem reads (lazy import — fault must stay importable
+        below telemetry)."""
+        from ..telemetry import flight, tracing
+        if not tracing.ACTIVE[0]:
+            return
+        tracing.mark("fault_injected")
+        fields = {k: str(v) for k, v in ctx.items()
+                  if isinstance(v, (str, int, float, bool))}
+        # explicit keys win over same-named fire-context keys (a
+        # where-matcher like kind="infer" rides in ctx)
+        fields.update(site=site, fault_kind=rule.kind, rule=rule.index)
+        flight.record("fault", **fields)
 
     def _act(self, rule, site, ctx):
         tag = rule.message or (

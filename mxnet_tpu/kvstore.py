@@ -27,6 +27,7 @@ import time as _time
 from .base import MXNetError
 from .fault import hooks as _fault
 from .ndarray import NDArray, zeros
+from .telemetry import tracing as _tracing
 from . import optimizer as opt
 
 __all__ = ["KVStore", "KVStoreDist", "create"]
@@ -64,8 +65,9 @@ def _instrumented(op):
                                                 False):
                 _TELEM_TL.fault_busy = True
                 try:
-                    _fault.fire("kvstore." + op)
-                    return wrapper(self, key, *args, **kwargs)
+                    with _tracing.span("kvstore." + op):
+                        _fault.fire("kvstore." + op)
+                        return wrapper(self, key, *args, **kwargs)
                 finally:
                     _TELEM_TL.fault_busy = False
             if not telemetry.enabled() or getattr(_TELEM_TL, "busy", False):
@@ -529,8 +531,9 @@ class KVStoreDist(KVStoreTPU):
         # whole step (peer="all": there is no single victim link in an
         # all-reduce, the step either completes everywhere or nowhere)
         if _fault.ACTIVE[0]:
-            _fault.fire("transport.collective", peer="all",
-                        keys=len(arrs))
+            with _tracing.span("transport.collective", keys=len(arrs)):
+                _fault.fire("transport.collective", peer="all",
+                            keys=len(arrs))
         if jax.process_count() == 1:
             return list(arrs)
         mesh = self._global_mesh()

@@ -58,6 +58,7 @@ import zlib
 
 from ..fault import hooks as _fault
 from ..fault.plan import Reorder
+from ..telemetry import tracing as _trace
 
 __all__ = ["InboxFull", "Message", "SpoolTransport"]
 
@@ -191,41 +192,49 @@ class SpoolTransport:
             with self._lock:
                 self._stats["resent"] += 1
         record = (peer, kind, dict(meta or {}), dict(arrays or {}), seq)
-        try:
-            if _fault.ACTIVE[0]:
-                _fault.fire("transport.send", peer=str(peer), kind=kind,
-                            sender=self.rank, seq=seq)
-        except Reorder:
-            with self._lock:
-                self._held.setdefault(int(peer), []).append(record)
-                self._stats["reordered"] += 1
-            return seq
-        except ConnectionError:
-            with self._lock:
-                self._stats["send_failures"] += 1
-            raise
-        self._publish(record)
-        with self._lock:
-            self._stats["sent"] += 1
-            held = self._held.pop(int(peer), [])
-        # adjacent swap: anything parked by a reorder fault goes out
-        # right AFTER the message that overtook it — stamped strictly
-        # later, or the receiver's (ms, sender, seq) arrival sort would
-        # put the lower seq first again and the swap would be invisible
-        late = _now_ms() + 1
-        for i, rec in enumerate(held):
-            self._publish(rec, ms=late + i)
+        # the frame carries the sender's trace context (the "_trace"
+        # header) so the receiving process stitches its spans into the
+        # same trace — a resubmitted request keeps ONE trace id across
+        # replica deaths
+        _trace.inject(record[2])
+        with _trace.span("transport.send", peer=str(peer), kind=kind,
+                         seq=seq) as _sp:
+            try:
+                if _fault.ACTIVE[0]:
+                    _fault.fire("transport.send", peer=str(peer),
+                                kind=kind, sender=self.rank, seq=seq)
+            except Reorder:
+                with self._lock:
+                    self._held.setdefault(int(peer), []).append(record)
+                    self._stats["reordered"] += 1
+                _sp.tag(reordered=True)
+                return seq
+            except ConnectionError:
+                with self._lock:
+                    self._stats["send_failures"] += 1
+                raise
+            self._publish(record)
             with self._lock:
                 self._stats["sent"] += 1
-        try:
-            if _fault.ACTIVE[0]:
-                _fault.fire("transport.send.ack", peer=str(peer),
-                            kind=kind, sender=self.rank, seq=seq)
-        except ConnectionError:
-            with self._lock:
-                self._stats["send_failures"] += 1
-            raise
-        return seq
+                held = self._held.pop(int(peer), [])
+            # adjacent swap: anything parked by a reorder fault goes out
+            # right AFTER the message that overtook it — stamped strictly
+            # later, or the receiver's (ms, sender, seq) arrival sort would
+            # put the lower seq first again and the swap would be invisible
+            late = _now_ms() + 1
+            for i, rec in enumerate(held):
+                self._publish(rec, ms=late + i)
+                with self._lock:
+                    self._stats["sent"] += 1
+            try:
+                if _fault.ACTIVE[0]:
+                    _fault.fire("transport.send.ack", peer=str(peer),
+                                kind=kind, sender=self.rank, seq=seq)
+            except ConnectionError:
+                with self._lock:
+                    self._stats["send_failures"] += 1
+                raise
+            return seq
 
     def send_reliable(self, peer, kind, meta=None, arrays=None,
                       retries=None):
@@ -366,26 +375,33 @@ class SpoolTransport:
                     self._stats["duplicates_dropped"] += 1
                 self._remove(path)
                 continue
-            try:
-                if _fault.ACTIVE[0]:
-                    _fault.fire("transport.recv", peer=str(sender),
-                                kind=kind, seq=seq)
-            except Reorder:
-                # skip it THIS scan: later arrivals overtake it, the
-                # next poll delivers it — receive-side adjacent swap
+            # parent the delivery span under the SENDER's context (the
+            # frame's "_trace" header), not this thread's — that is the
+            # cross-process stitch
+            with _trace.span("transport.recv", ctx=_trace.extract(header),
+                             peer=str(sender), kind=kind, seq=seq) as _sp:
+                try:
+                    if _fault.ACTIVE[0]:
+                        _fault.fire("transport.recv", peer=str(sender),
+                                    kind=kind, seq=seq)
+                except Reorder:
+                    # skip it THIS scan: later arrivals overtake it, the
+                    # next poll delivers it — receive-side adjacent swap
+                    with self._lock:
+                        self._stats["reordered"] += 1
+                    _sp.tag(reordered=True)
+                    continue
+                except ConnectionError:
+                    # receive-side partition: end this poll; everything
+                    # undelivered (this file included) stays spooled
+                    _sp.tag(partition=True)
+                    break
                 with self._lock:
-                    self._stats["reordered"] += 1
-                continue
-            except ConnectionError:
-                # receive-side partition: end this poll; everything
-                # undelivered (this file included) stays spooled
-                break
-            with self._lock:
-                self._seen[incarnation].add(seq)
-                self._stats["received"] += 1
-            self._remove(path)
-            out.append(Message(sender, seq, kind, header, arrays,
-                               epoch=incarnation[1]))
+                    self._seen[incarnation].add(seq)
+                    self._stats["received"] += 1
+                self._remove(path)
+                out.append(Message(sender, seq, kind, header, arrays,
+                                   epoch=incarnation[1]))
         return out
 
     def recv_wait(self, timeout_s=5.0, max_messages=0, poll_s=None):

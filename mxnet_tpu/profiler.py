@@ -133,6 +133,14 @@ def dumps(reset=False):
         extra = _telemetry.chrome_counter_events(_now_us())
     except Exception:
         extra = []
+    try:
+        # same bridge for request tracing: completed spans ride the
+        # profiler dump as 'X' events keyed by trace id (tools/trace.py
+        # merges the per-process shards; this is the one-file view)
+        from .telemetry import tracing as _tracing
+        extra += _tracing.chrome_events()
+    except Exception:
+        pass
     with _STATE["lock"]:
         events = list(_STATE["events"]) + extra
         if reset:

@@ -33,6 +33,7 @@ from .. import telemetry
 from ..analysis.sanitizers import hooks as _san_hooks
 from ..fault import hooks as _fault
 from ..predictor import Predictor
+from ..telemetry import tracing as _trace
 
 __all__ = ["ExecutorCache"]
 
@@ -104,42 +105,48 @@ class ExecutorCache:
         # needed it (worker_scope delivers to its futures); the batcher
         # and every cached entry keep serving
         bucket = self._norm_bucket(bucket)
-        if _fault.ACTIVE[0]:
-            _fault.fire("serving.cache.get", model=entry.name,
-                        bucket=bucket)
-        key = (entry.name, entry.version, id(entry), bucket)
-        with self._lock:
-            cached = self._entries.get(key)
-            if cached is not None:
-                self.hits += 1
-                self._count_locked(entry.name, "hits")
-                self._t_events.labels(outcome="hit",
+        with _trace.span("serving.cache.get", model=entry.name,
+                         bucket=str(bucket)) as _sp:
+            if _fault.ACTIVE[0]:
+                _fault.fire("serving.cache.get", model=entry.name,
+                            bucket=bucket)
+            key = (entry.name, entry.version, id(entry), bucket)
+            with self._lock:
+                cached = self._entries.get(key)
+                if cached is not None:
+                    self.hits += 1
+                    self._count_locked(entry.name, "hits")
+                    self._t_events.labels(outcome="hit",
+                                          model=entry.name).inc()
+                    self._entries.move_to_end(key)
+                    _sp.tag(outcome="hit")
+                    return cached[1]
+            # bind OUTSIDE the lock: a compile can take seconds and must
+            # not stall concurrent lookups of already-cached buckets
+            if binder is not None:
+                pred = binder()
+            else:
+                pred = Predictor.from_parts(entry.symbol,
+                                            entry.arg_params,
+                                            entry.aux_params,
+                                            entry.full_shapes(bucket))
+            with self._lock:
+                race = self._entries.get(key)
+                if race is not None:    # another thread bound it first
+                    self.hits += 1
+                    self._count_locked(entry.name, "hits")
+                    self._t_events.labels(outcome="hit",
+                                          model=entry.name).inc()
+                    self._entries.move_to_end(key)
+                    _sp.tag(outcome="hit")
+                    return race[1]
+                self.misses += 1
+                self._count_locked(entry.name, "misses")
+                self._t_events.labels(outcome="miss",
                                       model=entry.name).inc()
-                self._entries.move_to_end(key)
-                return cached[1]
-        # bind OUTSIDE the lock: a compile can take seconds and must not
-        # stall concurrent lookups of already-cached buckets
-        if binder is not None:
-            pred = binder()
-        else:
-            pred = Predictor.from_parts(entry.symbol, entry.arg_params,
-                                        entry.aux_params,
-                                        entry.full_shapes(bucket))
-        with self._lock:
-            race = self._entries.get(key)
-            if race is not None:        # another thread bound it first
-                self.hits += 1
-                self._count_locked(entry.name, "hits")
-                self._t_events.labels(outcome="hit",
-                                      model=entry.name).inc()
-                self._entries.move_to_end(key)
-                return race[1]
-            self.misses += 1
-            self._count_locked(entry.name, "misses")
-            self._t_events.labels(outcome="miss",
-                                  model=entry.name).inc()
-            self._entries[key] = (entry, pred)
-            self._evict_locked(entry.name)
+                self._entries[key] = (entry, pred)
+                self._evict_locked(entry.name)
+            _sp.tag(outcome="miss")
         if self._on_miss is not None:
             try:
                 self._on_miss(entry, bucket)

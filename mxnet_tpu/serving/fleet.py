@@ -48,6 +48,8 @@ import numpy as np
 from .. import config
 from ..fault.backoff import BackoffPolicy
 from ..parallel.transport import SpoolTransport
+from ..telemetry import flight as _flight
+from ..telemetry import tracing as _trace
 from .canary import CanaryState
 from .errors import (BadRequest, DeadlineExceeded, ModelNotFound, QueueFull,
                      ServerClosed, ServingError, _RetryHinted)
@@ -104,22 +106,42 @@ def replica_loop(server, transport, front=0, stop_event=None,
             if m.kind != "infer":
                 continue
             meta = {"id": m.meta["id"]}
-            try:
-                outs = server.infer(m.meta["model"], dict(m.arrays),
-                                    timeout_ms=m.meta.get("timeout_ms"),
-                                    priority=m.meta.get("priority"))
-                meta["ok"] = True
-                arrays = {"out%03d" % i: np.asarray(o)
-                          for i, o in enumerate(outs)}
-                transport.send_reliable(front, "result", meta=meta,
-                                        arrays=arrays)
-            except Exception as exc:  # typed errors cross the wire
-                meta["ok"] = False
-                meta["error"] = encode_error(exc)
+            # stitch into the front door's trace (the frame's _trace
+            # header); a request resubmitted after a replica death is
+            # anomalous by definition — the SURVIVOR retains it, since
+            # the victim's ring died with it
+            hdr_ctx = _trace.extract(m.meta)
+            resub = int(m.meta.get("resubmits") or 0)
+            with _trace.use(hdr_ctx), \
+                    _trace.span("replica.serve", req=m.meta["id"],
+                                model=m.meta.get("model"),
+                                resubmits=resub) as _sp:
+                if resub and hdr_ctx is not None:
+                    _trace.mark("resubmitted", hdr_ctx)
                 try:
-                    transport.send_reliable(front, "result", meta=meta)
-                except ConnectionError:
-                    pass  # result link dead: the front door resubmits
+                    outs = server.infer(m.meta["model"], dict(m.arrays),
+                                        timeout_ms=m.meta.get("timeout_ms"),
+                                        priority=m.meta.get("priority"))
+                    meta["ok"] = True
+                    arrays = {"out%03d" % i: np.asarray(o)
+                              for i, o in enumerate(outs)}
+                    transport.send_reliable(front, "result", meta=meta,
+                                            arrays=arrays)
+                except Exception as exc:  # typed errors cross the wire
+                    _sp.finish(status=type(exc).__name__)
+                    meta["ok"] = False
+                    meta["error"] = encode_error(exc)
+                    try:
+                        transport.send_reliable(front, "result", meta=meta)
+                    except ConnectionError:
+                        pass  # result link dead: the front door resubmits
+            if _trace.ACTIVE[0]:
+                # this process's share of the trace is done (its root
+                # finishes remotely, in the front door) — declare it
+                # eligible and persist NOW, so a later SIGKILL cannot
+                # lose spans of already-served requests
+                _trace.complete(hdr_ctx)
+                _trace.flush()
 
 
 class ReplicaHandle:
@@ -326,71 +348,105 @@ class FleetFrontDoor:
         if priority is not None:
             meta["priority"] = int(priority)
         queue_retries = 0
+        # the request's trace root: every route attempt, transport frame
+        # and (via the _trace header) remote replica span parents here
+        _root = _trace.start_span(
+            "fleet.infer", ctx=_trace.mint(model=str(name),
+                                           priority=priority)
+            if _trace.ACTIVE[0] else None, req=req_id)
         try:
-            while True:
-                rid = self._pick()
-                if rid is None:
-                    self._finish(req_id, "failed")
-                    raise ServingError(
-                        "fleet: no healthy replicas "
-                        "(status %r)" % (self.replica_status(),))
-                pend = _Pending()
-                with self._lock:
-                    self._pending[req_id] = pend
-                try:
-                    self._transport.send_reliable(rid, "infer", meta=meta,
-                                                  arrays=arrays)
-                except ConnectionError:
-                    # link to THIS replica is down: eject + try the next
-                    self._eject(rid, "unreachable")
-                    with self._lock:
-                        self._ledger["resubmitted"] += 1
-                    continue
-                # wait in slices so a SIGKILLed replica is noticed in
-                # ~100ms, not after the full request timeout
-                deadline = time.monotonic() + self._request_timeout_s
-                got = False
+            with _trace.use(_root.ctx):
                 while True:
-                    if pend.event.wait(0.1):
-                        got = True
-                        break
-                    if not self._handle_alive(rid) \
-                            or time.monotonic() >= deadline:
-                        break
-                if not got:
-                    if not self._handle_alive(rid):
-                        # replica died holding the request: same id to
-                        # the next replica — the ledger entry survives
-                        self._eject(rid, "dead")
-                        with self._lock:
-                            self._ledger["resubmitted"] += 1
-                        continue
-                    self._finish(req_id, "expired")
-                    raise DeadlineExceeded(
-                        "fleet: no response for %r from replica %d "
-                        "within %.1fs" % (req_id, rid,
-                                          self._request_timeout_s))
-                self._observe(pend.rid if pend.rid is not None else rid,
-                              pend)
-                if pend.error is not None:
-                    exc = decode_error(pend.error)
-                    if (isinstance(exc, QueueFull)
-                            and queue_retries < self._submit_retries):
-                        with self._lock:
-                            self._ledger["retried"] += 1
-                            if exc.retry_after_s is not None:
-                                self._ledger["hint_floors"] += 1
-                                self._last_hint = exc.retry_after_s
-                        self._submit_backoff.sleep_for(
-                            queue_retries,
-                            floor_s=exc.retry_after_s or 0.0)
-                        queue_retries += 1
-                        continue
-                    self._finish(req_id, "failed")
-                    raise exc
-                self._finish(req_id, "served")
-                return [pend.arrays[k] for k in sorted(pend.arrays)]
+                    rid = self._pick()
+                    if rid is None:
+                        self._finish(req_id, "failed")
+                        _root.finish(status="no_replicas")
+                        raise ServingError(
+                            "fleet: no healthy replicas "
+                            "(status %r)" % (self.replica_status(),))
+                    pend = _Pending()
+                    with self._lock:
+                        self._pending[req_id] = pend
+                    # one span per route ATTEMPT: a dead replica closes
+                    # this one "replica_dead" and the next attempt opens
+                    # a sibling — the merged trace shows route -> death
+                    # -> resubmit -> serve as four children of the root
+                    with _trace.span("fleet.route", rid=rid,
+                                     req=req_id) as _rsp:
+                        try:
+                            self._transport.send_reliable(
+                                rid, "infer", meta=meta, arrays=arrays)
+                        except ConnectionError:
+                            # link to THIS replica is down: eject + next
+                            _rsp.finish(status="unreachable")
+                            self._eject(rid, "unreachable")
+                            with self._lock:
+                                self._ledger["resubmitted"] += 1
+                            meta["resubmits"] = meta.get("resubmits",
+                                                         0) + 1
+                            continue
+                        # wait in slices so a SIGKILLed replica is
+                        # noticed in ~100ms, not after the full timeout
+                        deadline = (time.monotonic()
+                                    + self._request_timeout_s)
+                        got = False
+                        while True:
+                            if pend.event.wait(0.1):
+                                got = True
+                                break
+                            if not self._handle_alive(rid) \
+                                    or time.monotonic() >= deadline:
+                                break
+                        if not got:
+                            if not self._handle_alive(rid):
+                                # replica died holding the request: same
+                                # id to the next replica — the ledger
+                                # entry survives, and so does the TRACE:
+                                # the resubmitted frame carries the same
+                                # trace id, so the survivor stitches in
+                                _rsp.finish(status="replica_dead")
+                                self._eject(rid, "dead")
+                                with self._lock:
+                                    self._ledger["resubmitted"] += 1
+                                meta["resubmits"] = meta.get(
+                                    "resubmits", 0) + 1
+                                continue
+                            _rsp.finish(status="timeout")
+                            self._finish(req_id, "expired")
+                            _root.finish(status="deadline")
+                            raise DeadlineExceeded(
+                                "fleet: no response for %r from replica "
+                                "%d within %.1fs"
+                                % (req_id, rid, self._request_timeout_s))
+                        _rsp.finish(
+                            rid_served=pend.rid if pend.rid is not None
+                            else rid)
+                    self._observe(pend.rid if pend.rid is not None
+                                  else rid, pend)
+                    if pend.error is not None:
+                        exc = decode_error(pend.error)
+                        if (isinstance(exc, QueueFull)
+                                and queue_retries < self._submit_retries):
+                            with self._lock:
+                                self._ledger["retried"] += 1
+                                if exc.retry_after_s is not None:
+                                    self._ledger["hint_floors"] += 1
+                                    self._last_hint = exc.retry_after_s
+                            self._submit_backoff.sleep_for(
+                                queue_retries,
+                                floor_s=exc.retry_after_s or 0.0)
+                            queue_retries += 1
+                            continue
+                        self._finish(req_id, "failed")
+                        _root.finish(status=type(exc).__name__)
+                        raise exc
+                    self._finish(req_id, "served")
+                    _root.finish()
+                    return [pend.arrays[k] for k in sorted(pend.arrays)]
         finally:
+            # catch-all for escapes that bypassed a terminal finish
+            # (idempotent: the happy/typed paths already closed it)
+            _root.finish(status="aborted")
             with self._lock:
                 self._pending.pop(req_id, None)
 
@@ -478,6 +534,7 @@ class FleetFrontDoor:
             st.next_probe_s = time.monotonic()
             st.reset_window()
             self._ledger["ejections"] += 1
+        _flight.record("replica_ejected", rid=rid, reason=reason)
 
     def _health_loop(self):
         while not self._stop.wait(self._health_interval_s):
@@ -536,6 +593,7 @@ class FleetFrontDoor:
                     st.reason = None
                     st.reset_window()
                     self._ledger["readmissions"] += 1
+                _flight.record("replica_readmitted", rid=rid)
         except ConnectionError:
             pass  # still partitioned; next tick probes again
         finally:
@@ -563,6 +621,10 @@ class FleetFrontDoor:
         self._stop.set()
         self._rx.join(timeout=5)
         self._health_thread.join(timeout=5)
+        if not self.ledger_balanced():
+            with self._lock:
+                led = dict(self._ledger)
+            _flight.incident("ledger_imbalance", scope="fleet", **led)
         with self._lock:
             handles = list(self._handles.values())
         for h in handles:

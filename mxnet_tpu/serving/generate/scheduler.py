@@ -48,6 +48,7 @@ import numpy as np
 from ... import telemetry
 from ...analysis.sanitizers import hooks as _san_hooks
 from ...fault import hooks as _fault
+from ...telemetry import tracing as _trace
 from ..bucketing import pick_bucket
 from ..errors import BadRequest, DeadlineExceeded, QueueFull, ServerClosed
 from .stream import TokenStream
@@ -141,12 +142,25 @@ class DecodeScheduler:
                     if timeout_ms is not None else None)
         stream = TokenStream(self.model.name, tenant, priority,
                              max_new_tokens, deadline=deadline)
+        if _trace.ACTIVE[0]:
+            ctx = _trace.current() or _trace.mint(
+                model=self.model.name, tenant=tenant)
+            root = _trace.start_span(
+                "gen.request", ctx=ctx, model=self.model.name,
+                tenant=tenant, priority=int(priority),
+                max_new_tokens=int(max_new_tokens))
+            stream._span = root
+            stream.trace = root.ctx
         with self._cv:
             if self._closed:
+                if stream._span is not None:
+                    stream._span.finish(status="closed")
                 raise ServerClosed("scheduler for %r is stopped"
                                    % self.model.name)
             if len(self._pending) >= self.queue_depth:
                 self._rejected_full += 1
+                if stream._span is not None:
+                    stream._span.finish(status="rejected_queue_full")
                 raise QueueFull(
                     "generative queue for %r full (%d pending)"
                     % (self.model.name, len(self._pending)),
@@ -333,8 +347,13 @@ class DecodeScheduler:
                 self.model.admit(self.state, slot, k_hist[:, row],
                                  v_hist[:, row])
                 self.state.occupy(slot, prompt.size, first[row])
-                self._slot_meta[slot] = {"stream": stream,
-                                         "prompt_len": prompt.size}
+                meta = {"stream": stream, "prompt_len": prompt.size}
+                if _trace.ACTIVE[0] and stream.trace is not None:
+                    # one span per slot-occupancy epoch, not per token
+                    meta["span"] = _trace.start_span(
+                        "gen.occupy", ctx=stream.trace, slot=int(slot),
+                        tenant=stream.tenant)
+                self._slot_meta[slot] = meta
                 stream.put(first[row])
                 if stream.ttft_s is not None:
                     self._t_ttft.observe(stream.ttft_s)
@@ -348,36 +367,40 @@ class DecodeScheduler:
         """ONE decode step over the whole pool, then commit per slot —
         the fault site sits between compute and commit so a poisoned
         slot's token is simply never committed."""
-        t0 = time.perf_counter()
-        nxt = self.model.decode_step(self.state)
-        dt = time.perf_counter() - t0
-        with self._cv:
-            self._steps += 1
-            active = [s for s in list(self._slot_meta)
-                      if self.state.active[s]]
-            per_tok = dt / max(1, len(active))
-            for slot in active:
-                meta = self._slot_meta[slot]
-                stream = meta["stream"]
-                if _fault.ACTIVE[0]:
-                    try:
-                        _fault.fire("serving.decode.step",
-                                    model=self.model.name, slot=slot,
-                                    tenant=stream.tenant)
-                    except Exception as exc:
-                        self._finish_locked(stream, "failed", exc)
-                        self._release_locked(slot)
-                        continue
-                tok = int(nxt[slot])
-                self.state.advance(slot, tok)
-                stream.put(tok)
-                self._token_costs.append(per_tok)
-                self._t_per_token.observe(per_tok)
-                self._t_per_token.labels(
-                    model=self.model.name).observe(per_tok)
-                self._retire_if_done_locked(slot, tok)
-            self._publish_slots_locked()
-            self._cv.notify_all()
+        with _trace.span("gen.decode_step",
+                         model=self.model.name) as _sp:
+            t0 = time.perf_counter()
+            nxt = self.model.decode_step(self.state)
+            dt = time.perf_counter() - t0
+            with self._cv:
+                self._steps += 1
+                active = [s for s in list(self._slot_meta)
+                          if self.state.active[s]]
+                _sp.tag(active=len(active))
+                per_tok = dt / max(1, len(active))
+                for slot in active:
+                    meta = self._slot_meta[slot]
+                    stream = meta["stream"]
+                    if _fault.ACTIVE[0]:
+                        try:
+                            _fault.fire("serving.decode.step",
+                                        model=self.model.name,
+                                        slot=slot,
+                                        tenant=stream.tenant)
+                        except Exception as exc:
+                            self._finish_locked(stream, "failed", exc)
+                            self._release_locked(slot)
+                            continue
+                    tok = int(nxt[slot])
+                    self.state.advance(slot, tok)
+                    stream.put(tok)
+                    self._token_costs.append(per_tok)
+                    self._t_per_token.observe(per_tok)
+                    self._t_per_token.labels(
+                        model=self.model.name).observe(per_tok)
+                    self._retire_if_done_locked(slot, tok)
+                self._publish_slots_locked()
+                self._cv.notify_all()
 
     def _retire_if_done_locked(self, slot, last_token):
         meta = self._slot_meta.get(slot)
@@ -398,7 +421,11 @@ class DecodeScheduler:
 
     def _release_locked(self, slot):
         self.state.release(slot)
-        self._slot_meta.pop(slot, None)
+        meta = self._slot_meta.pop(slot, None)
+        if meta is not None:
+            span = meta.get("span")
+            if span is not None:
+                span.finish(tokens=meta["stream"].n_tokens)
         self._publish_slots_locked()
 
     def _publish_slots_locked(self):

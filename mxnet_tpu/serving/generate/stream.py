@@ -43,6 +43,8 @@ class TokenStream:
         self._read = 0                      # consumer cursor (1 thread)
         self._last_emit_s = None            # producer-only
         self._cv = threading.Condition()
+        self.trace = None                   # TraceContext, set at submit
+        self._span = None                   # root span; finish() closes
 
     # -- producer side (decode scheduler) ----------------------------
 
@@ -67,8 +69,13 @@ class TokenStream:
                 return False
             self._state = outcome
             self._error = error
+            n = len(self._tokens)
             self._cv.notify_all()
-            return True
+        span = self._span
+        if span is not None:
+            span.finish(status="ok" if outcome == "served"
+                        else str(outcome), tokens=n)
+        return True
 
     @property
     def n_tokens(self):

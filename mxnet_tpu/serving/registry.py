@@ -227,14 +227,16 @@ class CheckpointWatcher:
         None when nothing new (or the newest checkpoint is unservable)."""
         from ..checkpoint import CheckpointError, IntegrityError, TrainState
         from ..fault import hooks as _fault
+        from ..telemetry import tracing as _tracing
         from .. import ndarray as nd
         from ..symbol import load_json
         # graftfault: a poll-time fault must leave the watcher alive and
         # the CURRENT serving default untouched (worker_scope in _loop
         # logs it; a transient read below retries on the shared backoff)
-        if _fault.ACTIVE[0]:
-            _fault.fire("checkpoint.watcher.poll", name=self.name)
-        step = self._store.latest()
+        with _tracing.span("checkpoint.watcher.poll", model=self.name):
+            if _fault.ACTIVE[0]:
+                _fault.fire("checkpoint.watcher.poll", name=self.name)
+            step = self._store.latest()
         if step is None or step <= self._last_step:
             return None
         try:

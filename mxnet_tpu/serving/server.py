@@ -74,6 +74,8 @@ from .. import telemetry
 from ..analysis.sanitizers import hooks as _san_hooks
 from ..fault import hooks as _fault
 from ..io import pad_batch
+from ..telemetry import flight as _flight
+from ..telemetry import tracing as _trace
 from .bucketing import pick_bucket, shape_buckets
 from .cache import ExecutorCache
 from .canary import CanaryState
@@ -98,7 +100,7 @@ class InferenceFuture:
     client never consumes accelerator time retroactively."""
 
     __slots__ = ("_ev", "_lock", "_result", "_exc", "_cancelled",
-                 "_deadline", "_hint")
+                 "_deadline", "_hint", "_span")
 
     def __init__(self, deadline_ms, hint=None):
         self._ev = threading.Event()
@@ -111,6 +113,11 @@ class InferenceFuture:
         # consulted at expiry so the hint reflects the queue NOW, not
         # at submit time
         self._hint = hint
+        # the request's trace root (graftrace): ownership transfers
+        # here at submit, and every terminal path below closes it —
+        # deliver, fail, prune, brownout-shed, stop-leftovers and
+        # client-side expiry all funnel through these three methods
+        self._span = None
 
     def done(self):
         return self._ev.is_set()
@@ -125,7 +132,9 @@ class InferenceFuture:
                 return False
             self._result = value
             self._ev.set()
-            return True
+        if self._span is not None:
+            self._span.finish()
+        return True
 
     def _set_exception(self, exc):
         with self._lock:
@@ -133,7 +142,11 @@ class InferenceFuture:
                 return False
             self._exc = exc
             self._ev.set()
-            return True
+        if self._span is not None:
+            # a failed/shed/expired request is an anomalous trace —
+            # the non-ok status retains it through tail sampling
+            self._span.finish(status=type(exc).__name__)
+        return True
 
     def _expired(self, now_ms):
         return now_ms > self._deadline and not self._ev.is_set()
@@ -153,11 +166,15 @@ class InferenceFuture:
         if not self._ev.is_set() and self._hint is not None:
             hint = self._hint()
         with self._lock:
-            if not self._ev.is_set():
+            expired = not self._ev.is_set()
+            if expired:
                 self._cancelled = True
-                raise DeadlineExceeded(
-                    "deadline passed before a result was delivered",
-                    retry_after_s=hint)
+        if expired:
+            if self._span is not None:
+                self._span.finish(status="deadline")
+            raise DeadlineExceeded(
+                "deadline passed before a result was delivered",
+                retry_after_s=hint)
         if self._exc is not None:
             raise self._exc
         return self._result
@@ -165,10 +182,11 @@ class InferenceFuture:
 
 class _Request:
     __slots__ = ("entry", "inputs", "rows", "future", "gkey", "t_submit",
-                 "solo", "priority")
+                 "solo", "priority", "trace")
 
     def __init__(self, entry, inputs, rows, future, t_submit, solo=False,
                  priority=0):
+        self.trace = None       # graftrace child context (or None)
         self.entry = entry
         self.inputs = inputs
         self.rows = rows
@@ -386,6 +404,8 @@ class ModelServer:
             self._shed_counts[key] = self._shed_counts.get(key, 0) + n
         self._t_sheds.labels(model=model, cls=str(int(cls)),
                              reason=reason).inc(n)
+        _flight.record("shed", model=model, cls=int(cls), reason=reason,
+                       n=n)
 
     # -- model management ---------------------------------------------------
     def load_model(self, name, symbol_file, param_file, input_shapes,
@@ -564,10 +584,12 @@ class ModelServer:
             st.routed += 1
             version = st.canary_version
         if _fault.ACTIVE[0]:
-            # graftfault: a fault here must fail only THIS request's
-            # submit, never the baseline path or the batcher
-            _fault.fire("serving.canary.route", model=name,
-                        version=version)
+            with _trace.span("serving.canary.route", model=name,
+                             version=version):
+                # graftfault: a fault here must fail only THIS request's
+                # submit, never the baseline path or the batcher
+                _fault.fire("serving.canary.route", model=name,
+                            version=version)
         try:
             return self.registry.get(name, version)
         except ModelNotFound:
@@ -599,24 +621,29 @@ class ModelServer:
             decision, reason = verdict
             st.decide(decision, reason)
         try:
-            if _fault.ACTIVE[0]:
-                _fault.fire("serving.canary.promote", model=st.name,
-                            version=st.canary_version, decision=decision)
-            if decision == "promoted":
-                self.registry.set_default(st.name, st.canary_version)
-            else:
-                # unload BEFORE invalidate: a request already routed to
-                # the doomed version can miss the cache the instant its
-                # executors drop, and _execute classifies that rebind
-                # as last-ride cold work by observing the entry is gone
-                # from the registry — invalidate-first would leave a
-                # window where the rebind looks like a steady-state
-                # recompile (flaky san-recompile in the audit gate)
-                try:
-                    self.registry.unload(st.name, st.canary_version)
-                except ModelNotFound:
-                    pass   # already unloaded (operator raced us)
-                self.cache.invalidate(st.name, st.canary_version)
+            with _trace.span("serving.canary.decide", model=st.name,
+                             version=st.canary_version,
+                             decision=decision, reason=reason):
+                if _fault.ACTIVE[0]:
+                    _fault.fire("serving.canary.promote", model=st.name,
+                                version=st.canary_version,
+                                decision=decision)
+                if decision == "promoted":
+                    self.registry.set_default(st.name, st.canary_version)
+                else:
+                    # unload BEFORE invalidate: a request already routed
+                    # to the doomed version can miss the cache the
+                    # instant its executors drop, and _execute
+                    # classifies that rebind as last-ride cold work by
+                    # observing the entry is gone from the registry —
+                    # invalidate-first would leave a window where the
+                    # rebind looks like a steady-state recompile (flaky
+                    # san-recompile in the audit gate)
+                    try:
+                        self.registry.unload(st.name, st.canary_version)
+                    except ModelNotFound:
+                        pass   # already unloaded (operator raced us)
+                    self.cache.invalidate(st.name, st.canary_version)
         # contain-and-retry: the decision runs on the batcher thread
         # inside _execute — an injected/transient promotion failure
         # must fail the PROMOTION (stamp reverted below, retried on
@@ -637,6 +664,12 @@ class ModelServer:
             return
         with self._canary_lock:
             self._finish_canary_locked(st)
+            desc = st.describe()
+        if decision == "rolled_back":
+            # incident trigger: one self-contained post-mortem — the
+            # gate's inputs (describe()) + the flight ring + the
+            # retained anomalous traces, including the victim requests
+            _flight.incident("canary_rollback", **desc)
         import logging
         logging.info("canary of model %r: version %d %s (%s)",
                      st.name, st.canary_version, st.decision, st.reason)
@@ -654,6 +687,7 @@ class ModelServer:
             "terminal canary verdicts by model, decision and reason"
         ).labels(model=st.name, decision=st.decision,
                  reason=st.reason).inc()
+        _flight.record("canary_decision", **st.describe())
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -699,6 +733,13 @@ class ModelServer:
                 self._req_inc("failed", model=name)
             else:
                 self._req_inc("expired", model=name)
+        with self._mlock:
+            counts = dict(self._req_counts)
+        if counts["submitted"] != (counts["served"] + counts["failed"]
+                                   + counts["expired"] + counts["shed"]):
+            # the exactly-once invariant broke: black-box time
+            _flight.incident("ledger_imbalance", scope="server",
+                             **counts)
         if self._san_region is not None:
             self._san_region.close()
             self._san_region = None
@@ -785,8 +826,11 @@ class ModelServer:
     def _submit_async(self, name, inputs, version=None, timeout_ms=None,
                       priority=None, _solo=False):
         entry = self.registry.get(name, version)
+        canary_routed = False
         if version is None and not _solo:
+            baseline_entry = entry
             entry = self._canary_route(name, entry)
+            canary_routed = entry is not baseline_entry
         priority = self._default_priority if priority is None \
             else int(priority)
         if not 0 <= priority < self._priority_classes:
@@ -837,6 +881,22 @@ class ModelServer:
                               hint=lambda: self._retry_after_s(name))
         req = _Request(entry, arrs, rows, fut, now, solo=_solo,
                        priority=priority)
+        if _trace.ACTIVE[0]:
+            # the request's trace root: joins the caller's context when
+            # one exists (a fleet replica serving a routed request),
+            # else mints a fresh trace.  The future owns the span; the
+            # batcher parents its retro queue/execute spans on req.trace
+            _ctx = _trace.current() or _trace.mint(
+                model=name, priority=priority)
+            _root = _trace.start_span(
+                "serving.request", ctx=_ctx, model=name,
+                version=entry.version, rows=rows, priority=priority,
+                deadline_ms=timeout)
+            if canary_routed:
+                _trace.mark("canary_routed", _ctx)
+                _root.tag(canary=True)
+            fut._span = _root
+            req.trace = _root.ctx
         reject = None          # (shed?, message, depth for the hint)
         with self._cv:
             if self._stopping:
@@ -885,6 +945,11 @@ class ModelServer:
             self._req_inc("rejected_queue_full", model=name)
             if shed:
                 self._shed_inc(name, priority, "brownout_reject")
+            if fut._span is not None:
+                fut._span.finish(status="rejected_queue_full",
+                                 brownout=shed)
+            _flight.record("reject", model=name, priority=priority,
+                           brownout=shed, depth=hint_depth)
             raise QueueFull(
                 msg, retry_after_s=self._retry_after_s(
                     name, depth=hint_depth))
@@ -1174,7 +1239,16 @@ class ModelServer:
                     self._canary_observe(_entry, failed=got + gone)
                 return got > 0
 
-            with engine.worker_scope(deliver):
+            # batch assembly crosses request traces; the dispatch span
+            # parents under the LEADER request's context (first traced
+            # request in the batch) so cache get/bind, execute and the
+            # worker fault site all nest inside that request's trace
+            lead = next((r.trace for r in reqs if r.trace is not None),
+                        None)
+            with _trace.use(lead), \
+                    _trace.span("serving.dispatch", model=entry.name,
+                                bucket=bucket, reqs=len(reqs)), \
+                    engine.worker_scope(deliver):
                 # graftfault: a fault on the batcher thread fails THIS
                 # batch's futures through deliver() and the loop keeps
                 # serving — the poisoned-batch isolation contract
@@ -1304,6 +1378,14 @@ class ModelServer:
                 "mxnet_serving_brownout_transitions_total",
                 "brownout mode entries/exits by direction"
             ).labels(dir="enter").inc()
+            _flight.record("brownout", dir="enter", depth=depth,
+                           high=self._brownout_high)
+            # incident trigger (rare by construction — hysteresis — and
+            # capped at MXNET_TRACE_FLIGHT_DUMPS per process); runs
+            # under _cv, the price of dumping the ring exactly at entry
+            _flight.incident("brownout_entry", depth=depth,
+                             high=self._brownout_high,
+                             low=self._brownout_low)
         elif self._brownout and depth <= self._brownout_low:
             self._brownout = False
             self._t_brownout.set(0)
@@ -1311,6 +1393,8 @@ class ModelServer:
                 "mxnet_serving_brownout_transitions_total",
                 "brownout mode entries/exits by direction"
             ).labels(dir="exit").inc()
+            _flight.record("brownout", dir="exit", depth=depth,
+                           low=self._brownout_low)
         if not self._brownout or depth <= self._brownout_high:
             return
         sheddable = sorted(
@@ -1395,28 +1479,32 @@ class ModelServer:
                 doomed = True
         cold_cm = _san_hooks.suspended() if doomed \
             else contextlib.nullcontext()
-        with profiler.scope("serving:batch", cat="serving", args=span_args):
-            with cold_cm:
-                pred = self.cache.get(entry, bucket)
-                feed = {}
-                for k in entry.input_names:
-                    feed[k], _ = pad_batch(
-                        [r.inputs[k] for r in reqs], bucket)
-                pred.forward(**feed)
-                outs = [pred.get_output(i).asnumpy()
-                        for i in range(entry.num_outputs)]
-        if _fault.ACTIVE[0] and self._is_canary_version(name,
-                                                       entry.version):
-            # graftfault: the poisoned-canary site — kind=nan corrupts
-            # this batch's outputs in place (a silently-bad checkpoint),
-            # kind=raise fails the batch (an erroring one); the health
-            # gate below must catch either within its budget.  asnumpy
-            # views of device buffers are read-only, so hand the plan
-            # writable copies (canary batches under an armed plan only)
-            outs = [o.copy() if getattr(o, "flags", None) is not None
-                    and not o.flags.writeable else o for o in outs]
-            _fault.fire("serving.canary.execute", model=name,
-                        version=entry.version, arrays=outs)
+        with _trace.span("serving.batch", model=name, bucket=bucket,
+                         rows=rows_total):
+            with profiler.scope("serving:batch", cat="serving",
+                                args=span_args):
+                with cold_cm:
+                    pred = self.cache.get(entry, bucket)
+                    feed = {}
+                    for k in entry.input_names:
+                        feed[k], _ = pad_batch(
+                            [r.inputs[k] for r in reqs], bucket)
+                    pred.forward(**feed)
+                    outs = [pred.get_output(i).asnumpy()
+                            for i in range(entry.num_outputs)]
+            if _fault.ACTIVE[0] and self._is_canary_version(
+                    name, entry.version):
+                # graftfault: the poisoned-canary site — kind=nan
+                # corrupts this batch's outputs in place (a silently-bad
+                # checkpoint), kind=raise fails the batch (an erroring
+                # one); the health gate below must catch either within
+                # its budget.  asnumpy views of device buffers are
+                # read-only, so hand the plan writable copies (canary
+                # batches under an armed plan only)
+                outs = [o.copy() if getattr(o, "flags", None) is not None
+                        and not o.flags.writeable else o for o in outs]
+                _fault.fire("serving.canary.execute", model=name,
+                            version=entry.version, arrays=outs)
         t_done = _now_ms()
         # the non-finite sentinel runs BEFORE delivery: a client
         # unblocked by a poisoned result could submit its next request
@@ -1438,10 +1526,26 @@ class ModelServer:
         for r in reqs:
             sl = [o[off:off + r.rows] for o in outs]
             off += r.rows
+            if _trace.ACTIVE[0] and r.trace is not None:
+                # retroactive per-request attribution: queue wait and
+                # execute, as children of each request's own root (no
+                # live span object per queued request — two cheap ring
+                # appends at delivery)
+                wall = time.time()
+                _trace.add_span(
+                    "serving.queue", r.trace,
+                    wall - (t_done - r.t_submit) / 1e3,
+                    t_exec0 - r.t_submit)
+                _trace.add_span(
+                    "serving.execute", r.trace,
+                    wall - (t_done - t_exec0) / 1e3,
+                    t_done - t_exec0, bucket=bucket)
             if r.future._set_result(sl):
                 lat = t_done - r.t_submit
                 self._req_inc("served", model=name)
-                self._t_latency.observe(lat)
+                self._t_latency.observe(
+                    lat, exemplar=r.trace.trace_id
+                    if r.trace is not None else None)
                 served_lats.append(lat)
                 with self._mlock:
                     hist = self._latencies.setdefault(name, [])

@@ -33,6 +33,7 @@ from .registry import (Counter, Gauge, Histogram, MetricFamily,
                        MetricsRegistry, exponential_buckets,
                        validate_exposition)
 from .step_logger import StepLogger
+from . import tracing, flight
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
            "MetricsRegistry", "StepLogger", "counter", "gauge",
@@ -40,7 +41,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricFamily",
            "prometheus_text", "write_prometheus", "validate_exposition",
            "exponential_buckets", "enabled", "enable", "disable",
            "reset", "scalar_totals", "publish_to_profiler",
-           "chrome_counter_events"]
+           "chrome_counter_events", "tracing", "flight"]
 
 _REGISTRY = MetricsRegistry()
 _ENABLED = [False]
@@ -144,5 +145,10 @@ atexit.register(_atexit_write)
 # honor the env knob at import so subprocesses (bench legs) need no code
 from .. import config as _config  # noqa: E402
 
+_REGISTRY.set_label_cap(_config.get("MXNET_TELEMETRY_LABEL_CAP"))
+
 if _config.get("MXNET_TELEMETRY"):
     enable()
+
+if _config.get("MXNET_TRACE"):
+    tracing.enable()

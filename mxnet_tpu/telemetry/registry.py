@@ -92,7 +92,8 @@ class Gauge:
 class Histogram:
     """Cumulative histogram over fixed (typically exponential) buckets."""
 
-    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count")
+    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count",
+                 "_exemplars")
 
     def __init__(self, bounds):
         self.bounds = sorted(float(b) for b in bounds)
@@ -102,8 +103,12 @@ class Histogram:
         self._counts = [0] * (len(self.bounds) + 1)   # guarded-by: _lock (last slot = +Inf)
         self._sum = 0.0   # guarded-by: _lock
         self._count = 0   # guarded-by: _lock
+        self._exemplars = {}   # guarded-by: _lock — bucket idx -> (value, id)
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """Record ``value``; an optional ``exemplar`` (a trace id) is
+        retained per bucket for the WORST value seen there, so a slow
+        histogram bucket links back to the trace that filled it."""
         value = float(value)
         i = len(self.bounds)
         for j, b in enumerate(self.bounds):
@@ -114,6 +119,23 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                prev = self._exemplars.get(i)
+                if prev is None or value > prev[0]:
+                    self._exemplars[i] = (value, str(exemplar))
+
+    def exemplars(self):
+        """``{le: {"value": v, "trace": id}}`` — worst exemplar per
+        bucket (exposed via snapshot(), NOT prometheus_text: the 0.0.4
+        text format has no exemplar syntax and the validator is strict)."""
+        with self._lock:
+            ex = dict(self._exemplars)
+        out = {}
+        for i, (v, tid) in ex.items():
+            le = self.bounds[i] if i < len(self.bounds) else math.inf
+            out["+Inf" if math.isinf(le) else le] = {
+                "value": v, "trace": tid}
+        return out
 
     @property
     def count(self):
@@ -135,16 +157,29 @@ class Histogram:
         return out
 
 
-class MetricFamily:
-    """name + type + help owning labeled child series."""
+OVERFLOW_LABEL = "__overflow__"
 
-    def __init__(self, name, kind, help="", child_factory=None):
+
+class MetricFamily:
+    """name + type + help owning labeled child series.
+
+    Cardinality: ``max_children`` (installed by the registry from
+    ``MXNET_TELEMETRY_LABEL_CAP``) caps distinct label sets per family —
+    per-tenant/per-model labels are attacker-sized otherwise.  Past the
+    cap, novel label sets collapse into one shared child whose every
+    label value is ``__overflow__``, and ``on_overflow`` (the registry's
+    spill counter) fires once per spilled set."""
+
+    def __init__(self, name, kind, help="", child_factory=None,
+                 max_children=0, on_overflow=None):
         if not _NAME_RE.match(name):
             raise ValueError("invalid metric name %r" % name)
         self.name = name
         self.kind = kind
         self.help = help
         self._factory = child_factory
+        self._max = int(max_children or 0)
+        self._on_overflow = on_overflow
         self._lock = threading.Lock()
         self._children = OrderedDict()   # guarded-by: _lock
 
@@ -153,12 +188,22 @@ class MetricFamily:
             if not _LABEL_RE.match(k):
                 raise ValueError("invalid label name %r" % k)
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        spilled = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._factory()
-                self._children[key] = child
-            return child
+                if self._max and key and len(self._children) >= self._max:
+                    spilled = True
+                    key = tuple((k, OVERFLOW_LABEL) for k, _v in key)
+                    child = self._children.get(key)
+                if child is None:
+                    child = self._factory()
+                    self._children[key] = child
+        if spilled and self._on_overflow is not None:
+            # outside _lock: the spill counter is another family whose
+            # labels() we must not call re-entrantly
+            self._on_overflow(self.name)
+        return child
 
     def items(self):
         """``[(labels_dict, series), ...]`` snapshot of the children."""
@@ -178,8 +223,11 @@ class MetricFamily:
     def set(self, value):
         self._default().set(value)
 
-    def observe(self, value):
-        self._default().observe(value)
+    def observe(self, value, exemplar=None):
+        self._default().observe(value, exemplar=exemplar)
+
+    def exemplars(self):
+        return self._default().exemplars()
 
     @property
     def value(self):
@@ -205,13 +253,36 @@ class MetricFamily:
 _DEFAULT_BUCKETS = exponential_buckets(1e-5, 4.0, 12)
 
 
+_OVERFLOW_TOTAL = "mxnet_telemetry_label_overflow_total"
+
+
 class MetricsRegistry:
     """Thread-safe family registry with JSON and Prometheus views."""
 
-    def __init__(self):
+    def __init__(self, label_cap=0):
         self._lock = threading.Lock()
         self._families = OrderedDict()   # guarded-by: _lock
         self._generation = 0             # guarded-by: _lock
+        self._label_cap = int(label_cap or 0)   # guarded-by: _lock
+
+    def set_label_cap(self, cap):
+        """Install the per-family label-cardinality cap (0 = uncapped);
+        applies to existing families too."""
+        with self._lock:
+            self._label_cap = int(cap or 0)
+            for fam in self._families.values():
+                if fam.name != _OVERFLOW_TOTAL:
+                    fam._max = self._label_cap
+                    fam._on_overflow = self._record_overflow
+
+    def _record_overflow(self, family_name):
+        """One spill counted per label set collapsed into the overflow
+        child.  Bounded: one series per family name, and the spill
+        counter itself is exempt from the cap (no recursion)."""
+        self.counter(_OVERFLOW_TOTAL,
+                     "label sets collapsed into the __overflow__ child "
+                     "by MXNET_TELEMETRY_LABEL_CAP, by metric family"
+                     ).labels(metric=family_name).inc()
 
     def _get_or_create(self, name, kind, help, factory):
         with self._lock:
@@ -222,7 +293,11 @@ class MetricsRegistry:
                         "metric %r already registered as %s, not %s"
                         % (name, fam.kind, kind))
                 return fam
-            fam = MetricFamily(name, kind, help, factory)
+            cap = 0 if name == _OVERFLOW_TOTAL else self._label_cap
+            fam = MetricFamily(name, kind, help, factory,
+                               max_children=cap,
+                               on_overflow=None if name == _OVERFLOW_TOTAL
+                               else self._record_overflow)
             self._families[name] = fam
             return fam
 
@@ -278,13 +353,17 @@ class MetricsRegistry:
             values = []
             for labels, child in fam.items():
                 if fam.kind == "histogram":
-                    values.append({
+                    row = {
                         "labels": labels,
                         "count": child.count,
                         "sum": child.sum,
                         "buckets": [["+Inf" if math.isinf(le) else le, c]
                                     for le, c in child.buckets()],
-                    })
+                    }
+                    ex = child.exemplars()
+                    if ex:
+                        row["exemplars"] = ex
+                    values.append(row)
                 else:
                     values.append({"labels": labels, "value": child.value})
             snap[fam.name] = {"type": fam.kind, "help": fam.help,

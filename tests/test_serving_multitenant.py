@@ -602,3 +602,12 @@ def test_multitenant_chaos_soak():
     assert report["zero_cross_tenant_evictions"]
     assert report["per_tenant"]["tenantB"]["requests"]["lost"] == 0
     assert report["faults_injected"]["total"] > 0
+    # graftrace rode the soak: the rollback left a flight-recorder
+    # incident dump whose trace set names the victim and not the
+    # bystander (the drill asserts the dump contents; the report
+    # carries the tallies)
+    assert report["tracing"]["incident_dump"]
+    assert report["tracing"]["flight_events"] >= 1
+    assert report["tracing"]["anomalous_traces"] >= 1
+    assert report["tracing"]["victim_traces_retained"] >= 1
+    assert report["tracing"]["bystander_traces_clean"] is True

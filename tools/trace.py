@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""graftrace shard tool — ``tools/trace.py merge SHARD... [options]``.
+
+Each traced process exports one JSONL shard
+(``MXNET_TRACE_DIR/trace-<pid>.jsonl``, one completed span per line).
+A cross-process request — fleet front door in one process, replica
+serve in another — therefore lands split across shards, joined only by
+the ``trace`` id that rode the transport frame headers.  ``merge``
+reassembles them:
+
+    tools/trace.py merge /tmp/traces/trace-*.jsonl
+    tools/trace.py merge /tmp/traces --out merged.json
+    tools/trace.py merge /tmp/traces --chrome merged-chrome.json
+    tools/trace.py merge /tmp/traces --trace t-123-abc --tree
+
+- positional args are shard files OR directories (directories are
+  scanned for ``trace-*.jsonl``);
+- ``--out`` writes ``{"traces": {tid: [spans...]}}`` (stdout default),
+  spans sorted by start timestamp within each trace;
+- ``--chrome`` additionally writes a chrome-trace JSON
+  (``chrome://tracing`` / Perfetto), one row per trace id, so the
+  cross-process request reads as one lane;
+- ``--trace TID`` restricts to one trace; ``--anomalous`` restricts to
+  traces any shard marked anomalous;
+- ``--tree`` pretty-prints each trace as an indented span tree (the
+  incident post-mortem view).
+
+Malformed lines are counted and skipped, never fatal: a shard cut off
+mid-line by a SIGKILLed process is expected input, not an error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import zlib
+
+
+def _shard_files(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(os.path.join(p, n) for n in sorted(os.listdir(p))
+                       if n.startswith("trace-") and n.endswith(".jsonl"))
+        else:
+            out.append(p)
+    return out
+
+
+def load_shards(paths):
+    """Read shard files -> (traces, bad_lines).  ``traces`` maps
+    trace id -> span list sorted by ``ts`` (ties broken by span id so
+    the order is stable across runs)."""
+    traces = {}
+    bad = 0
+    for path in _shard_files(paths):
+        try:
+            f = open(path)
+        except OSError as exc:
+            print("trace: cannot read %s (%s)" % (path, exc),
+                  file=sys.stderr)
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    tid = rec["trace"]
+                except (ValueError, TypeError, KeyError):
+                    bad += 1   # torn tail of a killed process's shard
+                    continue
+                traces.setdefault(tid, []).append(rec)
+    for tid in traces:
+        traces[tid].sort(key=lambda r: (r.get("ts", 0.0),
+                                        str(r.get("span"))))
+    return traces, bad
+
+
+def _anomaly(spans):
+    for rec in spans:
+        if rec.get("anomaly"):
+            return rec["anomaly"]
+    return None
+
+
+def chrome_events(traces):
+    """Merged spans as chrome-trace ``'X'`` events: pid = the recording
+    process, tid = a stable per-trace lane so one request reads as one
+    row even across processes."""
+    events = []
+    for tid, spans in sorted(traces.items()):
+        lane = zlib.crc32(tid.encode()) % 100000
+        for rec in spans:
+            args = {"trace": tid, "span": rec.get("span"),
+                    "parent": rec.get("parent"),
+                    "status": rec.get("status")}
+            for key in ("baggage", "tags", "anomaly"):
+                if rec.get(key):
+                    args[key] = rec[key]
+            events.append({
+                "name": rec.get("name", "?"), "cat": "trace", "ph": "X",
+                "ts": float(rec.get("ts", 0.0)) * 1e6,
+                "dur": float(rec.get("dur_ms", 0.0)) * 1e3,
+                "pid": rec.get("pid", 0), "tid": lane, "args": args})
+    return events
+
+
+def format_tree(tid, spans):
+    """One trace as an indented parent->child text tree (orphans —
+    parents lost with a killed process's ring — root at top level)."""
+    by_id = {rec.get("span"): rec for rec in spans}
+    children = {}
+    roots = []
+    for rec in spans:
+        parent = rec.get("parent")
+        if parent is not None and parent in by_id:
+            children.setdefault(parent, []).append(rec)
+        else:
+            roots.append(rec)
+    lines = ["trace %s%s" % (tid, "  [%s]" % _anomaly(spans)
+                             if _anomaly(spans) else "")]
+
+    def walk(rec, depth):
+        tags = rec.get("tags") or {}
+        extra = ("  " + " ".join("%s=%s" % kv for kv in sorted(
+            tags.items()))) if tags else ""
+        lines.append("%s%-28s %8.3fms  pid=%s status=%s%s" % (
+            "  " * depth, rec.get("name", "?"),
+            float(rec.get("dur_ms", 0.0)), rec.get("pid"),
+            rec.get("status"), extra))
+        for child in children.get(rec.get("span"), ()):
+            walk(child, depth + 1)
+
+    for rec in roots:
+        walk(rec, 1)
+    return "\n".join(lines)
+
+
+def cmd_merge(args):
+    traces, bad = load_shards(args.shards)
+    if args.trace:
+        traces = {t: s for t, s in traces.items() if t == args.trace}
+    if args.anomalous:
+        traces = {t: s for t, s in traces.items() if _anomaly(s)}
+    if args.chrome:
+        payload = {"traceEvents": chrome_events(traces),
+                   "displayTimeUnit": "ms"}
+        with open(args.chrome, "w") as f:
+            json.dump(payload, f, indent=1)
+        print("wrote %s (%d traces)" % (args.chrome, len(traces)),
+              file=sys.stderr)
+    if args.tree:
+        for tid in sorted(traces):
+            print(format_tree(tid, traces[tid]))
+            print()
+    else:
+        doc = {"traces": traces, "bad_lines": bad,
+               "anomalous": {t: _anomaly(s) for t, s in traces.items()
+                             if _anomaly(s)}}
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            print("wrote %s (%d traces, %d bad lines)"
+                  % (args.out, len(traces), bad), file=sys.stderr)
+        else:
+            json.dump(doc, sys.stdout, indent=1, sort_keys=True)
+            print()
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="trace.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser("merge", help="join per-process shards by trace id")
+    m.add_argument("shards", nargs="+",
+                   help="trace-*.jsonl files or directories of them")
+    m.add_argument("--out", help="write merged JSON here (default stdout)")
+    m.add_argument("--chrome", help="also write a chrome-trace JSON here")
+    m.add_argument("--trace", help="restrict to one trace id")
+    m.add_argument("--anomalous", action="store_true",
+                   help="restrict to tail-retained anomalous traces")
+    m.add_argument("--tree", action="store_true",
+                   help="print indented span trees instead of JSON")
+    m.set_defaults(fn=cmd_merge)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
