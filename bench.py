@@ -119,6 +119,74 @@ def _ir_cost_columns():
         return {"ir_error": "cost trace failed: %s" % (exc,)}
 
 
+_SHARDED_SWEEP_SRC = r"""
+import json, os, time
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+from mxnet_tpu.parallel import make_mesh
+from mxnet_tpu.parallel.optimizer import PureAdam
+
+mesh = make_mesh(dp=8)
+ns = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+rng = np.random.RandomState(9)
+sizes = [8 * 8192, 8 * 4096]
+mk = lambda: {"b%d" % i: jax.device_put(
+                  jnp.asarray(rng.randn(n).astype(np.float32)), ns)
+              for i, n in enumerate(sizes)}
+params, grads = mk(), mk()
+opt = PureAdam(1e-3, wd=0.01)
+state = opt.init(params, {k: ns for k in params})
+
+def bench(knob, mesh_arg, iters=20):
+    os.environ["MXNET_PALLAS_FUSED_OPT"] = knob
+    step = jax.jit(lambda p, g, s: opt.apply(p, g, s, flat=True,
+                                             mesh=mesh_arg))
+    p, s = step(params, grads, state)          # compile outside timing
+    jax.block_until_ready(p)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, s = step(p, grads, s)
+    jax.block_until_ready(p)
+    return (time.perf_counter() - t0) / iters * 1e6
+
+us_f = bench("1", mesh)    # shard_map-wrapped fused sweep
+us_t = bench("0", None)    # per-array tree_map oracle
+print(json.dumps({"sharded_fused_us_per_step": round(us_f, 1),
+                  "sharded_treemap_us_per_step": round(us_t, 1),
+                  "sharded_treemap_vs_fused": round(us_t / us_f, 3)}))
+"""
+
+
+def _sharded_sweep_rider(timeout_s):
+    """The ZeRO sharded-sweep A/B: dp8 shard_map-wrapped fused
+    optimizer vs the tree_map oracle, a bounded CPU microbench.  The
+    imagenet workload trains through kvstore/Module.fit, not
+    ``ParallelTrainer``, so the multi-chip sweep (graftkern-gated,
+    ``mesh_sweep_safe``) cannot ride the img/s legs — this measures it
+    directly on an 8-device virtual mesh.  Bit-parity is the drill's
+    bar (``fault/drill.py fused_sweep_parity_drill``); this leg records
+    the timing ratio."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = HERE + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    rc, text = _run_bounded([sys.executable, "-c", _SHARDED_SWEEP_SRC],
+                            env, timeout_s, cwd=HERE)
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                break
+    return {"sharded_sweep_error": "microbench rc=%s with no JSON tail"
+                                   % (rc,)}
+
+
 def main():
     import time
 
@@ -228,6 +296,17 @@ def main():
             # most the in-flight leg, skip markers included
             with open(riders_path, "w") as f:
                 json.dump(riders, f)
+        # sharded-sweep leg: not an img/s run — the trainer here goes
+        # through kvstore, so the ZeRO shard_map sweep gets its own
+        # bounded dp8 CPU microbench (fused vs tree_map step time)
+        to = leg_timeout()
+        if to is None:
+            riders["sharded_sweep_skipped"] = \
+                "secondary wall budget exhausted"
+        else:
+            riders.update(_sharded_sweep_rider(min(to, 300)))
+        with open(riders_path, "w") as f:
+            json.dump(riders, f)
 
 
 if __name__ == "__main__":

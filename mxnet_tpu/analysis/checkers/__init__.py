@@ -6,6 +6,7 @@ from . import fault_sites        # noqa: F401
 from . import global_mutation    # noqa: F401
 from . import host_sync          # noqa: F401
 from . import ir_rules           # noqa: F401
+from . import kern_rules         # noqa: F401
 from . import lock_discipline    # noqa: F401
 from . import mesh_contract      # noqa: F401
 from . import missing_donation   # noqa: F401

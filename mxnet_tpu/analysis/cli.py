@@ -275,12 +275,100 @@ def _ir(args):
     return 1 if new else 0
 
 
+def _load_kern():
+    """Build the kernel catalog (jax required for BlockSpec
+    construction, but nothing traces or compiles — index maps are
+    evaluated with plain ints) and run the kern checkers."""
+    from .checkers.kern_rules import run_kern_checkers
+    from .kern.catalog import kernel_reports
+    reports = kernel_reports()
+    return reports, run_kern_checkers(reports)
+
+
+def _kern_line(report):
+    vmem = report.get("vmem") or {}
+    shard = report.get("shard")
+    verdict = ""
+    if shard is not None:
+        verdict = (", shard-safe (grid dim %s walks axis %s)"
+                   % (shard.get("grid_dim"), shard.get("axis"))
+                   if shard.get("safe")
+                   else ", NOT provably shard-safe")
+    return ("kern %-26s grid %s, vmem %d B of %d B budget%s"
+            % (report["name"], tuple(report["grid"]),
+               vmem.get("bytes_per_instance", 0),
+               vmem.get("budget", 0), verdict))
+
+
+def _kern(args):
+    """``--kern``: graftkern over the in-tree Pallas kernel catalog —
+    grid coverage, VMEM budgets, scalar-prefetch transport, shard_map
+    safety — by abstract interpretation of the kernels' own
+    grid/BlockSpec plans (ops/pallas_kernels.py builds them for its
+    dispatch; the catalog re-reads the same objects).  Like --plan
+    this imports the package (jax required) but NOTHING traces or
+    compiles — index maps are evaluated with plain Python ints.  The
+    per-kernel VMEM predictions print beside the plan leg's HBM
+    numbers under --all (one byte story per step: HBM from graftplan,
+    VMEM from graftkern)."""
+    import json
+
+    from .checkers.kern_rules import KERN_RULES
+
+    if _bad_rules(args.rules):
+        return 2
+    reports, findings = _load_kern()
+    if args.rules:
+        findings = [f for f in findings if f.rule in set(args.rules)]
+    baseline_path = args.baseline or baseline_mod.default_path(repo_root())
+    if args.update_baseline:
+        return _restricted_update(findings, baseline_path, KERN_RULES,
+                                  narrowed=args.rules)
+    known = {} if args.no_baseline else baseline_mod.load(baseline_path)
+    new, old = baseline_mod.filter_new(findings, known)
+    if args.sarif:
+        doc = json.loads(sarif_report(new, old))
+        doc["runs"][0]["properties"] = {
+            "graftkern": {"kernels": [r["name"] for r in reports]}}
+        print(json.dumps(doc, indent=1))
+    elif args.json:
+        doc = json.loads(json_report(new, old))
+        doc["kern"] = {"reports": reports}
+        print(json.dumps(doc, indent=1))
+    else:
+        for r in reports:
+            print(_kern_line(r))
+        print(human_report(new, old, show_baselined=args.show_baselined))
+        cands = [r for r in reports if r.get("shard") is not None]
+        safe = sum(1 for r in cands if r["shard"].get("safe"))
+        print("graftkern: %d kernel%s analyzed, %d of %d shard_map "
+              "candidate%s provably safe"
+              % (len(reports), "s" if len(reports) != 1 else "",
+                 safe, len(cands), "s" if len(cands) != 1 else ""))
+    return 1 if new else 0
+
+
+def _kern_relevant(paths):
+    """Whether a --changed path set can affect the kernel catalog:
+    the kernels themselves (ops/pallas_kernels.py), anything in the
+    analysis package (checkers/catalog/engine), or config.py (the
+    VMEM budget and family knobs feed the reports)."""
+    for p in paths:
+        rel = p.replace(os.sep, "/")
+        if rel.endswith("ops/pallas_kernels.py") \
+                or rel.endswith("mxnet_tpu/config.py") \
+                or "mxnet_tpu/analysis" in rel:
+            return True
+    return False
+
+
 def _all(args):
-    """``--all``: lint + plan + ir in ONE process with one merged
-    baseline pass and one exit code — the single entry point tier-1
-    and CI call instead of three.  The plan's closed-loop verification
-    still fails the run even when its findings are baselined; the IR
-    leg honors the MXNET_IR master switch."""
+    """``--all``: lint + plan + ir + kern in ONE process with one
+    merged baseline pass and one exit code — the single entry point
+    tier-1 and CI call instead of four.  The plan's closed-loop
+    verification still fails the run even when its findings are
+    baselined; the IR leg honors the MXNET_IR master switch and the
+    kern leg honors MXNET_KERN."""
     import json
 
     from mxnet_tpu import config as _config
@@ -311,7 +399,13 @@ def _all(args):
         ir_reports, ir_findings = _load_ir(live_configs=live)
         _write_cost_report(ir_reports)
 
-    findings = list(static) + list(plan_findings) + list(ir_findings)
+    kern_reports, kern_findings = [], []
+    kern_on = bool(_config.get("MXNET_KERN"))
+    if kern_on:
+        kern_reports, kern_findings = _load_kern()
+
+    findings = (list(static) + list(plan_findings) + list(ir_findings)
+                + list(kern_findings))
     if args.rules:
         wanted = set(args.rules)
         findings = [f for f in findings
@@ -320,13 +414,17 @@ def _all(args):
     if args.update_baseline:
         # full-scope merge: every leg re-derived in this run, so only
         # audit annotations need carrying over (narrowed --rule runs
-        # still preserve out-of-scope entries).  A skipped IR leg
-        # (MXNET_IR=0) re-derived nothing — its rules leave the scope
-        # so accepted ir-* entries are preserved, not silently dropped
+        # still preserve out-of-scope entries).  A skipped IR/kern leg
+        # (MXNET_IR=0 / MXNET_KERN=0) re-derived nothing — its rules
+        # leave the scope so accepted entries are preserved, not
+        # silently dropped
         from .checkers.ir_rules import IR_RULES
+        from .checkers.kern_rules import KERN_RULES
         scope = set(rule_ids()) | {"parse-error", "stale-suppression"}
         if not ir_on:
             scope -= set(IR_RULES)
+        if not kern_on:
+            scope -= set(KERN_RULES)
         return _restricted_update(findings, baseline_path, scope,
                                   narrowed=args.rules)
     known = {} if args.no_baseline else baseline_mod.load(baseline_path)
@@ -338,26 +436,39 @@ def _all(args):
                 "plan_configs": [r["name"] for r in plan_reports],
                 "verify_problems": verify_problems,
                 "ir_programs": [r["name"] for r in ir_reports],
-                "ir_enabled": ir_on}}
+                "ir_enabled": ir_on,
+                "kern_kernels": [r["name"] for r in kern_reports],
+                "kern_enabled": kern_on}}
         print(json.dumps(doc, indent=1))
     elif args.json:
         doc = json.loads(json_report(new, old))
         doc["plan"] = {"reports": plan_reports,
                        "verify_problems": verify_problems}
         doc["ir"] = {"enabled": ir_on, "reports": ir_reports}
+        doc["kern"] = {"enabled": kern_on, "reports": kern_reports}
         print(json.dumps(doc, indent=1))
     else:
         for p in verify_problems:
             print("PREDICTION MISMATCH: %s" % p)
         if not ir_on:
             print("graftir: skipped (MXNET_IR=0)")
+        if not kern_on:
+            print("graftkern: skipped (MXNET_KERN=0)")
+        else:
+            # VMEM predictions beside the plan leg's HBM numbers —
+            # one byte story per step
+            for r in kern_reports:
+                print(_kern_line(r))
         print(human_report(new, old, show_baselined=args.show_baselined))
-        print("graftlint --all: %d static + %d plan + %d ir findings "
-              "before baseline; %d plan config%s, %d traced program%s"
+        print("graftlint --all: %d static + %d plan + %d ir + %d kern "
+              "findings before baseline; %d plan config%s, %d traced "
+              "program%s, %d kernel%s"
               % (len(static), len(plan_findings), len(ir_findings),
-                 len(plan_reports),
+                 len(kern_findings), len(plan_reports),
                  "s" if len(plan_reports) != 1 else "",
-                 len(ir_reports), "s" if len(ir_reports) != 1 else ""))
+                 len(ir_reports), "s" if len(ir_reports) != 1 else "",
+                 len(kern_reports),
+                 "s" if len(kern_reports) != 1 else ""))
     return 1 if (new or verify_problems) else 0
 
 
@@ -458,10 +569,20 @@ def main(argv=None):
              "imports and instantiates the package (jax required), "
              "but only traces/lowers — nothing XLA-compiles")
     parser.add_argument(
+        "--kern", action="store_true",
+        help="run graftkern (static Pallas kernel verification: grid "
+             "coverage, VMEM budget vs MXNET_KERN_VMEM_BYTES, "
+             "scalar-prefetch retrace hazards, shard_map safety) over "
+             "the in-tree kernel catalog and gate the kern-* "
+             "findings.  NOTE: imports the package (jax required) but "
+             "nothing traces or compiles — index maps are evaluated "
+             "with plain ints")
+    parser.add_argument(
         "--all", action="store_true", dest="all_modes",
-        help="lint + plan + ir in one process with one merged "
+        help="lint + plan + ir + kern in one process with one merged "
              "baseline pass and one exit code (the tier-1/CI entry "
-             "point); the ir leg honors MXNET_IR")
+             "point); the ir leg honors MXNET_IR, the kern leg "
+             "MXNET_KERN")
     parser.add_argument(
         "--audit-suppressions", action="store_true",
         help="run the graftsan workload (runtime sanitizers + line "
@@ -499,7 +620,7 @@ def main(argv=None):
     if args.audit_suppressions:
         return _audit_suppressions(args)
 
-    if args.changed is not None and (args.plan or args.ir
+    if args.changed is not None and (args.plan or args.ir or args.kern
                                      or args.all_modes):
         # the catalog analyses are whole-program (IR facts and plan
         # predictions don't decompose per file), so --changed acts as
@@ -519,11 +640,18 @@ def main(argv=None):
         if not changed:
             print("graftlint: no changed lintable files")
             return 0
+        if args.kern and not args.all_modes and not _kern_relevant(changed):
+            # the kern catalog is derived solely from the kernel plans
+            # (plus the analysis engine and the knob registry); edits
+            # anywhere else cannot change a kern verdict
+            print("graftlint: no changed files affect the kernel "
+                  "catalog; skipping kern run")
+            return 0
 
     if args.all_modes:
-        if args.plan or args.ir:
-            print("graftlint: --all already includes --plan and --ir",
-                  file=sys.stderr)
+        if args.plan or args.ir or args.kern:
+            print("graftlint: --all already includes --plan, --ir "
+                  "and --kern", file=sys.stderr)
             return 2
         return _all(args)
 
@@ -532,6 +660,9 @@ def main(argv=None):
 
     if args.ir:
         return _ir(args)
+
+    if args.kern:
+        return _kern(args)
 
     root = repo_root()
     if args.changed is not None:

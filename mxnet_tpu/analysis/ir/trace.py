@@ -30,7 +30,10 @@ Fact channels:
   computed-but-unused work (a dropped residual/output) is visible as
   an eqn whose results reach no output; only flop-bearing eqns are
   reported (dead converts/broadcasts are trace lint, not lost work).
-- **pallas** — ``pallas_call`` kernel names (``name_and_src_info``).
+- **pallas** — ``pallas_call`` kernel names (``name_and_src_info``),
+  found through wrapper sub-jaxprs too (``shard_map``/``pjit`` descend
+  explicitly in ``_subjaxprs`` — the multi-chip fused sweep's kernels
+  live inside a ``shard_map`` body).
 - **donation** — declared-donated leaves (``args_info.donated``)
   checked against the ``tf.aliasing_output`` / ``jax.buffer_donor``
   attributes of the lowered module's kept args: a declared donation
@@ -86,6 +89,16 @@ def _subjaxprs(eqn):
         for br in eqn.params.get("branches", ()):
             out.append((br, 1, True))
         return out
+    if name in ("shard_map", "pjit"):
+        # explicit, not left to the generic fallback: the per-shard /
+        # inner program is where shard_map-wrapped Pallas kernels live
+        # (the fused optimizer sweep on a multi-chip mesh), and
+        # ir-pallas-presence must see through the wrapper whatever
+        # param type this jax version uses (Jaxpr vs ClosedJaxpr)
+        body = eqn.params.get("jaxpr")
+        if body is not None:
+            out.append((body, 1, False))
+            return out
     for v in eqn.params.values():
         if isinstance(v, jax.core.ClosedJaxpr):
             out.append((v, 1, False))

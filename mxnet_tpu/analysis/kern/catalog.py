@@ -1,0 +1,275 @@
+"""graftkern catalog — the in-tree Pallas kernels abstractly
+interpreted into pure-data reports.
+
+Every kernel family in ``ops/pallas_kernels.py`` is instantiated here
+at representative shapes and its PLAN — the grid/BlockSpec dict the
+dispatch itself consumes (``sweep_plan``, ``flash_fwd_plan``, ...) —
+is evaluated into a report: grid, per-operand block shapes and the
+index-map table over every grid point (index maps called with plain
+Python ints — nothing traces, nothing compiles, no jit), the
+scalar-prefetch transport, Python-level closure constants, padded-tail
+contract, per-instance VMEM bytes, and the shard facts the
+``kern-shard-safety`` verdict judges.  Because the dispatch and the
+analysis read the SAME plan objects, the verifier cannot drift from
+the kernels it verifies.
+
+Report schema (mirrored by the seeded fixtures in
+``tests/fixtures/analysis/kern_bad_kernels.json``)::
+
+    {"name": "_adam_kernel", "family": "MXNET_PALLAS_FUSED_OPT",
+     "origin": "mxnet_tpu/ops/pallas_kernels.py",
+     "grid": [8],
+     "operands": [{"name": "w", "role": "in|out|scalar_prefetch",
+                   "dtype": "float32", "block": [1024, 128],
+                   "shape": [8192, 128],          # padded shape
+                   "index": [[0, 0], [1, 0], ...]},  # one row per
+                  ...],                           # grid point (row-
+     "scratch": [{"shape": [128, 64], "dtype": "float32"}],  # major)
+     "hyper": {"transport": "scalar_prefetch", "names": [...]},
+     "python_constants": [{"name": "use_clip", "detail": "..."}],
+     "tail": {"logical_elems": N, "padded_elems": M, "masked": true,
+              "how": "..."},
+     "shard": {"axis": 0, "operands": [...], "why": "...",
+               "safe": true, "grid_dim": 0},      # verdict attached
+     "vmem": {"bytes_per_instance": B, "budget": L}}
+"""
+from __future__ import annotations
+
+import itertools
+
+__all__ = ["kernel_reports", "sweep_reports", "flash_reports",
+           "scale_bias_relu_reports", "layernorm_reports",
+           "softmax_reports", "ORIGIN"]
+
+ORIGIN = "mxnet_tpu/ops/pallas_kernels.py"
+
+
+def _eval_index(spec, grid, n_prefetch):
+    """The index map evaluated at every grid point (row-major), with
+    one dummy argument per scalar-prefetch operand — block-local maps
+    never touch the prefetch ref, so abstract evaluation works on
+    plain ints; a data-dependent map would raise here, which is
+    exactly a not-statically-analyzable kernel."""
+    extra = (None,) * n_prefetch
+    return [[int(v) for v in spec.index_map(*pt, *extra)]
+            for pt in itertools.product(*[range(int(g)) for g in grid])]
+
+
+def _operand(name, role, spec, shape, grid, n_prefetch,
+             dtype="float32"):
+    return {"name": name, "role": role, "dtype": dtype,
+            "block": [None if b is None else int(b)
+                      for b in spec.block_shape],
+            "shape": [int(s) for s in shape],
+            "index": _eval_index(spec, grid, n_prefetch)}
+
+
+def _report(name, family, plan, in_names, out_names, *, hyper=None,
+            python_constants=(), shard=None, tail=None):
+    from mxnet_tpu import config as _config
+
+    from ..checkers.kern_rules import shard_safety, vmem_bytes
+    grid = [int(g) for g in plan["grid"]]
+    npf = int(plan.get("num_scalar_prefetch", 0))
+    operands = []
+    if npf:
+        operands.append({
+            "name": "hyper", "role": "scalar_prefetch",
+            "dtype": "float32", "block": None,
+            "shape": [len((hyper or {}).get("names") or ())],
+            "index": None})
+    for nm, spec, shape in zip(in_names, plan["in_specs"],
+                               plan["in_shapes"]):
+        operands.append(_operand(nm, "in", spec, shape, grid, npf))
+    for nm, spec, shape in zip(out_names, plan["out_specs"],
+                               plan["out_shapes"]):
+        operands.append(_operand(nm, "out", spec, shape, grid, npf))
+    report = {
+        "name": name, "family": family, "origin": ORIGIN,
+        "grid": grid,
+        "operands": operands,
+        "scratch": [{"shape": [int(s) for s in sh],
+                     "dtype": "float32"}
+                    for sh in plan.get("scratch", ())],
+        "hyper": hyper or {"transport": None, "names": []},
+        "python_constants": list(python_constants),
+        "tail": tail,
+        "shard": dict(shard) if shard else None,
+    }
+    report["vmem"] = {
+        "bytes_per_instance": vmem_bytes(report),
+        "budget": int(_config.get("MXNET_KERN_VMEM_BYTES")),
+    }
+    if shard:
+        # attach the verdict for display/consumption; the checker
+        # re-derives it from the raw facts, never trusts this field
+        v = shard_safety(report)
+        report["shard"]["safe"] = v["safe"]
+        report["shard"]["grid_dim"] = v["grid_dim"]
+    return report
+
+
+# -- one-sweep fused optimizer ---------------------------------------------
+
+_SWEEPS = (
+    ("_sgd_kernel", ("w", "g"), ("ow",),
+     ("lr", "wd", "rescale", "clip")),
+    ("_sgd_mom_kernel", ("w", "g", "mom"), ("ow", "om"),
+     ("lr", "momentum", "wd", "rescale", "clip")),
+    ("_adam_kernel", ("w", "g", "mean", "var"), ("ow", "om", "ov"),
+     ("lr_eff", "beta1", "beta2", "one_minus_beta1",
+      "one_minus_beta2", "epsilon", "wd", "rescale", "clip")),
+)
+
+
+def sweep_reports(n=None):
+    """The three optimizer-sweep kernels at a representative bucket
+    size — a NON-lane-divisible element count, so the padded-tail
+    contract is part of what gets verified."""
+    from mxnet_tpu.ops import pallas_kernels as pk
+    if n is None:
+        n = 8 * pk._OPT_BLOCK_ELEMS - 37
+    reports = []
+    for name, ins, outs, hyper_names in _SWEEPS:
+        plan = pk.sweep_plan(n, len(ins), len(outs))
+        padded = plan["out_shapes"][0][0] * pk.LANES
+        reports.append(_report(
+            name, "MXNET_PALLAS_FUSED_OPT", plan, ins, outs,
+            hyper={"transport": "scalar_prefetch",
+                   "names": list(hyper_names)},
+            python_constants=[
+                {"name": "use_clip",
+                 "detail": "structural branch (presence of clipping "
+                           "changes the kernel body; the clip VALUE "
+                           "rides scalar prefetch)"}],
+            shard={"axis": 0,
+                   "operands": list(ins) + list(outs),
+                   "why": "ZeRO flat buckets shard the rows axis "
+                          "1/mesh across the trainer mesh "
+                          "(parallel/trainer.py _make_step_zero)"},
+            tail={"logical_elems": int(n), "padded_elems": int(padded),
+                  "masked": True,
+                  "how": "host zero-pad (_to_rows); every sweep "
+                         "update maps 0 -> 0 exactly, pad sliced "
+                         "away on return"}))
+    return reports
+
+
+# -- flash attention -------------------------------------------------------
+
+def flash_reports(bh=8, tq=512, tk=512, d=64, bq=128, bk=128):
+    from mxnet_tpu.ops import pallas_kernels as pk
+    structural = [
+        {"name": "scale", "detail": "architecture constant (1/sqrt(d) "
+                                    "unless overridden)"},
+        {"name": "causal", "detail": "structural branch: masking "
+                                     "changes the kernel body"},
+        {"name": "bq", "detail": "block size"},
+        {"name": "bk", "detail": "block size"},
+    ]
+    elems = bh * tq * d
+    tail = {"logical_elems": elems, "padded_elems": elems,
+            "masked": True,
+            "how": "no padding: _pick_block divides T exactly"}
+    # flash has no MXNET_PALLAS_* family knob: parallel/attention.py
+    # selects it per call via impl="auto"/"flash" — label the family
+    # by that entry point, not a fabricated knob name
+    family = "flash_attention(impl=...)"
+    return [
+        _report("_flash_fwd_kernel", family,
+                pk.flash_fwd_plan(bh, tq, tk, d, bq, bk),
+                ("q", "k", "v"), ("o", "lse"),
+                python_constants=structural + [
+                    {"name": "nk", "detail": "grid extent"}],
+                tail=tail),
+        _report("_flash_bwd_dq_kernel", family,
+                pk.flash_bwd_dq_plan(bh, tq, tk, d, bq, bk),
+                ("q", "k", "v", "do", "lse", "delta"), ("dq",),
+                python_constants=structural + [
+                    {"name": "nk", "detail": "grid extent"}],
+                tail=tail),
+        _report("_flash_bwd_dkv_kernel", family,
+                pk.flash_bwd_dkv_plan(bh, tq, tk, d, bq, bk),
+                ("q", "k", "v", "do", "lse", "delta"), ("dk", "dv"),
+                python_constants=structural + [
+                    {"name": "nq", "detail": "grid extent"}],
+                tail=tail),
+    ]
+
+
+# -- inference BatchNorm+ReLU epilogue -------------------------------------
+
+def scale_bias_relu_reports(n=4096, c=64, block=1024):
+    from mxnet_tpu.ops import pallas_kernels as pk
+    bn = pk._pick_block(n, block)
+    elems = n * c
+    return [_report(
+        "_scale_bias_relu_kernel", "MXNET_PALLAS_BN_RELU",
+        pk.scale_bias_relu_plan(n, c, bn),
+        ("x", "scale", "bias"), ("y",),
+        python_constants=[
+            {"name": "relu", "detail": "structural branch: the "
+                                       "epilogue with/without "
+                                       "activation"}],
+        tail={"logical_elems": elems, "padded_elems": elems,
+              "masked": True,
+              "how": "no padding: _pick_block divides N exactly"})]
+
+
+# -- fused layernorm -------------------------------------------------------
+
+def layernorm_reports(r=1024, c=256):
+    from mxnet_tpu.ops import pallas_kernels as pk
+    br = pk._norm_block_rows(r, c, "MXNET_PALLAS_NORM_BLOCK_ROWS")
+    rp = r + (-r) % br
+    eps = [{"name": "eps", "detail": "architecture constant fixed at "
+                                     "layer construction, not a "
+                                     "schedule value"}]
+    tail = {"logical_elems": r * c, "padded_elems": rp * c,
+            "masked": True,
+            "how": "zero pad rows (_pad_rows); pad-row stats never "
+                   "mix into real rows (row-wise kernel), pad sliced "
+                   "away on return"}
+    return [
+        _report("_layernorm_fwd_kernel", "MXNET_PALLAS_NORM",
+                pk.layernorm_fwd_plan(rp, c, br),
+                ("x", "gamma", "beta"), ("o", "mu", "rstd"),
+                python_constants=eps, tail=tail),
+        _report("_layernorm_bwd_kernel", "MXNET_PALLAS_NORM",
+                pk.layernorm_bwd_plan(rp, c, br),
+                ("x", "do", "gamma", "mu", "rstd"), ("dx",),
+                tail=tail),
+    ]
+
+
+# -- fused bias+softmax ----------------------------------------------------
+
+def softmax_reports(b=8, r=128, c0=1000):
+    from mxnet_tpu.ops import pallas_kernels as pk
+    c = c0 + (-c0) % pk.LANES
+    br = pk._norm_block_rows(r, c, "MXNET_PALLAS_SOFTMAX_BLOCK_ROWS")
+    rp = r + (-r) % br
+    tail = {"logical_elems": b * r * c0, "padded_elems": b * rp * c,
+            "masked": True,
+            "how": "per-operand identity column fills (NEG_INF "
+                   "logits, 0 probabilities/cotangents), zero pad "
+                   "rows; pad sliced away on return"}
+    return [
+        _report("_softmax_fwd_kernel", "MXNET_PALLAS_SOFTMAX",
+                pk.softmax_plan(b, rp, c, 1, br),
+                ("x",), ("p",), tail=tail),
+        _report("_softmax_bias_fwd_kernel", "MXNET_PALLAS_SOFTMAX",
+                pk.softmax_plan(b, rp, c, 1, br, has_bias=True),
+                ("x", "bias"), ("p",), tail=tail),
+        _report("_softmax_bwd_kernel", "MXNET_PALLAS_SOFTMAX",
+                pk.softmax_plan(b, rp, c, 2, br),
+                ("p", "do"), ("dx",), tail=tail),
+    ]
+
+
+def kernel_reports():
+    """Every in-tree kernel family's reports — the catalog
+    ``tools/lint.py --kern`` / ``--all`` judge."""
+    return (sweep_reports() + flash_reports()
+            + scale_bias_relu_reports() + layernorm_reports()
+            + softmax_reports())

@@ -155,7 +155,12 @@ def predict_memory(spec):
     bucket under ZeRO, the largest trainable param otherwise); the
     one-sweep Pallas path (``optimizer["fused_sweep"]``, the
     ``MXNET_PALLAS_FUSED_OPT`` export) stages its bucket blocks through
-    VMEM only — NO per-param HBM temporaries — so the component is 0."""
+    VMEM only — NO per-param HBM temporaries — so the component is 0.
+    The VMEM side of that claim is graftkern's to verify: its
+    ``kern-vmem-budget`` checker bounds each sweep kernel's
+    per-grid-instance residency against ``MXNET_KERN_VMEM_BYTES``, and
+    ``tools/lint.py --all`` prints those predictions beside this HBM
+    model — one run, the whole byte story."""
     mesh = spec.mesh
     n = mesh.size if mesh is not None else 1
     params = 0

@@ -273,6 +273,20 @@ register_env("MXNET_IR_COST_REPORT", str, None,
              "path where tools/lint.py --ir/--all writes the traced "
              "catalog's static CostReports (flops/bytes/op-mix per "
              "program) as JSON, next to graftplan's memory numbers")
+register_env("MXNET_KERN", bool, True,
+             "graftkern master switch: include the kernel analysis leg "
+             "(grid coverage / VMEM budget / retrace hazard / "
+             "shard_map safety over the Pallas kernel catalog, "
+             "analysis/kern/) in tools/lint.py --all runs; "
+             "tools/lint.py --kern always runs (explicit request "
+             "wins).  The mesh_sweep_safe shard-safety verdict is "
+             "computed regardless — this knob only gates the lint leg")
+register_env("MXNET_KERN_VMEM_BYTES", int, 16 * 1024 * 1024,
+             "per-core VMEM budget (bytes) for graftkern's "
+             "kern-vmem-budget checker: a kernel whose per-program-"
+             "instance residency (operand blocks x dtypes + scratch) "
+             "exceeds it fails tools/lint.py --kern; default 16 MiB "
+             "(v5e-class core)")
 register_env("MXNET_PALLAS_FUSED_OPT", str, "auto",
              "one-sweep Pallas optimizer (ParallelTrainer ZeRO sweep, "
              "executor fused step; fused_sgd_momentum/fused_adam): "

@@ -14,6 +14,14 @@ writes the MULTICHIP record):
   loss log behind, and overlapping steps between a victim and its
   successor must agree — the no-skip/no-double witness.
 
+- :func:`fused_sweep_parity_drill` — the MULTICHIP fused-optimizer
+  leg: in a real 8-device worker, the shard_map-wrapped one-sweep
+  Pallas optimizer (the path graftkern's ``kern-shard-safety`` verdict
+  opens via ``mesh_sweep_safe``) over 1/mesh-sharded flat buckets is
+  asserted BITWISE equal to the per-array ``tree_map`` oracle, with
+  ``mxnet_pallas_kernel_calls_total`` proving the kernels actually
+  instantiated at dp8.
+
 - :func:`chaos_soak` — serving + checkpoint stack under a seeded
   pseudo-random plan (transient executor-bind failures, batcher
   delays, commit/manifest/poll IO errors) with live client traffic,
@@ -48,7 +56,8 @@ import threading
 import time
 
 __all__ = ["elastic_kill_drill", "chaos_soak", "multitenant_soak",
-           "fleet_network_soak", "kv_worker_main"]
+           "fleet_network_soak", "kv_worker_main",
+           "fused_sweep_parity_drill", "fused_parity_worker_main"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -151,6 +160,102 @@ def _read_loss_log(path):
                 rec = json.loads(line)
                 out[int(rec["step"])] = float(rec["loss"])
     return out
+
+
+def fused_parity_worker_main(report_path):
+    """dp8 fused-sweep parity witness, run inside an 8-device worker:
+    the shard_map-wrapped one-sweep optimizer (the path graftkern's
+    ``kern-shard-safety`` verdict opens — ``mesh_sweep_safe``) over
+    1/mesh-sharded flat buckets must be BITWISE the per-array
+    ``tree_map`` oracle, params and slots, and the Pallas kernels must
+    actually instantiate (``mxnet_pallas_kernel_calls_total``
+    nonzero)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.analysis.kern import sweep_shard_verdict
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.optimizer import PureAdam, PureSGD
+
+    telemetry.enable()
+    mesh = make_mesh(dp=8)
+    ns = NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
+    rng = np.random.RandomState(5)
+    sizes = [8 * 2048, 8192]
+
+    def buckets():
+        return {"b%d" % i: jax.device_put(
+                    jnp.asarray(rng.randn(n).astype(np.float32)), ns)
+                for i, n in enumerate(sizes)}
+
+    bit_equal = True
+    for opt in (PureSGD(0.1, momentum=0.9, wd=0.01),
+                PureAdam(1e-3, wd=0.01)):
+        params = buckets()
+        grads = [buckets() for _ in range(4)]
+        shardings = {k: ns for k in params}
+
+        def drive(knob, mesh_arg):
+            os.environ["MXNET_PALLAS_FUSED_OPT"] = knob
+            step = jax.jit(lambda p, g, s: opt.apply(
+                p, g, s, flat=True, mesh=mesh_arg))
+            p, s = dict(params), opt.init(params, shardings)
+            for g in grads:
+                p, s = step(p, g, s)
+            return p, s
+
+        pf, sf = drive("1", mesh)     # fused, shard_map-wrapped
+        pu, su = drive("0", None)     # tree_map oracle
+        for k in params:
+            bit_equal &= bool(np.array_equal(np.asarray(pf[k]),
+                                             np.asarray(pu[k])))
+        for a, b in zip(jax.tree_util.tree_leaves(sf),
+                        jax.tree_util.tree_leaves(su)):
+            bit_equal &= bool(np.array_equal(np.asarray(a),
+                                             np.asarray(b)))
+    fam = telemetry.snapshot().get("mxnet_pallas_kernel_calls_total",
+                                   {"values": []})
+    calls = {dict(v["labels"])["kernel"]: v["value"]
+             for v in fam["values"]}
+    record = {
+        "mesh": "dp8",
+        "verdict_safe": bool(sweep_shard_verdict()["safe"]),
+        "bitwise_equal_vs_treemap": bit_equal,
+        "pallas_kernel_calls": calls,
+    }
+    with open(report_path, "w") as f:
+        json.dump(record, f, indent=1)
+        f.write("\n")
+    print("drill-worker: fused parity bitwise=%s calls=%s"
+          % (bit_equal, sorted(calls)))
+    return 0 if bit_equal else 1
+
+
+def fused_sweep_parity_drill(tmpdir=None, timeout=240):
+    """The MULTICHIP fused-optimizer leg: run
+    :func:`fused_parity_worker_main` in a REAL 8-device subprocess
+    (the record machine may have any device count) and assert the
+    record's bars."""
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="mx_fused_parity_")
+    report_path = os.path.join(tmpdir, "fused_parity.json")
+    cmd = [sys.executable, "-u", "-m", "mxnet_tpu.fault.drill",
+           "--fused-parity-worker", "--report", report_path]
+    proc = subprocess.run(cmd, env=_worker_env(8), cwd=_REPO,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+    if proc.returncode != 0 or not os.path.exists(report_path):
+        raise AssertionError("fused parity worker failed:\n%s\n%s"
+                             % (proc.stdout[-2000:], proc.stderr[-2000:]))
+    with open(report_path) as f:
+        record = json.load(f)
+    assert record["verdict_safe"], record
+    assert record["bitwise_equal_vs_treemap"], record
+    calls = record["pallas_kernel_calls"]
+    assert calls.get("fused_sgd_momentum", 0) >= 1, calls
+    assert calls.get("fused_adam", 0) >= 1, calls
+    return record
 
 
 def elastic_kill_drill(steps=12, kill_at=(4, 8), widths=(4, 2, 8),
@@ -1282,6 +1387,7 @@ def _main(argv):
     ap = argparse.ArgumentParser(prog="mxnet_tpu.fault.drill")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--kv-worker", action="store_true")
+    ap.add_argument("--fused-parity-worker", action="store_true")
     ap.add_argument("--width", type=int, default=2)
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--pushes", type=int, default=30)
@@ -1297,15 +1403,19 @@ def _main(argv):
     if args.kv_worker:
         kv_worker_main(args.pushes, args.report)
         return 0
+    if args.fused_parity_worker:
+        return fused_parity_worker_main(args.report)
     # two drill flavors: same-width kill/restart must be EXACT (atol=0,
     # the reshard guarantee); shrink-then-grow matches to float32
     # reduction noise of the re-topologized collectives
     same_width = elastic_kill_drill(widths=(4, 4, 4))
     reshard = elastic_kill_drill(widths=(4, 2, 8), atol=1e-5)
     soak = fleet_network_soak()
+    fused_parity = fused_sweep_parity_drill()
     record = {"elastic_kill_drill_same_width": same_width,
               "elastic_kill_drill_reshard": reshard,
-              "fleet_network_soak": soak}
+              "fleet_network_soak": soak,
+              "fused_sweep_parity": fused_parity}
     out = args.record or "MULTICHIP_r08.json"
     with open(out, "w") as f:
         json.dump(record, f, indent=1)

@@ -15,10 +15,16 @@ fp32 buffers with slots allocated bucket-major, still ZeRO-sharded
 ONE Pallas kernel (``ops/pallas_kernels.py`` ``fused_sgd_momentum`` /
 ``fused_adam``): params, grads and slots stream through VMEM once
 instead of XLA's per-stage elementwise kernels, and lr/betas/wd ride a
-scalar-prefetch operand so schedule changes never retrace.  The
-``tree_map`` path below stays byte-for-byte as the fallback AND the
-bit-parity oracle (tests/test_pallas.py / test_parallel_zero.py assert
-exact equality, padded tails and checkpoint cycles included).
+scalar-prefetch operand so schedule changes never retrace.  On a
+multi-chip mesh the trainer additionally passes ``mesh=`` and the
+sweep runs ``shard_map``-wrapped over the sharded bucket rows — the
+path ``mesh_sweep_safe`` only opens after graftkern's
+``kern-shard-safety`` verdict statically proved every sweep kernel's
+index maps block-local along the sharded axis
+(``analysis/kern/``).  The ``tree_map`` path below stays byte-for-byte
+as the fallback AND the bit-parity oracle (tests/test_pallas.py /
+test_parallel_zero.py assert exact equality, padded tails and
+checkpoint cycles included).
 """
 from __future__ import annotations
 
@@ -83,11 +89,16 @@ class PureSGD:
                 "scalar_slots": [],
                 "fused_sweep": _fused_sweep_on(True)}
 
-    def apply(self, params, grads, state, lr=None, flat=False):
+    def apply(self, params, grads, state, lr=None, flat=False,
+              mesh=None):
         """``flat=True`` marks the leaves as bucketed flat views (1-D
         fp32 buffers, slots bucket-major) — the contract under which
         the one-sweep Pallas path may take over; the per-array
-        ``tree_map`` below is its bit-parity oracle."""
+        ``tree_map`` below is its bit-parity oracle.  ``mesh`` (a
+        multi-chip trainer mesh) makes the sweep run ``shard_map``-ped
+        over the bucket's sharded rows — only reachable when
+        graftkern's ``kern-shard-safety`` verdict proved the kernels
+        block-local (``mesh_sweep_safe``)."""
         lr = self.lr if lr is None else lr
         clip = self.clip_gradient
 
@@ -102,7 +113,7 @@ class PureSGD:
                     params[k], grads[k],
                     None if self.momentum == 0.0 else state["mom"][k],
                     lr=lr, momentum=self.momentum, wd=self.wd,
-                    rescale=self.rescale_grad, clip=clip)
+                    rescale=self.rescale_grad, clip=clip, mesh=mesh)
                 new_params[k] = nw
                 if nm is not None:
                     new_mom[k] = nm
@@ -157,8 +168,10 @@ class PureAdam:
         return {"slots": ["mean", "var"], "scalar_slots": [["t", 4]],
                 "fused_sweep": _fused_sweep_on(True)}
 
-    def apply(self, params, grads, state, lr=None, flat=False):
-        """See :meth:`PureSGD.apply` for the ``flat`` contract."""
+    def apply(self, params, grads, state, lr=None, flat=False,
+              mesh=None):
+        """See :meth:`PureSGD.apply` for the ``flat``/``mesh``
+        contract."""
         lr = self.lr if lr is None else lr
         t = state["t"] + 1
         b1, b2 = self.beta1, self.beta2
@@ -178,7 +191,7 @@ class PureAdam:
                     params[k], grads[k], state["mean"][k],
                     state["var"][k], lr_eff=lr_eff, beta1=b1, beta2=b2,
                     epsilon=self.epsilon, wd=self.wd,
-                    rescale=self.rescale_grad, clip=clip)
+                    rescale=self.rescale_grad, clip=clip, mesh=mesh)
                 new_params[k] = nw
                 new_mean[k] = nm
                 new_var[k] = nv

@@ -384,11 +384,13 @@ class ParallelTrainer:
         from ..ops.pallas_kernels import mesh_sweep_safe
         opt_spec = self._opt.slot_spec()
         # the sweep engages only where the step hands the optimizer
-        # flat bucket views (zero>=1) AND the mesh supports the kernel
-        # (mesh_sweep_safe); a zero=0 or native-multi-chip trainer runs
-        # the per-array path whatever the knob says, and the memory
-        # model's update_temp component must reflect the path that
-        # actually runs
+        # flat bucket views (zero>=1) AND mesh_sweep_safe clears the
+        # mesh — on multi-chip that means graftkern's kern-shard-safety
+        # verdict proved the sweep kernels block-local, so the sweep
+        # runs shard_map-wrapped; a zero=0 trainer (or an unprovable
+        # kernel set) runs the per-array path whatever the knob says,
+        # and the memory model's update_temp component must reflect
+        # the path that actually runs
         opt_spec["fused_sweep"] = bool(opt_spec.get("fused_sweep")) \
             and self._zero >= 1 and mesh_sweep_safe(mesh.size)
         return {
@@ -629,12 +631,16 @@ class ParallelTrainer:
             # flat buckets (1-D fp32 views, bucket-major slots) let the
             # optimizer take the one-sweep Pallas path
             # (MXNET_PALLAS_FUSED_OPT; tree_map stays the parity
-            # oracle) — gated off on native multi-chip meshes where the
-            # Mosaic call has no GSPMD partitioning rule
-            # (pallas_kernels.mesh_sweep_safe)
+            # oracle).  On a multi-chip mesh the sweep runs
+            # shard_map-wrapped over the 1/mesh bucket rows — only
+            # when mesh_sweep_safe's graftkern kern-shard-safety
+            # verdict proved the kernels block-local along the sharded
+            # axis; an unprovable kernel keeps flat_sweep_ok False and
+            # this stays the tree_map path
             new_shards, new_fused_state = opt.apply(
                 p_shards, g_shards, opt_state["fused"],
-                flat=flat_sweep_ok)
+                flat=flat_sweep_ok,
+                mesh=mesh if mesh.size > 1 else None)
             new_fused = {}
             for b in plan:
                 # the all-gather: shard-updated flat buffer back to the

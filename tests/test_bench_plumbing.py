@@ -30,6 +30,14 @@ def bench_mod(tmp_path, monkeypatch, capsys):
         return 2000.0, None
 
     monkeypatch.setattr(bench, "_measure", fake_measure)
+    # the sharded-sweep rider is a real dp8 jax subprocess — stub it so
+    # plumbing tests stay fast; its own numbers are covered by running
+    # bench.py for real (and the parity bar by the fault drill)
+    monkeypatch.setattr(
+        bench, "_sharded_sweep_rider",
+        lambda to: {"sharded_fused_us_per_step": 100.0,
+                    "sharded_treemap_us_per_step": 150.0,
+                    "sharded_treemap_vs_fused": 1.5})
     bench._test_calls = calls
     return bench
 
@@ -48,7 +56,10 @@ def test_all_legs_run_within_budget(bench_mod, tmp_path, capsys,
     assert rd["pallas_unfused_vs_baseline"] == 1.0
     assert rd["stem_s2d_vs_baseline"] == 1.0
     assert rd["unfused_metric_vs_baseline"] == 1.0
-    # primary + nhwc + 3 riders
+    # the sharded-sweep microbench rider (ZeRO shard_map fused vs
+    # tree_map) rides the same riders file, not the img/s measurer
+    assert rd["sharded_treemap_vs_fused"] == 1.5
+    # primary + nhwc + 3 riders (the sharded leg is its own subprocess)
     assert len(bench_mod._test_calls) == 5
     assert {"MXNET_STEM_SPACE_TO_DEPTH": "1"} in bench_mod._test_calls
     assert {"MXNET_FUSED_METRIC": "0"} in bench_mod._test_calls
@@ -69,6 +80,7 @@ def test_exhausted_budget_skips_secondary_legs(bench_mod, tmp_path,
     assert "nhwc_skipped" in ab
     assert "stem_s2d_skipped" in rd and "unfused_metric_skipped" in rd
     assert "pallas_unfused_skipped" in rd
+    assert "sharded_sweep_skipped" in rd
     assert len(bench_mod._test_calls) == 1  # primary only
 
 

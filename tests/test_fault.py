@@ -853,6 +853,18 @@ def test_elastic_multiproc_kill_drill(tmp_path):
 
 
 @pytest.mark.slow
+def test_fused_sweep_parity_drill(tmp_path):
+    """The MULTICHIP fused-optimizer leg: dp8 shard_map-wrapped sweep
+    bitwise vs the tree_map oracle, kernels proven instantiated."""
+    from mxnet_tpu.fault.drill import fused_sweep_parity_drill
+    record = fused_sweep_parity_drill(tmpdir=str(tmp_path))
+    assert record["verdict_safe"]
+    assert record["bitwise_equal_vs_treemap"]
+    assert record["pallas_kernel_calls"]["fused_sgd_momentum"] >= 1
+    assert record["pallas_kernel_calls"]["fused_adam"] >= 1
+
+
+@pytest.mark.slow
 def test_chaos_soak_zero_lost_zero_incomplete():
     from mxnet_tpu.fault.drill import chaos_soak
     report = chaos_soak(duration_s=6.0, clients=4)

@@ -1,0 +1,298 @@
+"""graftkern — static Pallas kernel verification (PR 19).
+
+Proof obligations:
+
+1. each ``kern-*`` rule catches its seeded bad-kernel fixture (an
+   overlapping index map, an unmasked padded tail, an over-budget
+   block, a closure-constant lr, a cross-block read on the sharded
+   dim) with ``jax.jit`` fully poisoned — the judging path is pure
+   data;
+2. the in-tree catalog gate (tier-1): every kernel in
+   ``ops/pallas_kernels.py`` analyzes clean, ALSO with ``jax.jit``
+   poisoned — building the plans and evaluating the index maps never
+   traces or compiles anything;
+3. the ``kern-shard-safety`` verdict is load-bearing:
+   ``sweep_shard_verdict()`` proves the sweep family block-local,
+   ``mesh_sweep_safe`` consumes the verdict (no hardcoded flag), and
+   the multi-chip dp8 fused sweep is BITWISE the ``tree_map`` oracle,
+   with graftir finding the ``pallas_call`` inside the ``shard_map``
+   body (``ir-pallas-presence``'s blind spot closed);
+4. the four ``kern-*`` rule ids ride the SARIF reporter and the
+   stale-suppression hygiene like every other rule, and ``--changed``
+   maps kernel-plan edits to a kern re-run.
+"""
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import analysis, parallel
+from mxnet_tpu.analysis import rule_ids, sarif_report
+from mxnet_tpu.analysis.checkers.kern_rules import (
+    KERN_RULES, SCHEDULE_HYPERPARAMS, coverage_problems,
+    run_kern_checkers, shard_safety, vmem_bytes)
+from mxnet_tpu.analysis.kern import (kernel_reports, sweep_reports,
+                                     sweep_shard_verdict)
+from mxnet_tpu.ops import pallas_kernels as pk
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(ROOT, "tests", "fixtures")
+
+
+def _poison_jit(monkeypatch):
+    def boom(*_a, **_k):
+        raise AssertionError(
+            "jax.jit reached from the graftkern static path")
+    monkeypatch.setattr(jax, "jit", boom)
+
+
+def _fixture_reports():
+    doc = json.load(open(os.path.join(FIX, "analysis",
+                                      "kern_bad_kernels.json")))
+    return doc["reports"]
+
+
+# ---------------------------------------------------------------------------
+# 1. seeded bad kernels — pure data, jax.jit fully poisoned
+# ---------------------------------------------------------------------------
+
+def test_fixture_kernels_with_jit_poisoned(monkeypatch):
+    """ACCEPTANCE: every kern-* rule catches its seeded report without
+    compiling anything — the checkers never leave pure data."""
+    _poison_jit(monkeypatch)
+    seen = set()
+    for entry in _fixture_reports():
+        findings = run_kern_checkers([entry["report"]])
+        rules = {f.rule for f in findings}
+        assert entry["expect_rule"] in rules, \
+            (entry["report"]["name"], rules)
+        for f in findings:
+            assert f.path == "mxnet_tpu/ops/pallas_kernels.py"
+            assert f.symbol == entry["report"]["name"]
+        seen.add(entry["expect_rule"])
+    assert seen == set(KERN_RULES)
+
+
+def test_fixture_failure_modes_are_specific(monkeypatch):
+    """The seeded defects are the advertised ones: the overlap fixture
+    reports BOTH the race and the gap; the cross-read fixture's shard
+    verdict is candidate-but-unsafe with the offending operand named."""
+    _poison_jit(monkeypatch)
+    by_name = {e["report"]["name"]: e["report"]
+               for e in _fixture_reports()}
+    overlap = by_name["_seed_overlap_kernel"]
+    out = next(o for o in overlap["operands"] if o["role"] == "out")
+    problems = coverage_problems(out, overlap["grid"])
+    assert any("never written" in p for p in problems)
+    assert any("race" in p for p in problems)
+    cross = by_name["_seed_cross_read_kernel"]
+    verdict = shard_safety(cross)
+    assert verdict["candidate"] and not verdict["safe"]
+    assert verdict["grid_dim"] is None
+    assert any("g:" in r for r in verdict["reasons"])
+    fat = by_name["_seed_fat_block_kernel"]
+    assert vmem_bytes(fat) == 2 * 4096 * 4096 * 4
+
+
+# ---------------------------------------------------------------------------
+# 2. the in-tree catalog gate (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_in_tree_catalog_clean_with_jit_poisoned(monkeypatch):
+    """ACCEPTANCE: the whole kernel catalog analyzes with ZERO findings
+    and jax.jit poisoned — abstract interpretation of the shared plan
+    objects, nothing traces, nothing compiles."""
+    _poison_jit(monkeypatch)
+    reports = kernel_reports()
+    names = {r["name"] for r in reports}
+    assert {"_sgd_kernel", "_sgd_mom_kernel", "_adam_kernel",
+            "_flash_fwd_kernel", "_flash_bwd_dq_kernel",
+            "_flash_bwd_dkv_kernel", "_scale_bias_relu_kernel",
+            "_layernorm_fwd_kernel", "_layernorm_bwd_kernel",
+            "_softmax_fwd_kernel", "_softmax_bias_fwd_kernel",
+            "_softmax_bwd_kernel"} <= names
+    findings = run_kern_checkers(reports)
+    assert findings == [], [(f.rule, f.symbol, f.message)
+                            for f in findings]
+    for r in reports:
+        assert r["vmem"]["bytes_per_instance"] <= r["vmem"]["budget"], \
+            r["name"]
+        assert r["tail"]["masked"], r["name"]
+
+
+def test_catalog_respects_vmem_budget_knob(monkeypatch):
+    """A tightened MXNET_KERN_VMEM_BYTES turns real kernels into
+    kern-vmem-budget findings — the budget is the knob, not a constant
+    baked into the checker."""
+    _poison_jit(monkeypatch)
+    reports = sweep_reports()
+    findings = run_kern_checkers(reports, ctx={"vmem_budget": 1024})
+    assert {f.rule for f in findings} == {"kern-vmem-budget"}
+    assert len(findings) == len(reports)
+
+
+# ---------------------------------------------------------------------------
+# 3. the verdict is load-bearing
+# ---------------------------------------------------------------------------
+
+def test_sweep_shard_verdict_proves_block_local():
+    verdict = sweep_shard_verdict()
+    assert verdict["safe"] is True
+    assert set(verdict["kernels"]) == {"_sgd_kernel", "_sgd_mom_kernel",
+                                       "_adam_kernel"}
+    for name, v in verdict["kernels"].items():
+        assert v["candidate"] and v["safe"], name
+        assert v["grid_dim"] == 0, name
+
+
+def test_mesh_sweep_safe_derives_from_verdict(monkeypatch):
+    """mesh_sweep_safe is the verdict, not a hardcoded flag: on a
+    native (non-interpret) backend multi-chip is allowed iff graftkern
+    proves the sweep kernels block-local."""
+    import mxnet_tpu.analysis.kern as kern_mod
+    monkeypatch.setattr(pk, "_interpret", lambda: False)
+    monkeypatch.setattr(pk, "_SWEEP_SHARD_VERDICT", None)
+    assert pk.mesh_sweep_safe(1) is True          # single chip: no wrap
+    assert pk.mesh_sweep_safe(8) is True          # proof present
+    monkeypatch.setattr(pk, "_SWEEP_SHARD_VERDICT", None)
+    monkeypatch.setattr(kern_mod, "sweep_shard_verdict",
+                        lambda: {"safe": False, "kernels": {}})
+    assert pk.mesh_sweep_safe(8) is False         # proof absent
+    assert pk.mesh_sweep_safe(1) is True          # single chip still ok
+    monkeypatch.setattr(pk, "_SWEEP_SHARD_VERDICT", None)
+    monkeypatch.setattr(kern_mod, "sweep_shard_verdict",
+                        lambda: (_ for _ in ()).throw(RuntimeError()))
+    assert pk.mesh_sweep_safe(8) is False         # verdict errors: safe
+
+
+def test_multichip_fused_sweep_bitwise_vs_treemap(monkeypatch):
+    """ACCEPTANCE (dp8): the shard_map-wrapped fused sweep over
+    1/mesh-sharded flat buckets is BITWISE the per-array tree_map
+    oracle — params and slots — for SGD+momentum and Adam."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel.optimizer import PureAdam, PureSGD
+    mesh = parallel.make_mesh(dp=8)
+    ns = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    rng = np.random.RandomState(7)
+
+    def buckets(sizes):
+        return {"b%d" % i: jax.device_put(
+                    jnp.asarray(rng.randn(n).astype(np.float32)), ns)
+                for i, n in enumerate(sizes)}
+
+    sizes = [8 * 1024, 4096]
+    for opt in (PureSGD(0.1, momentum=0.9, wd=0.01,
+                        clip_gradient=0.05),
+                PureAdam(1e-3, wd=0.01)):
+        params = buckets(sizes)
+        grads = [buckets(sizes) for _ in range(3)]
+        shardings = {k: ns for k in params}
+
+        def drive(knob, mesh_arg):
+            monkeypatch.setenv("MXNET_PALLAS_FUSED_OPT", knob)
+            step = jax.jit(lambda p, g, s: opt.apply(
+                p, g, s, flat=True, mesh=mesh_arg))
+            p, s = dict(params), opt.init(params, shardings)
+            for g in grads:
+                p, s = step(p, g, s)
+            return p, s
+
+        pf, sf = drive("1", mesh)     # fused, shard_map-wrapped
+        pu, su = drive("0", None)     # tree_map oracle
+        for k in params:
+            assert np.array_equal(np.asarray(pf[k]),
+                                  np.asarray(pu[k])), (type(opt), k)
+        for a, b in zip(jax.tree_util.tree_leaves(sf),
+                        jax.tree_util.tree_leaves(su)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_sweep_requires_mesh_divisible_buckets():
+    """The bucket plan pads every bucket to a multiple of mesh.size
+    (parallel/collectives.py); the sharded sweep enforces that
+    contract instead of silently re-padding unevenly."""
+    mesh = parallel.make_mesh(dp=8)
+    w = jnp.ones(8 * 100 + 3, jnp.float32)
+    with pytest.raises(ValueError, match="mesh"):
+        pk.fused_sgd_momentum(w, w, None, lr=0.1, momentum=0.0,
+                              mesh=mesh)
+
+
+def test_ir_finds_pallas_inside_shard_map(monkeypatch):
+    """Satellite: graftir's fact walk descends shard_map/pjit
+    sub-jaxprs, so ir-pallas-presence sees the kernels of the
+    multi-chip fused step (trace-only; compile poisoned)."""
+    from jax._src.interpreters import pxla
+    from mxnet_tpu.analysis.ir.trace import collect_facts
+    mesh = parallel.make_mesh(dp=8)
+    w = jnp.ones(8 * 1024, jnp.float32)
+
+    def step(w, g):
+        nw, _ = pk.fused_sgd_momentum(w, g, None, lr=0.1, momentum=0.0,
+                                      mesh=mesh)
+        return nw
+
+    traced = jax.jit(step).trace(w, w)
+
+    def boom(*_a, **_k):
+        raise AssertionError("XLA compile reached from abstract path")
+
+    monkeypatch.setattr(pxla.MeshComputation, "compile", boom)
+    facts = collect_facts(traced.jaxpr)
+    assert "_sgd_kernel" in facts["pallas"]
+
+
+# ---------------------------------------------------------------------------
+# 4. reporter / hygiene / CLI plumbing
+# ---------------------------------------------------------------------------
+
+def test_sarif_coverage_of_kern_rules():
+    findings = run_kern_checkers([e["report"]
+                                  for e in _fixture_reports()])
+    sarif = json.loads(sarif_report(findings))
+    ids = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+    assert ids == set(KERN_RULES)
+    for res in sarif["runs"][0]["results"]:
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+        assert res["locations"][0]["physicalLocation"][
+            "artifactLocation"]["uri"] == "mxnet_tpu/ops/pallas_kernels.py"
+    assert set(rule_ids()) >= ids
+
+
+def test_stale_suppression_handles_kern_rules(tmp_path):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        def f(x):
+            return x  # graftlint: disable=kern-shard-safety
+    """))
+    findings = analysis.run([str(tmp_path)], root=str(tmp_path))
+    stale = [f for f in findings if f.rule == "stale-suppression"]
+    assert len(stale) == 1 and "kern-shard-safety" in stale[0].message
+
+
+def test_changed_maps_kernel_edits_to_kern_run():
+    """Satellite: the --changed fast path re-runs kern exactly when the
+    kernel plans, the analysis engine, or the knob registry changed."""
+    from mxnet_tpu.analysis.cli import _kern_relevant
+    assert _kern_relevant(["mxnet_tpu/ops/pallas_kernels.py"])
+    assert _kern_relevant(["mxnet_tpu/config.py"])
+    assert _kern_relevant(["mxnet_tpu/analysis/kern/catalog.py"])
+    assert _kern_relevant(["mxnet_tpu/analysis/checkers/kern_rules.py"])
+    assert not _kern_relevant(["docs/faq/perf.md",
+                               "mxnet_tpu/parallel/trainer.py"])
+
+
+def test_schedule_hyperparams_vocabulary():
+    """The retrace vocabulary matches the sweep kernels' scalar-prefetch
+    names (exact-name matching: structural constants like use_clip,
+    eps, scale, causal must stay clean)."""
+    for r in sweep_reports():
+        assert r["hyper"]["transport"] == "scalar_prefetch"
+        for pc in r["python_constants"]:
+            assert pc["name"] not in SCHEDULE_HYPERPARAMS, r["name"]
+    assert "lr" in SCHEDULE_HYPERPARAMS
+    assert "use_clip" not in SCHEDULE_HYPERPARAMS
+    assert "eps" not in SCHEDULE_HYPERPARAMS

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """graftlint launcher — ``tools/lint.py [paths...] [--changed [REF]]
 [--json | --sarif] [--rule R] [--stale] [--update-baseline]
-[--cache PATH | --no-cache] [--plan] [--ir] [--all]
+[--cache PATH | --no-cache] [--plan] [--ir] [--kern] [--all]
 [--audit-suppressions]``.
 
 Thin wrapper over ``mxnet_tpu.analysis.cli`` that works from any CWD
@@ -16,9 +16,13 @@ XLA-compiles them; ``--ir`` runs graftir — the same catalog's step/
 serving programs ABSTRACTLY traced (``jax.jit(...).trace`` + aot
 lowering, nothing compiles) and verified at the jaxpr level (donation
 aliasing, dtype drift, dead outputs, collective schedule, Pallas
-presence, static cost model); ``--all`` runs lint + plan + ir in one
-process with ONE merged baseline pass and one exit code (the tier-1/
-CI entry point); and ``--audit-suppressions`` EXECUTES a built-in
+presence, static cost model); ``--kern`` runs graftkern — the in-tree
+Pallas kernel plans abstractly interpreted (grid coverage, VMEM
+budget, retrace hazards, shard_map safety; index maps evaluated on
+plain ints, nothing traces or compiles); ``--all`` runs lint + plan +
+ir + kern in one process with ONE merged baseline pass and one exit
+code (the tier-1/CI entry point); and ``--audit-suppressions``
+EXECUTES a built-in
 workload under the graftsan sanitizers, classifying every
 suppression/baseline entry as runtime-confirmed / never-exercised /
 contradicted (contradictions fail).  See
@@ -33,7 +37,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-if {"--plan", "--ir", "--all"} & set(sys.argv):
+if {"--plan", "--ir", "--kern", "--all"} & set(sys.argv):
     # the full catalog wants the virtual 8-device mesh (same trick as
     # tests/conftest.py); must be set before jax initializes, which the
     # mxnet_tpu import below triggers.  Explicit env always wins.
