@@ -1,0 +1,158 @@
+"""tune-knob-drift — the grafttune space and the config registry must
+agree, in both directions.
+
+``tune/space.py`` declares what the autotuner may move; ``config.py``
+marks the same knobs ``tunable=True`` so readers of the registry (and
+``docs/faq/env_var.md``) know which values a tuning DB can override.
+The two files drift independently — a knob added to the sweep without
+the registry flag, or flagged in the registry after its sweep entry
+was dropped, silently lies about what grafttune controls — so the
+checker holds them in two-way agreement:
+
+- every ``TunableSpace.register(name, "MXNET_...", ...)`` config key
+  in ``tune/space.py`` must be a ``register_env`` entry carrying
+  ``tunable=True`` (an unregistered key is a typo no sweep can bind;
+  a registered-but-unflagged one hides the knob from the registry's
+  tunable view);
+- every ``register_env(..., tunable=True)`` entry in ``config.py``
+  must appear as a space key (a flag with no sweep entry advertises
+  tuning that never happens).
+
+Both sides are read from the ASTs — the space keeps its config keys
+as positional string literals precisely so this checker never has to
+import the tree (the same discipline as ``env-knob-drift``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import Checker, Finding, register
+
+__all__ = ["TuneKnobChecker", "drift_report", "space_keys",
+           "tunable_names"]
+
+
+def space_keys(space_path):
+    """``{config_key: line}`` of every ``.register(name, key, ...)``
+    call in the tuning space whose key is a ``MXNET_*`` string
+    literal — parsed from the AST, never imported."""
+    with open(space_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    keys = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "register"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+                and node.args[1].value.startswith("MXNET_")):
+            continue
+        keys.setdefault(node.args[1].value, node.args[1].lineno)
+    return keys
+
+
+def tunable_names(config_path):
+    """``{name: line}`` of every ``register_env`` call carrying a
+    literal ``tunable=True`` keyword."""
+    with open(config_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "register_env"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        for kw in node.keywords:
+            if (kw.arg == "tunable"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True):
+                names[node.args[0].value] = node.lineno
+    return names
+
+
+@register
+class TuneKnobChecker(Checker):
+    rule = "tune-knob-drift"
+    severity = "error"
+    suffixes = (".py",)
+
+    def _tables(self, ctx):
+        key = "tune-knob-tables"
+        if key not in ctx.memo:
+            space_path = os.path.join(ctx.root, "mxnet_tpu", "tune",
+                                      "space.py")
+            config_path = os.path.join(ctx.root, "mxnet_tpu",
+                                      "config.py")
+            keys = (space_keys(space_path)
+                    if os.path.exists(space_path) else {})
+            flagged = (tunable_names(config_path)
+                       if os.path.exists(config_path) else {})
+            registered = {}
+            if os.path.exists(config_path):
+                from .env_knobs import registered_names
+                registered = registered_names(config_path)
+            ctx.memo[key] = (keys, flagged, registered)
+        return ctx.memo[key]
+
+    def check(self, path, relpath, text, tree, ctx):
+        rel_n = relpath.replace("\\", "/")
+        keys, flagged, registered = self._tables(ctx)
+        out = []
+        if rel_n.endswith("mxnet_tpu/tune/space.py"):
+            # space -> registry direction, flagged at the space entry
+            for key, line in sorted(keys.items()):
+                if key not in registered:
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, line,
+                        "tuning-space key %s is not register_env'd in "
+                        "config.py — no sweep or bind site can resolve "
+                        "it (typo or missing registration)" % key,
+                        symbol="TunableSpace.register"))
+                elif key not in flagged:
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, line,
+                        "tuning-space key %s is registered without "
+                        "tunable=True — the registry hides a knob "
+                        "grafttune actually sweeps" % key,
+                        symbol="TunableSpace.register"))
+        elif rel_n.endswith("mxnet_tpu/config.py"):
+            # registry -> space direction, flagged at the registration
+            for name, line in sorted(flagged.items()):
+                if name not in keys:
+                    out.append(Finding(
+                        self.rule, self.severity, relpath, line,
+                        "%s is marked tunable=True but has no "
+                        "tune/space.py entry — the flag advertises "
+                        "tuning the sweep never performs" % name,
+                        symbol="register_env"))
+        return out
+
+
+def drift_report(root=None):
+    """One-call two-way report for the test-suite wrapper:
+    ``{"space_keys", "tunable", "unregistered", "unflagged",
+    "orphaned_flags"}``."""
+    from ..core import repo_root
+    root = root or repo_root()
+    space_path = os.path.join(root, "mxnet_tpu", "tune", "space.py")
+    config_path = os.path.join(root, "mxnet_tpu", "config.py")
+    keys = space_keys(space_path) if os.path.exists(space_path) else {}
+    flagged = (tunable_names(config_path)
+               if os.path.exists(config_path) else {})
+    registered = {}
+    if os.path.exists(config_path):
+        from .env_knobs import registered_names
+        registered = registered_names(config_path)
+    return {
+        "space_keys": sorted(keys),
+        "tunable": sorted(flagged),
+        "unregistered": sorted(k for k in keys if k not in registered),
+        "unflagged": sorted(k for k in keys
+                            if k in registered and k not in flagged),
+        "orphaned_flags": sorted(n for n in flagged if n not in keys),
+    }

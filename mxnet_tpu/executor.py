@@ -251,7 +251,8 @@ class Executor:
                    float(optimizer._param_mult(n, optimizer.wd_mult,
                                                "wd_mult")))
             groups.setdefault(key, []).append(j)
-        cap = _config.get("MXNET_PALLAS_OPT_BUCKET_BYTES")
+        cap = _config.tuned("MXNET_PALLAS_OPT_BUCKET_BYTES",
+                            program="executor-fused-step")
         plan = []
         for key in sorted(groups):
             idxs = groups[key]

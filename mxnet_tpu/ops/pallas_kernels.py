@@ -75,8 +75,11 @@ def _count(kernel):
 
 
 def _knob(name):
+    # env > tuning DB (MXNET_TUNE; the "pallas-kernels" program) >
+    # default — block-size knobs the grafttune sweep won bind here
+    # without any env plumbing, while an explicit env var still wins
     from .. import config as _config
-    return _config.get(name)
+    return _config.tuned(name, program="pallas-kernels")
 
 
 def family_enabled(knob):
@@ -713,8 +716,10 @@ def _pad_rows(x2, br):
     return x2
 
 
-def _norm_block_rows(r, c, knob):
-    br = _knob(knob)
+def _norm_block_rows(r, c, knob, value=None):
+    # `value` lets grafttune price a CANDIDATE block size through the
+    # exact production clamp without touching the process env
+    br = _knob(knob) if value is None else value
     if not br or br <= 0:
         br = max(8, min(256, (512 * 1024 // max(4 * c, 1)) // 8 * 8))
     return max(8, min(int(br), -(-r // 8) * 8))

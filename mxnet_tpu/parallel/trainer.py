@@ -222,19 +222,31 @@ class ParallelTrainer:
         else:
             raise MXNetError("unsupported trainer dtype: %r" % (dtype,))
 
-        # -- reduction-path knobs (args override MXNET_PARALLEL_*) ----------
-        self._zero = int(_config.get("MXNET_PARALLEL_ZERO")
-                         if zero is None else zero)
+        # -- reduction-path knobs ------------------------------------------
+        # explicit args > env > tuning DB (MXNET_TUNE, keyed by this
+        # mesh's shape) > registered default; provenance recorded per
+        # knob in self._tuned and surfaced through plan_spec()
+        mesh_shape = [[str(a), int(self._mesh.shape[a])]
+                      for a in self._mesh.axis_names]
+        self._tuned = {}
+
+        def _knob(name, arg):
+            if arg is not None:
+                self._tuned[name] = {"value": arg, "source": "arg"}
+                return arg
+            info = _config.tuned_info(name, program="parallel-trainer",
+                                      mesh_shape=mesh_shape)
+            self._tuned[name] = info
+            return info["value"]
+
+        self._zero = int(_knob("MXNET_PARALLEL_ZERO", zero))
         if self._zero not in (0, 1, 2):
             raise MXNetError("zero stage must be 0, 1 or 2; got %r"
                              % (self._zero,))
-        if bucket_bytes is None:
-            bucket_bytes = _config.get("MXNET_PARALLEL_BUCKET_BYTES")
-        if first_bucket_bytes is None:
-            first_bucket_bytes = _config.get(
-                "MXNET_PARALLEL_BUCKET_FIRST_BYTES")
-        if compression is None:
-            compression = _config.get("MXNET_PARALLEL_COMPRESSION")
+        bucket_bytes = _knob("MXNET_PARALLEL_BUCKET_BYTES", bucket_bytes)
+        first_bucket_bytes = _knob("MXNET_PARALLEL_BUCKET_FIRST_BYTES",
+                                   first_bucket_bytes)
+        compression = _knob("MXNET_PARALLEL_COMPRESSION", compression)
         cparams = dict(compression_params or {})
         if isinstance(compression, dict):
             cparams = {**compression, **cparams}
@@ -402,6 +414,8 @@ class ParallelTrainer:
             "codec": ({"name": self._codec.name}
                       if self._codec is not None else None),
             "batch": {"axes": ["dp", "fsdp"]},
+            "tuned_config": {k: dict(v)
+                             for k, v in sorted(self._tuned.items())},
         }
 
     def optimizer_state_bytes(self):

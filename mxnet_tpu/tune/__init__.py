@@ -1,0 +1,37 @@
+"""grafttune — statically-pruned autotuning with a fleet-shared DB.
+
+The subsystem closes the loop the static analyzers opened: graftplan
+and graftkern can *price* a configuration without compiling it, so a
+tuning sweep does not have to measure every candidate — it proposes
+from a declarative :class:`~.space.TunableSpace`, kills inadmissible
+candidates with the analyzers' own rules (:mod:`.prune`; the killing
+rule is journaled, nothing compiles), measures only the survivors in a
+bounded subprocess with bit-parity and recompile-flatness guards
+(:mod:`.measure`), and commits winners to a persistent database
+(:mod:`.db`) keyed like the compile cache — program x backend x mesh
+shape x jax version — that every bind site resolves through
+``config.tuned`` (env > DB > default, provenance exposed).
+
+Entry point: :func:`~.search.run_sweep`.  The sweep is seeded and
+journaled, so it is deterministic, resumable, and auditable; its prune
+rate and rule histogram are first-class outputs.  ``bench.py --tune``
+runs a budgeted sweep and emits ``BENCH_TUNE.json``.  Lifecycle and
+operator guidance: ``docs/faq/tune.md``.
+"""
+from .space import (Knob, TunableSpace, candidate_key, default_context,
+                    default_space)
+from .prune import judge, kern_reports, serving_specs, static_cost, \
+    trainer_spec
+from .search import MESHED_PROGRAMS, propose, run_sweep
+from .measure import measure_candidate
+from . import db
+
+__all__ = [
+    "Knob", "TunableSpace", "candidate_key", "default_context",
+    "default_space",
+    "judge", "kern_reports", "serving_specs", "static_cost",
+    "trainer_spec",
+    "MESHED_PROGRAMS", "propose", "run_sweep",
+    "measure_candidate",
+    "db",
+]

@@ -1,0 +1,175 @@
+"""grafttune database — winners persisted per program x deployment.
+
+One JSON file per ``(program, backend, mesh shape, jax version)`` key,
+named ``<program>-<sha256(key)[:24]>.json`` and committed through
+``_atomic_io.atomic_write`` (temp sibling + fsync + ``os.replace``) —
+the compile cache's keying discipline applied to tuned knob values: a
+winner measured on one deployment never binds on another (different
+backend, mesh, or jax version misses cleanly and falls back to
+defaults), and a torn write can never leave a half-entry at the final
+name.  Concurrent writers (fleet replicas, parallel sweeps) race only
+at the ``os.replace``, which is atomic — last complete entry wins,
+readers see old-complete or new-complete, never a hybrid.
+
+Corruption tolerance is the bind-site contract: a truncated, invalid,
+or key-mismatched entry degrades to ``None`` (the caller's default
+path) with ONE counted warning — ``config.tuned`` must never crash a
+trainer or server constructor because a cache file went bad.
+
+Counters (``mxnet_tune_db_total{event=...}``; mirrored in-process in
+``counts()`` like the compile cache's ``_COUNTS``): hit, miss,
+corrupt, store.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+
+__all__ = ["db_dir", "db_key", "entry_path", "store", "lookup",
+           "counts", "reset_counts"]
+
+_LOCK = threading.Lock()
+_COUNTS = {"hit": 0, "miss": 0, "corrupt": 0, "store": 0}
+
+_HELP = ("tuning-DB events by outcome: hit (an entry bound), miss (no "
+         "entry for the key — defaults used), corrupt (unreadable/"
+         "mismatched entry degraded to defaults with a warning), "
+         "store (a winner committed)")
+
+
+def _bump(event):
+    with _LOCK:
+        _COUNTS[event] += 1
+    from .. import telemetry
+    if telemetry.enabled():
+        telemetry.counter("mxnet_tune_db_total",
+                          _HELP).labels(event=event).inc()
+
+
+def counts():
+    """In-process event counts (telemetry-independent, for tests and
+    ``stats()`` blocks)."""
+    with _LOCK:
+        return dict(_COUNTS)
+
+
+def reset_counts():
+    with _LOCK:
+        for k in _COUNTS:
+            _COUNTS[k] = 0
+
+
+def db_dir(dirpath=None):
+    """The tuning-DB directory: explicit arg > ``MXNET_TUNE_DB_DIR`` >
+    ``~/.cache/mxnet_tpu/tune``."""
+    if dirpath:
+        return str(dirpath)
+    from .. import config as _config
+    d = _config.get("MXNET_TUNE_DB_DIR")
+    if d:
+        return str(d)
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "mxnet_tpu", "tune")
+
+
+def _backend():
+    try:
+        import jax
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
+def _jax_version():
+    try:
+        import jax
+        return str(jax.__version__)
+    except Exception:
+        return "unknown"
+
+
+def db_key(program, backend=None, mesh_shape=None):
+    """The deployment identity a winner is valid for.  ``mesh_shape``
+    is ``None`` (unmeshed program) or ``(name, size)`` pairs,
+    canonicalized sorted-by-axis so capture order never splits the
+    key."""
+    mesh = None
+    if mesh_shape:
+        mesh = sorted([str(a), int(s)] for a, s in mesh_shape)
+    return {"program": str(program),
+            "backend": str(backend) if backend else _backend(),
+            "mesh": mesh,
+            "jax": _jax_version()}
+
+
+def _key_sha(key):
+    return hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()
+
+
+def entry_path(program, dirpath=None, backend=None, mesh_shape=None):
+    key = db_key(program, backend=backend, mesh_shape=mesh_shape)
+    fname = "%s-%s.json" % (
+        "".join(ch if ch.isalnum() or ch in "-_" else "_"
+                for ch in str(program)),
+        _key_sha(key)[:24])
+    return os.path.join(db_dir(dirpath), fname), key
+
+
+def store(program, values, dirpath=None, backend=None, mesh_shape=None,
+          meta=None):
+    """Atomically commit ``values`` (``{config_key: value}``) as the
+    winner for ``program`` on this deployment.  Returns the entry
+    path."""
+    from .._atomic_io import atomic_write
+    path, key = entry_path(program, dirpath=dirpath, backend=backend,
+                           mesh_shape=mesh_shape)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {"key": key, "values": dict(values),
+               "meta": dict(meta or {})}
+    atomic_write(path, json.dumps(payload, indent=1,
+                                  sort_keys=True).encode())
+    _bump("store")
+    return path
+
+
+def lookup(program, dirpath=None, backend=None, mesh_shape=None):
+    """The stored winner ``{config_key: value}`` for ``program`` on
+    this deployment, or ``None`` (no entry / corrupt entry / key
+    mismatch — all degrade to the caller's defaults; corruption warns
+    once per call and counts)."""
+    path, key = entry_path(program, dirpath=dirpath, backend=backend,
+                           mesh_shape=mesh_shape)
+    if not os.path.exists(path):
+        _bump("miss")
+        return None
+    try:
+        with open(path, "rb") as f:
+            payload = json.loads(f.read().decode("utf-8"))
+        stored_key = payload["key"]
+        values = payload["values"]
+        if not isinstance(values, dict):
+            raise ValueError("values is not a mapping")
+    except Exception as e:
+        _bump("corrupt")
+        warnings.warn(
+            "tuning-DB entry %s is unreadable (%s: %s) — falling back "
+            "to defaults; delete the file or re-run the sweep"
+            % (path, type(e).__name__, e), RuntimeWarning,
+            stacklevel=2)
+        return None
+    # the filename hash already encodes the key, but verify the stored
+    # key field-for-field: a renamed/copied file must not smuggle a
+    # stale winner onto the wrong deployment
+    if stored_key != key:
+        _bump("corrupt")
+        warnings.warn(
+            "tuning-DB entry %s was recorded for %s but requested as "
+            "%s — stale winner ignored, defaults used"
+            % (path, stored_key, key), RuntimeWarning, stacklevel=2)
+        return None
+    _bump("hit")
+    return values

@@ -1,0 +1,281 @@
+"""grafttune static pruning — judge a candidate before any compile.
+
+Every candidate the search driver proposes passes through
+:func:`judge` first, and only survivors reach measurement.  The
+judgement is the SAME machinery ``tools/lint.py --all`` runs, applied
+to specs/reports built from the candidate's knob values instead of the
+in-tree defaults:
+
+- **graftplan** — the candidate's trainer configuration (bucket split
+  x ZeRO stage x codec), its serving ladder, and the ladder's top rung
+  as a batch-sharded program are analyzed by
+  :func:`~mxnet_tpu.analysis.plan.analyze` and judged by
+  ``run_plan_checkers`` (``spmd-divisibility``, ``oom-risk`` against
+  the context's HBM budget, ``bucket-plan-waste`` including the
+  generative window geometry, ``collective-mismatch``);
+- **graftkern** — the candidate's Pallas block sizes are instantiated
+  into the REAL dispatch plans (``sweep_plan``, ``layernorm_fwd_plan``,
+  ``softmax_plan`` — the same objects ``pallas_call`` consumes),
+  abstractly interpreted by the graftkern catalog, and judged by
+  ``run_kern_checkers`` (``kern-vmem-budget`` against the context's
+  VMEM budget, ``kern-grid-coverage``);
+- **graftir cost floor** — the candidate's static step cost (the
+  context's dense-compute rows + its predicted collective traffic,
+  folded by ``ir/cost.py``) is compared by the driver against a
+  multiple of the best admissible cost seen so far: a candidate the
+  model prices several times off the frontier is never measured (the
+  TVM pruning discipline, arXiv 1802.04799).
+
+Everything here is pure data evaluation: index maps run on plain
+Python ints, memory/wire models are closed-form — **nothing traces,
+jits, or compiles** (the closed-loop test runs this whole stage with
+``jax.jit`` poisoned to prove it).
+
+A *context* (see :func:`~.space.default_context`) describes the
+deployment being tuned for: mesh, params, batch, budgets, reference
+buffer sizes.  The judgement returns ``{"pruned", "records",
+"static_cost"}`` where each record names the killing rule — the rule
+histogram is a first-class output of the sweep.
+"""
+from __future__ import annotations
+
+__all__ = ["trainer_spec", "serving_specs", "kern_reports",
+           "static_cost", "judge", "PLAN_ORIGIN"]
+
+# findings anchor to the space that declared the candidate
+PLAN_ORIGIN = "mxnet_tpu/tune/space.py"
+
+# the sweep family judged kernel-side: fused Adam (4 ins, 3 outs), the
+# widest-residency sweep kernel, priced with the catalog's exact
+# hyper/shard/tail contracts
+_SWEEP_INS = ("w", "g", "mean", "var")
+_SWEEP_OUTS = ("ow", "om", "ov")
+_SWEEP_HYPER = ("lr_eff", "beta1", "beta2", "one_minus_beta1",
+                "one_minus_beta2", "epsilon", "wd", "rescale", "clip")
+
+
+def _optimizer_spec(name, zero):
+    """The slot spec of the context's optimizer family — mirrors
+    ``PureAdam.slot_spec()`` / ``PureSGD.slot_spec()``; the fused-sweep
+    bit mirrors the trainer's gate (the one-sweep path serves the
+    ZeRO flat-bucket update)."""
+    fused = bool(int(zero) >= 1)
+    if name == "adam":
+        return {"slots": ["mean", "var"], "scalar_slots": [["t", 4]],
+                "fused_sweep": fused}
+    if name in ("sgd_momentum", "momentum"):
+        return {"slots": ["mom"], "scalar_slots": [],
+                "fused_sweep": fused}
+    return {"slots": [], "scalar_slots": [], "fused_sweep": fused}
+
+
+def trainer_spec(candidate, context):
+    """The candidate's trainer configuration as a
+    :class:`~mxnet_tpu.analysis.plan.PlanSpec` — REAL bucket plan
+    (``build_bucket_plan``, mesh-padded), candidate ZeRO stage and
+    codec, the context's params/batch/HBM budget."""
+    from ..analysis.plan import MeshSpec, PlanSpec
+    from ..parallel.collectives import build_bucket_plan
+    mesh = MeshSpec(context["mesh"])
+    params = [dict(p) for p in context["params"]]
+    fused = [p for p in params if p.get("fused", True)
+             and p.get("trainable", True)]
+    zero = int(candidate.get("zero_stage", 0) or 0)
+    buckets = build_bucket_plan(
+        [p["name"] for p in fused], [tuple(p["shape"]) for p in fused],
+        int(candidate.get("bucket_bytes", 4 << 20)),
+        int(candidate.get("first_bucket_bytes", 0) or 0) or None,
+        pad_multiple=mesh.size)
+    codec = candidate.get("compression")
+    batch = dict(context.get("batch") or {})
+    return PlanSpec(
+        name="tune:trainer", kind="trainer", origin=PLAN_ORIGIN,
+        mesh=mesh, params=params, zero=zero,
+        optimizer=_optimizer_spec(context.get("optimizer", "adam"),
+                                  zero),
+        buckets=[b.to_dict() for b in buckets],
+        codec={"name": str(codec)} if codec else None,
+        batch=batch or None,
+        hbm_budget=context.get("hbm_budget"))
+
+
+def serving_specs(candidate, context):
+    """The candidate's serving side, two specs:
+
+    - a ``serving``-kind spec carrying the batch ladder
+      (``shape_buckets(max_batch)``) and the generative deployment
+      (prefill ladders + the candidate's generation budget against the
+      context's KV window) — judged by ``bucket-plan-waste``;
+    - a ``program``-kind spec whose batch is the ladder's TOP rung
+      sharded over the context's serving batch axes — the max-dispatch
+      shape every coalesced batch pads up to, judged by
+      ``spmd-divisibility`` (interior rungs legitimately pad; the top
+      rung must actually shard).
+    """
+    from ..analysis.plan import MeshSpec, PlanSpec
+    from ..serving.bucketing import seq_buckets, shape_buckets
+    srv = context.get("serving") or {}
+    mb = int(candidate.get("serving_max_batch", 8) or 8)
+    ladder = shape_buckets(mb)
+    gen_ctx = dict(srv.get("gen") or {})
+    generative = None
+    if gen_ctx:
+        max_len = int(gen_ctx.get("max_len", 0) or 0)
+        generative = {"model": {
+            "batch_ladder": shape_buckets(
+                int(gen_ctx.get("prefill_batch", 1) or 1)),
+            "len_ladder": seq_buckets(max_len) if max_len else [],
+            "slots": int(gen_ctx.get("slots", 0) or 0),
+            "kv_bytes_per_slot": int(
+                gen_ctx.get("kv_bytes_per_slot", 0) or 0),
+            "max_len": max_len,
+            "max_new_tokens": int(
+                candidate.get("gen_max_new_tokens", 0) or 0),
+            "param_bytes": int(gen_ctx.get("param_bytes", 0) or 0),
+        }}
+    specs = [PlanSpec(name="tune:serving", kind="serving",
+                      origin=PLAN_ORIGIN, ladder=ladder,
+                      generative=generative)]
+    axes = list(srv.get("batch_axes") or ())
+    if axes:
+        specs.append(PlanSpec(
+            name="tune:serving-top-rung", kind="program",
+            origin=PLAN_ORIGIN, mesh=MeshSpec(context["mesh"]),
+            params=(), batch={"axes": axes, "shape": [ladder[-1]]}))
+    return specs
+
+
+def kern_reports(candidate, context):
+    """graftkern reports for the candidate's Pallas block sizes, built
+    from the SAME plan builders the dispatch consumes.
+
+    The sweep family gets two views: the production plan (whose layout
+    pads the buffer up to whole blocks — this is what VMEM residency is
+    judged on) and, for an explicit block size, a *literal-tiling*
+    report — the raw block applied to the reference bucket's rows with
+    no pad-up.  A block that does not tile the bucket leaves a tail
+    block the literal grid never writes: ``kern-grid-coverage`` kills
+    it, which is the admissibility statement "this block size only
+    works by growing the buffer" — padding the tuner chose, not the
+    caller, so the candidate is rejected rather than silently
+    reshaped.
+    """
+    from ..analysis.kern import catalog
+    from ..ops import pallas_kernels as pk
+    reports = []
+    n = int(context["sweep_n"])
+    be = int(candidate.get("opt_block_elems", 0) or 0)
+    plan = pk.sweep_plan(n, len(_SWEEP_INS), len(_SWEEP_OUTS), be)
+    padded = plan["out_shapes"][0][0] * pk.LANES
+    reports.append(catalog._report(
+        "_adam_kernel[be=%d]" % be, "MXNET_PALLAS_FUSED_OPT", plan,
+        _SWEEP_INS, _SWEEP_OUTS,
+        hyper={"transport": "scalar_prefetch",
+               "names": list(_SWEEP_HYPER)},
+        python_constants=[
+            {"name": "use_clip",
+             "detail": "structural branch (clip VALUE rides scalar "
+                       "prefetch)"}],
+        shard={"axis": 0,
+               "operands": list(_SWEEP_INS) + list(_SWEEP_OUTS),
+               "why": "ZeRO flat buckets shard the rows axis across "
+                      "the trainer mesh"},
+        tail={"logical_elems": n, "padded_elems": int(padded),
+              "masked": True,
+              "how": "host zero-pad (_to_rows); pad sliced away on "
+                     "return"}))
+    if be > 0:
+        rows = -(-n // pk.LANES)
+        lit = max(1, be // pk.LANES)
+        grid = [rows // lit]
+        reports.append({
+            "name": "_adam_kernel[be=%d literal]" % be,
+            "family": "MXNET_PALLAS_FUSED_OPT",
+            "origin": catalog.ORIGIN,
+            "grid": grid,
+            "operands": [{"name": "ow", "role": "out",
+                          "dtype": "float32",
+                          "block": [lit, pk.LANES],
+                          "shape": [rows, pk.LANES],
+                          "index": [[i, 0] for i in range(grid[0])]}],
+            "scratch": [],
+            "hyper": {"transport": None, "names": []},
+            "python_constants": [],
+            "tail": None, "shard": None})
+    r, c = (int(x) for x in context["norm_shape"])
+    br = pk._norm_block_rows(r, c, "MXNET_PALLAS_NORM_BLOCK_ROWS",
+                             value=int(candidate.get("norm_block_rows",
+                                                     0) or 0))
+    rp = r + (-r) % br
+    reports.append(catalog._report(
+        "_layernorm_fwd_kernel[br=%d]" % br, "MXNET_PALLAS_NORM",
+        pk.layernorm_fwd_plan(rp, c, br),
+        ("x", "gamma", "beta"), ("o", "mu", "rstd"),
+        python_constants=[
+            {"name": "eps", "detail": "architecture constant"}],
+        tail={"logical_elems": r * c, "padded_elems": rp * c,
+              "masked": True, "how": "zero pad rows, sliced away"}))
+    b, r2, c0 = (int(x) for x in context["softmax_shape"])
+    c2 = c0 + (-c0) % pk.LANES
+    sbr = pk._norm_block_rows(
+        r2, c2, "MXNET_PALLAS_SOFTMAX_BLOCK_ROWS",
+        value=int(candidate.get("softmax_block_rows", 0) or 0))
+    rp2 = r2 + (-r2) % sbr
+    reports.append(catalog._report(
+        "_softmax_fwd_kernel[br=%d]" % sbr, "MXNET_PALLAS_SOFTMAX",
+        pk.softmax_plan(b, rp2, c2, 1, sbr), ("x",), ("p",),
+        tail={"logical_elems": b * r2 * c0,
+              "padded_elems": b * rp2 * c2, "masked": True,
+              "how": "identity column fills + zero pad rows"}))
+    return reports
+
+
+def static_cost(candidate, context, tspec=None):
+    """The candidate's static step cost in graftir's bytes metric —
+    the context's dense-compute rows plus the candidate's predicted
+    per-step collective traffic, folded by ``cost_report``.  Bytes
+    (the unfused-traffic upper bound) rather than flops: the knobs
+    here move data placement and wire payload, never the math."""
+    from ..analysis.ir.cost import cost_report
+    from ..analysis.plan.schedule import predict_comm
+    if tspec is None:
+        tspec = trainer_spec(candidate, context)
+    rows = [tuple(r) for r in context.get("cost_rows", ())]
+    rows.append(("collectives", 0,
+                 int(predict_comm(tspec)["total_bytes"]), 1, False))
+    return int(cost_report(rows)["bytes"])
+
+
+def judge(candidate, context, cost_floor=None):
+    """Statically judge one candidate.  Returns ``{"pruned",
+    "records", "static_cost"}`` — ``records`` lists every
+    ``{"rule", "message"}`` that killed it (empty == admissible).
+
+    ``cost_floor`` (driver-supplied: ``cost_floor_ratio`` x the best
+    admissible static cost seen so far) only applies to candidates the
+    rule checkers admit — the floor prunes the cost frontier's tail,
+    not already-dead configs."""
+    from ..analysis.checkers.kern_rules import run_kern_checkers
+    from ..analysis.checkers.plan_rules import run_plan_checkers
+    from ..analysis.plan import analyze
+    fill_min = context.get("fill_min")
+    tspec = trainer_spec(candidate, context)
+    specs = [tspec] + serving_specs(candidate, context)
+    reports = [analyze(s, fill_min=fill_min) for s in specs]
+    findings = list(run_plan_checkers(reports))
+    findings.extend(run_kern_checkers(
+        kern_reports(candidate, context),
+        ctx={"vmem_budget": context.get("vmem_budget")}))
+    records = [{"rule": f.rule, "message": f.message}
+               for f in findings]
+    cost = static_cost(candidate, context, tspec)
+    if not records and cost_floor is not None and cost > cost_floor:
+        records.append({
+            "rule": "ir-cost-floor",
+            "message": "static step cost %d B exceeds the admissible "
+                       "frontier floor %d B (cost_floor_ratio x best "
+                       "seen) — the cost model prices this candidate "
+                       "off the frontier, not worth a measurement"
+                       % (cost, int(cost_floor))})
+    return {"pruned": bool(records), "records": records,
+            "static_cost": cost}

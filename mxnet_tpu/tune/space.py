@@ -1,0 +1,230 @@
+"""grafttune search space — declarative knobs over config.py entries.
+
+A :class:`TunableSpace` names every knob the tuner may move: the
+``config.py`` env-var it binds, the discrete domain the driver draws
+from, the default the production code would use without tuning, the
+knob *family* (the unit the driver sweeps and the docs talk about),
+and the tuning-DB *program* key the winning value is committed under
+(the same key the bind site passes to ``config.tuned``).
+
+The in-tree space (:func:`default_space`) is seeded from the same
+configurations the graftplan catalog (``analysis/plan/configs.py``)
+verifies: the trainer bucket-bytes split, the Pallas sweep/layernorm/
+softmax block sizes, the serving + generative bucket ladders, and the
+ZeRO stage x compression cross.  ``register`` calls keep the config
+key as a positional string literal — graftlint's ``tune-knob-drift``
+checker reads this file's AST (it never imports it) to prove every
+space key is a real ``register_env`` entry and every knob marked
+``tunable=True`` in config.py appears here.
+
+A *candidate* is a plain ``{knob_name: value}`` dict — pure data,
+json-roundtrippable, hashable via :func:`candidate_key` — so the
+journal, the prune records and the tuning DB all speak the same
+vocabulary.
+"""
+from __future__ import annotations
+
+__all__ = ["Knob", "TunableSpace", "default_space", "default_context",
+           "candidate_key"]
+
+
+class Knob:
+    """One tunable: config key, discrete domain, default, grouping."""
+
+    __slots__ = ("name", "key", "domain", "default", "family", "program")
+
+    def __init__(self, name, key, domain, default, family, program):
+        self.name = str(name)
+        self.key = str(key)
+        self.domain = list(domain)
+        if not self.domain:
+            raise ValueError("knob %s needs a non-empty domain" % name)
+        if default not in self.domain:
+            raise ValueError("knob %s default %r is outside its domain "
+                             "%r" % (name, default, self.domain))
+        self.default = default
+        self.family = str(family)
+        self.program = str(program)
+
+    def to_dict(self):
+        return {"name": self.name, "key": self.key,
+                "domain": list(self.domain), "default": self.default,
+                "family": self.family, "program": self.program}
+
+
+class TunableSpace:
+    """Ordered registry of :class:`Knob` rows."""
+
+    def __init__(self):
+        self._knobs = {}
+
+    def register(self, name, key, domain, default=None, family="misc",
+                 program="misc"):
+        """Declare one knob.  Keep ``name`` and ``key`` positional
+        string literals — tune-knob-drift parses them statically."""
+        if name in self._knobs:
+            raise ValueError("knob %r registered twice" % name)
+        if default is None:
+            default = domain[0]
+        self._knobs[name] = Knob(name, key, domain, default, family,
+                                 program)
+        return self._knobs[name]
+
+    def __iter__(self):
+        return iter(self._knobs.values())
+
+    def __len__(self):
+        return len(self._knobs)
+
+    def __contains__(self, name):
+        return name in self._knobs
+
+    def knob(self, name):
+        return self._knobs[name]
+
+    @property
+    def names(self):
+        return list(self._knobs)
+
+    @property
+    def keys(self):
+        return [k.key for k in self._knobs.values()]
+
+    def families(self):
+        out = []
+        for k in self._knobs.values():
+            if k.family not in out:
+                out.append(k.family)
+        return out
+
+    def default_candidate(self):
+        return {k.name: k.default for k in self._knobs.values()}
+
+    def env_overrides(self, candidate):
+        """The candidate as subprocess env: ``{config_key: str(value)}``
+        (``None`` values mean "leave the variable unset")."""
+        env = {}
+        for k in self._knobs.values():
+            v = candidate[k.name]
+            env[k.key] = None if v is None else str(v)
+        return env
+
+    def by_program(self, candidate):
+        """Candidate values regrouped by tuning-DB program key:
+        ``{program: {config_key: value}}`` — the shape ``tune.db``
+        stores and ``config.tuned`` resolves."""
+        out = {}
+        for k in self._knobs.values():
+            out.setdefault(k.program, {})[k.key] = candidate[k.name]
+        return out
+
+    def to_dict(self):
+        return {"knobs": [k.to_dict() for k in self._knobs.values()]}
+
+
+def candidate_key(candidate):
+    """Stable dedup/journal key of one candidate."""
+    return tuple(sorted((str(k), repr(v))
+                        for k, v in candidate.items()))
+
+
+def default_space():
+    """The in-tree tuning space.
+
+    Domains are small and discrete on purpose: every value is one the
+    static judges (graftplan / graftkern) can price, and the cross
+    product stays enumerable by a CI-budget sweep.  A few values are
+    *deliberately* inadmissible on the reference deployment context —
+    a serving ladder whose top rung cannot shard, a sweep block that
+    cannot tile its buffer, a block too large for VMEM — so the prune
+    stage always has real work; pruning them statically (recorded with
+    the killing rule, nothing compiled) is the subsystem's thesis.
+    """
+    s = TunableSpace()
+    # -- trainer gradient-bucket split (parallel/collectives.py) -----------
+    s.register("bucket_bytes", "MXNET_PARALLEL_BUCKET_BYTES",
+               [1 << 20, 2 << 20, 4 << 20, 8 << 20, 16 << 20],
+               default=4 << 20, family="bucket",
+               program="parallel-trainer")
+    s.register("first_bucket_bytes", "MXNET_PARALLEL_BUCKET_FIRST_BYTES",
+               [256 << 10, 512 << 10, 1 << 20, 2 << 20],
+               default=1 << 20, family="bucket",
+               program="parallel-trainer")
+    # -- ZeRO stage x gradient compression ---------------------------------
+    s.register("zero_stage", "MXNET_PARALLEL_ZERO",
+               [0, 1, 2], default=0, family="zero",
+               program="parallel-trainer")
+    s.register("compression", "MXNET_PARALLEL_COMPRESSION",
+               [None, "2bit", "bf16", "fp8"], default=None,
+               family="zero", program="parallel-trainer")
+    # -- Pallas block sizes (ops/pallas_kernels.py) ------------------------
+    # 12288 elements is 96 rows — it cannot tile the 8192-row reference
+    # bucket (kern-grid-coverage); 2Mi elements saturates to the whole
+    # buffer and blows the 16MiB VMEM budget 7 operands wide
+    # (kern-vmem-budget).  0 is the auto default.
+    s.register("opt_block_elems", "MXNET_PALLAS_OPT_BLOCK_ELEMS",
+               [0, 64 * 1024, 128 * 1024, 256 * 1024, 12288,
+                2 * 1024 * 1024],
+               default=0, family="pallas", program="pallas-kernels")
+    s.register("norm_block_rows", "MXNET_PALLAS_NORM_BLOCK_ROWS",
+               [0, 8, 64, 256], default=0, family="pallas",
+               program="pallas-kernels")
+    s.register("softmax_block_rows", "MXNET_PALLAS_SOFTMAX_BLOCK_ROWS",
+               [0, 8, 64], default=0, family="pallas",
+               program="pallas-kernels")
+    # -- executor fused-step bucket cap ------------------------------------
+    s.register("opt_bucket_bytes", "MXNET_PALLAS_OPT_BUCKET_BYTES",
+               [0, 1 << 20, 4 << 20], default=0, family="bucket",
+               program="executor-fused-step")
+    # -- serving + generative ladders --------------------------------------
+    # 6 tops a ladder whose max dispatch cannot shard across the
+    # context's dp axis (spmd-divisibility)
+    s.register("serving_max_batch", "MXNET_SERVING_MAX_BATCH",
+               [4, 6, 8, 16], default=8, family="serving",
+               program="serving-ladder")
+    # 256 overruns the reference deployment's 128-token KV window
+    # (bucket-plan-waste via the generative window geometry)
+    s.register("gen_max_new_tokens", "MXNET_SERVING_GEN_MAX_NEW_TOKENS",
+               [16, 64, 256], default=64, family="serving",
+               program="serving-ladder")
+    return s
+
+
+def default_context():
+    """The deployment the static judges price candidates against —
+    pure data, mirroring the graftplan catalog's reference trainer
+    (replicated fp32 params on a dp4 x fsdp2 mesh) and serving
+    deployment (batch dispatch sharded over dp; a generative model
+    with a 128-token KV window).
+
+    ``hbm_budget`` sits between the uncompressed zero=0 footprint
+    (admissible) and the same layout plus replicated error-feedback
+    residuals (not): compression at zero=0 is the configuration the
+    oom-risk rule exists to catch.  ``cost_rows`` seed the graftir
+    cost floor with the step's dense-compute traffic so per-candidate
+    collective traffic is priced against it.
+    """
+    return {
+        "mesh": [["dp", 4], ["fsdp", 2]],
+        "params": [{"name": "w%d" % i, "shape": [512, 512],
+                    "dtype_size": 4, "trainable": True,
+                    "spec": [None, None], "fused": True}
+                   for i in range(4)],
+        "batch": {"axes": ["dp", "fsdp"], "shape": [32]},
+        "optimizer": "adam",
+        "hbm_budget": 20 * 1024 * 1024,
+        "serving": {
+            "batch_axes": ["dp"],
+            "gen": {"prefill_batch": 4, "max_len": 128, "slots": 8,
+                    "kv_bytes_per_slot": 64 * 1024,
+                    "param_bytes": 1 << 20},
+        },
+        "sweep_n": 8 * 128 * 1024,
+        "norm_shape": [1024, 256],
+        "softmax_shape": [8, 128, 1024],
+        "fill_min": 0.6,
+        "vmem_budget": 16 * 1024 * 1024,
+        "cost_rows": [["dot_general", 64 * 1024 * 1024,
+                       1024 * 1024, 1, False]],
+        "cost_floor_ratio": 1.5,
+    }

@@ -112,3 +112,87 @@ def test_primary_leg_carries_telemetry_knobs(bench_mod, tmp_path, capsys,
         str(tmp_path / "BENCH_TELEMETRY.prom")
     assert not stale.exists(), \
         "a new bench run must not append to a previous run's step log"
+
+
+# -- bench.py --tune (grafttune leg) -----------------------------------------
+
+def _stub_sweep(bench, summary):
+    calls = []
+
+    def fake(journal, db_dir=None, measure_timeout=240.0):
+        calls.append({"journal": journal, "timeout": measure_timeout})
+        return summary
+
+    bench._run_tune_sweep = fake
+    return calls
+
+
+TUNE_SUMMARY = {
+    "proposed": 12, "pruned": 7, "admissible": 0, "measured": 5,
+    "failed": 0, "duplicates": 0, "budget": 12, "seed": 0,
+    "prune_rules": {"oom-risk": 4, "kern-grid-coverage": 3},
+    "default_us_per_step": 200.0,
+    "winner": {"candidate": {"bucket_bytes": 2097152},
+               "us_per_step": 150.0, "k": 10},
+    "stored": ["/tmp/db/parallel-trainer-abc.json"],
+    "resumed_records": 0,
+}
+
+
+def test_tune_leg_writes_side_json_and_one_stdout_line(
+        bench_mod, tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv("MXNET_BENCH_SECONDARY_BUDGET_S", raising=False)
+    calls = _stub_sweep(bench_mod, dict(TUNE_SUMMARY))
+    bench_mod.tune_main()
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip()]
+    assert len(lines) == 1                 # ONE stdout JSON line
+    out = json.loads(lines[0])
+    side = json.loads((tmp_path / "BENCH_TUNE.json").read_text())
+    assert out == side
+    assert out["proposed"] == 12 and out["pruned"] == 7
+    assert out["measured"] == 5
+    assert out["prune_rules"] == {"oom-risk": 4,
+                                  "kern-grid-coverage": 3}
+    assert out["default_us_per_step"] == 200.0
+    assert out["tuned_us_per_step"] == 150.0
+    assert out["tuned_vs_default"] == 0.75     # tuned <= default
+    assert out["tuned_candidate"] == {"bucket_bytes": 2097152}
+    assert out["stored"] == ["/tmp/db/parallel-trainer-abc.json"]
+    # the journal lands next to the side file (resumable sweep)
+    assert calls[0]["journal"] == str(tmp_path
+                                      / "BENCH_TUNE.journal.jsonl")
+
+
+def test_tune_leg_skips_under_exhausted_budget(bench_mod, tmp_path,
+                                               capsys, monkeypatch):
+    monkeypatch.setenv("MXNET_BENCH_SECONDARY_BUDGET_S", "0")
+    calls = _stub_sweep(bench_mod, dict(TUNE_SUMMARY))
+    bench_mod.tune_main()
+    out = json.loads(capsys.readouterr().out.strip())
+    side = json.loads((tmp_path / "BENCH_TUNE.json").read_text())
+    assert out == side == {
+        "tune_skipped": "secondary wall budget exhausted"}
+    assert calls == []                     # the driver never ran
+
+
+def test_tune_leg_without_winner_reports_counts_only(
+        bench_mod, tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv("MXNET_BENCH_SECONDARY_BUDGET_S", raising=False)
+    summary = dict(TUNE_SUMMARY, winner=None, measured=0,
+                   default_us_per_step=None, stored=[])
+    _stub_sweep(bench_mod, summary)
+    bench_mod.tune_main()
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["pruned"] == 7
+    assert "tuned_us_per_step" not in out
+    assert "tuned_vs_default" not in out
+
+
+def test_tune_leg_clamps_measure_timeout_to_budget(
+        bench_mod, tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("MXNET_BENCH_SECONDARY_BUDGET_S", "90")
+    calls = _stub_sweep(bench_mod, dict(TUNE_SUMMARY))
+    bench_mod.tune_main()
+    capsys.readouterr()
+    assert calls[0]["timeout"] == 90.0

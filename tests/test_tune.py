@@ -1,0 +1,631 @@
+"""grafttune: the statically-pruned autotuning loop (docs/faq/tune.md).
+
+The acceptance spine is the closed loop: a seeded sweep proposes over
+the real knob space, the static judges (graftplan + graftkern +
+graftir's cost floor) prune inadmissible candidates WITHOUT compiling
+anything (``jax.jit`` is poisoned during the prune-only sweeps to
+prove it), survivors are measured in bounded subprocesses under
+bit-parity and recompile-flatness guards, the winner is committed to
+the tuning DB through atomic writes, and a FRESH process binds it with
+provenance ``db``.  Around the spine: DB hygiene (corruption degrades
+with a counted warning, two writers race safely, a key mismatch never
+smuggles a stale winner), resolution-order provenance, journal-based
+determinism/resume, the provenance blocks on ``ParallelTrainer`` and
+``ModelServer``, and the ``tune-knob-drift`` lint contract.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from mxnet_tpu import config  # noqa: E402
+from mxnet_tpu.tune import (candidate_key, db as tune_db,  # noqa: E402
+                            default_context, default_space, judge,
+                            measure_candidate, propose, run_sweep)
+
+# every static rule the default space must be able to trigger on the
+# reference context: three graftplan rules, two graftkern rules, and
+# graftir's relative cost floor
+PLAN_RULES = ("spmd-divisibility", "oom-risk", "bucket-plan-waste")
+KERN_RULES = ("kern-vmem-budget", "kern-grid-coverage")
+ALL_RULES = PLAN_RULES + KERN_RULES + ("ir-cost-floor",)
+
+
+@pytest.fixture()
+def poisoned_jit(monkeypatch):
+    """Nothing in the prune path may compile OR trace: the whole point
+    of static pruning is that a killed candidate costs zero XLA work."""
+    import jax
+
+    def boom(*a, **k):
+        raise AssertionError("jax.jit invoked during static pruning")
+
+    monkeypatch.setattr(jax, "jit", boom)
+    return boom
+
+
+@pytest.fixture()
+def db_counts_reset():
+    tune_db.reset_counts()
+    yield
+    tune_db.reset_counts()
+
+
+# -- proposal stream ---------------------------------------------------------
+
+def test_candidate_zero_is_the_default():
+    space = default_space()
+    assert propose(space, seed=7, k=0) == space.default_candidate()
+
+
+def test_proposal_stream_is_pure_in_seed_and_k():
+    space = default_space()
+    a = [propose(space, seed=3, k=k) for k in range(16)]
+    b = [propose(space, seed=3, k=k) for k in range(16)]
+    c = [propose(space, seed=4, k=k) for k in range(16)]
+    assert a == b
+    assert a != c
+    for cand in a:
+        for knob in space:
+            assert cand[knob.name] in knob.domain
+
+
+def test_mutation_moves_exactly_one_knob():
+    space = default_space()
+    base = space.default_candidate()
+    cand = propose(space, seed=5, k=40, best=base, explore=8)
+    diffs = [n for n in base if cand[n] != base[n]]
+    assert len(diffs) == 1
+
+
+# -- static pruning: each rule, nothing compiles -----------------------------
+
+def test_kill_matrix_each_rule_reachable(poisoned_jit):
+    """Directed candidates hit every judge: the default is admissible,
+    and each deliberately-inadmissible domain value is killed by the
+    rule the space documents for it."""
+    space, ctx = default_space(), default_context()
+    default = space.default_candidate()
+    v = judge(default, ctx)
+    assert not v["pruned"]
+    assert v["static_cost"] > 0
+    kills = {
+        "spmd-divisibility": dict(default, serving_max_batch=6),
+        "bucket-plan-waste": dict(default, gen_max_new_tokens=256),
+        "oom-risk": dict(default, compression="2bit"),
+        "kern-grid-coverage": dict(default, opt_block_elems=12288),
+        "kern-vmem-budget": dict(default,
+                                 opt_block_elems=2 * 1024 * 1024),
+    }
+    for rule, cand in kills.items():
+        verdict = judge(cand, ctx)
+        assert verdict["pruned"], rule
+        assert rule in {r["rule"] for r in verdict["records"]}, rule
+
+
+def test_cost_floor_prunes_relative_to_frontier(poisoned_jit):
+    space, ctx = default_space(), default_context()
+    default = space.default_candidate()
+    base = judge(default, ctx)["static_cost"]
+    v = judge(default, ctx, cost_floor=base - 1)
+    assert v["pruned"]
+    assert [r["rule"] for r in v["records"]] == ["ir-cost-floor"]
+
+
+def test_seeded_sweep_covers_every_rule_without_compiling(
+        poisoned_jit, tmp_path):
+    """THE acceptance sweep: seeded, prune-only, full space, jit
+    poisoned — at least one prune per graftplan rule, per graftkern
+    rule, and the ir cost floor; journal + summary agree."""
+    space, ctx = default_space(), default_context()
+    journal = str(tmp_path / "sweep.jsonl")
+    out = run_sweep(space, ctx, budget=96, seed=3, prune_only=True,
+                    journal=journal)
+    for rule in ALL_RULES:
+        assert out["prune_rules"].get(rule, 0) >= 1, rule
+    assert out["pruned"] >= len(ALL_RULES) - 1
+    assert out["admissible"] > 0
+    assert out["measured"] == 0 and out["winner"] is None
+    assert out["proposed"] == 96
+    # the journal is the ledger: every pruned record names its rules
+    recs = [json.loads(l) for l in open(journal)]
+    assert len(recs) == 96
+    pruned = [r for r in recs if r["outcome"] == "pruned"]
+    assert all(r["rules"] and r["messages"] for r in pruned)
+    assert sum(len(set(r["rules"])) for r in pruned) \
+        == sum(out["prune_rules"].values())
+
+
+def test_sweep_resume_replays_journal_and_dedups(tmp_path):
+    space, ctx = default_space(), default_context()
+    journal = str(tmp_path / "resume.jsonl")
+    first = run_sweep(space, ctx, budget=10, seed=3, prune_only=True,
+                      journal=journal)
+    assert first["resumed_records"] == 0
+    n_lines = len(open(journal).readlines())
+    assert n_lines == 10
+    # append garbage: a sweep killed mid-write leaves a torn tail
+    with open(journal, "a") as f:
+        f.write('{"k": 10, "outcome": "prun')
+    second = run_sweep(space, ctx, budget=24, seed=3, prune_only=True,
+                       journal=journal)
+    assert second["resumed_records"] == 10
+    # the torn tail was truncated before appending: every line parses
+    recs = [json.loads(l) for l in open(journal)]
+    ks = [r["k"] for r in recs]
+    assert ks == list(range(24))  # no k re-judged, none lost
+    assert second["proposed"] == 24
+    # a third run with the same budget is a pure replay
+    third = run_sweep(space, ctx, budget=24, seed=3, prune_only=True,
+                      journal=journal)
+    assert third["resumed_records"] == 24
+    assert third["prune_rules"] == second["prune_rules"]
+
+
+# -- the closed loop: sweep -> measure -> DB -> fresh-process bind -----------
+
+@pytest.fixture(scope="module")
+def closed_loop(tmp_path_factory):
+    """One real sweep shared by the E2E assertions: budget 12 over the
+    full space, survivors measured in real subprocesses (small n),
+    winner committed to a fresh DB dir."""
+    d = tmp_path_factory.mktemp("tune_e2e")
+    space, ctx = default_space(), default_context()
+    journal = str(d / "journal.jsonl")
+    db_dir = str(d / "db")
+    out = run_sweep(
+        space, ctx, budget=12, seed=0, journal=journal, db_dir=db_dir,
+        measure=lambda c: measure_candidate(
+            c, space=space, n=16384, steps=4, warmup=1, timeout=180))
+    return {"summary": out, "journal": journal, "db_dir": db_dir,
+            "space": space, "ctx": ctx}
+
+
+def test_closed_loop_prunes_measures_and_commits(closed_loop):
+    out = closed_loop["summary"]
+    assert out["pruned"] >= 1
+    for rule_family in (PLAN_RULES, KERN_RULES):
+        assert any(out["prune_rules"].get(r) for r in rule_family)
+    assert out["measured"] >= 2          # default + at least one rival
+    assert out["default_us_per_step"] > 0
+    assert out["winner"] is not None
+    assert out["winner"]["us_per_step"] <= out["default_us_per_step"]
+    # one DB entry per program the winner's knobs group into
+    progs = set(closed_loop["space"].by_program(
+        out["winner"]["candidate"]))
+    assert len(out["stored"]) == len(progs)
+    for path in out["stored"]:
+        assert os.path.exists(path)
+        payload = json.load(open(path))
+        assert payload["key"]["program"] in progs
+        assert payload["meta"]["us_per_step"] \
+            == out["winner"]["us_per_step"]
+
+
+def test_closed_loop_measured_candidates_pass_guards(closed_loop):
+    """Every measured candidate was bit-parity-equal to the tree_map
+    oracle and recompile-flat — the guards ride the journal."""
+    recs = [json.loads(l) for l in open(closed_loop["journal"])]
+    measured = [r for r in recs if r["outcome"] == "measured"]
+    assert measured
+    for r in measured:
+        assert r["parity"] is True
+        assert r["recompiles"] == 1
+        assert r["us_per_step"] > 0
+
+
+def test_fresh_process_binds_winner_with_db_provenance(closed_loop):
+    """A process that was never part of the sweep resolves the
+    committed winner through config.tuned_info with source=db — the
+    trainer program keyed by the context mesh, the serving ladder
+    mesh-less via a real ModelServer constructor."""
+    out = closed_loop["summary"]
+    winner = out["winner"]["candidate"]
+    src = textwrap.dedent("""
+        import json, sys
+        sys.path.insert(0, %r)
+        from mxnet_tpu import config
+        from mxnet_tpu.serving.server import ModelServer
+        info = config.tuned_info(
+            "MXNET_PARALLEL_BUCKET_BYTES", program="parallel-trainer",
+            mesh_shape=[["dp", 4], ["fsdp", 2]])
+        srv = ModelServer()
+        print(json.dumps({
+            "trainer": info,
+            "serving": srv._tuned_config["MXNET_SERVING_MAX_BATCH"],
+            "buckets": srv._buckets}))
+    """ % ROOT)
+    env = dict(os.environ, MXNET_TUNE="1",
+               MXNET_TUNE_DB_DIR=closed_loop["db_dir"],
+               JAX_PLATFORMS="cpu")
+    env.pop("MXNET_PARALLEL_BUCKET_BYTES", None)
+    env.pop("MXNET_SERVING_MAX_BATCH", None)
+    proc = subprocess.run([sys.executable, "-c", src], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["trainer"]["source"] == "db"
+    assert got["trainer"]["value"] == winner["bucket_bytes"]
+    assert got["serving"]["source"] == "db"
+    assert got["serving"]["value"] == winner["serving_max_batch"]
+    assert got["buckets"][-1] == winner["serving_max_batch"]
+
+
+def test_winner_never_binds_on_a_different_deployment(
+        closed_loop, monkeypatch):
+    """Same DB dir, different mesh shape -> clean miss (defaults),
+    never a stale winner."""
+    monkeypatch.setenv("MXNET_TUNE", "1")
+    monkeypatch.setenv("MXNET_TUNE_DB_DIR", closed_loop["db_dir"])
+    monkeypatch.delenv("MXNET_PARALLEL_BUCKET_BYTES", raising=False)
+    info = config.tuned_info(
+        "MXNET_PARALLEL_BUCKET_BYTES", program="parallel-trainer",
+        mesh_shape=[["dp", 8]])
+    assert info["source"] == "default"
+    # the committed mesh still hits from THIS process too
+    info = config.tuned_info(
+        "MXNET_PARALLEL_BUCKET_BYTES", program="parallel-trainer",
+        mesh_shape=[["dp", 4], ["fsdp", 2]])
+    assert info["source"] == "db"
+
+
+# -- resolution order and provenance -----------------------------------------
+
+def test_tuned_resolution_env_beats_db_beats_default(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TUNE", "1")
+    monkeypatch.setenv("MXNET_TUNE_DB_DIR", str(tmp_path))
+    monkeypatch.delenv("MXNET_PARALLEL_BUCKET_BYTES", raising=False)
+    # nothing stored yet -> default
+    info = config.tuned_info("MXNET_PARALLEL_BUCKET_BYTES",
+                             program="parallel-trainer")
+    assert info == {"value": 4194304, "source": "default"}
+    # a committed winner -> db, with the registered type applied
+    tune_db.store("parallel-trainer",
+                  {"MXNET_PARALLEL_BUCKET_BYTES": "2097152"})
+    info = config.tuned_info("MXNET_PARALLEL_BUCKET_BYTES",
+                             program="parallel-trainer")
+    assert info == {"value": 2097152, "source": "db"}
+    # an explicit env var ALWAYS wins over the db
+    monkeypatch.setenv("MXNET_PARALLEL_BUCKET_BYTES", "1048576")
+    info = config.tuned_info("MXNET_PARALLEL_BUCKET_BYTES",
+                             program="parallel-trainer")
+    assert info == {"value": 1048576, "source": "env"}
+    # MXNET_TUNE off -> db ignored entirely
+    monkeypatch.delenv("MXNET_PARALLEL_BUCKET_BYTES")
+    monkeypatch.setenv("MXNET_TUNE", "0")
+    info = config.tuned_info("MXNET_PARALLEL_BUCKET_BYTES",
+                             program="parallel-trainer")
+    assert info["source"] == "default"
+
+
+def test_tuned_without_program_never_touches_db(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TUNE", "1")
+    monkeypatch.setenv("MXNET_TUNE_DB_DIR", str(tmp_path))
+    tune_db.store("parallel-trainer",
+                  {"MXNET_PARALLEL_BUCKET_BYTES": 1})
+    assert config.tuned("MXNET_PARALLEL_BUCKET_BYTES") == 4194304
+
+
+# -- DB hygiene --------------------------------------------------------------
+
+def test_corrupt_entry_degrades_with_counted_warning(
+        tmp_path, db_counts_reset):
+    path = tune_db.store("pallas-kernels",
+                         {"MXNET_PALLAS_OPT_BLOCK_ELEMS": 65536},
+                         dirpath=str(tmp_path))
+    with open(path, "w") as f:
+        f.write('{"key": {"program": "pallas-ker')   # torn write shape
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = tune_db.lookup("pallas-kernels", dirpath=str(tmp_path))
+    assert got is None
+    assert any("falling back to defaults" in str(w.message)
+               for w in caught)
+    assert tune_db.counts()["corrupt"] == 1
+
+
+def test_corrupt_entry_never_crashes_a_bind_site(
+        tmp_path, monkeypatch, db_counts_reset):
+    """The constructor contract: a broken DB file must not take down
+    ModelServer.__init__ — it binds the default with a warning."""
+    monkeypatch.setenv("MXNET_TUNE", "1")
+    monkeypatch.setenv("MXNET_TUNE_DB_DIR", str(tmp_path))
+    monkeypatch.delenv("MXNET_SERVING_MAX_BATCH", raising=False)
+    path = tune_db.store("serving-ladder",
+                         {"MXNET_SERVING_MAX_BATCH": 16})
+    with open(path, "w") as f:
+        f.write("not json at all")
+    from mxnet_tpu.serving.server import ModelServer
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        srv = ModelServer()
+    assert srv._max_batch == 8        # the registered default
+    assert srv._tuned_config["MXNET_SERVING_MAX_BATCH"]["source"] \
+        == "default"
+    assert tune_db.counts()["corrupt"] >= 1
+
+
+def test_key_mismatch_never_applies_stale_winner(
+        tmp_path, db_counts_reset):
+    """A copied/renamed entry (right filename, wrong stored key) is
+    rejected: the stored key is verified field-for-field."""
+    src = tune_db.store("serving-ladder",
+                        {"MXNET_SERVING_MAX_BATCH": 16},
+                        dirpath=str(tmp_path), backend="tpu")
+    dst, _ = tune_db.entry_path("serving-ladder",
+                                dirpath=str(tmp_path), backend="cpu")
+    assert src != dst
+    with open(src, "rb") as f:
+        payload = f.read()
+    with open(dst, "wb") as f:
+        f.write(payload)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = tune_db.lookup("serving-ladder", dirpath=str(tmp_path),
+                             backend="cpu")
+    assert got is None
+    assert any("stale winner ignored" in str(w.message)
+               for w in caught)
+    assert tune_db.counts()["corrupt"] == 1
+    # the original key still hits
+    assert tune_db.lookup("serving-ladder", dirpath=str(tmp_path),
+                          backend="tpu") \
+        == {"MXNET_SERVING_MAX_BATCH": 16}
+
+
+def test_two_process_store_race_is_atomic(tmp_path):
+    """Two writer PROCESSES hammer the same entry while this process
+    reads: every successful lookup is one of the two complete value
+    sets — no torn hybrid, no partial JSON (the os.replace claim)."""
+    writer = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        from mxnet_tpu.tune import db
+        val = int(sys.argv[1])
+        for i in range(120):
+            db.store("race-program",
+                     {"MXNET_PARALLEL_BUCKET_BYTES": val,
+                      "MXNET_PARALLEL_ZERO": val %% 3},
+                     dirpath=sys.argv[2], backend="cpu")
+    """ % ROOT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", writer, str(val), str(tmp_path)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        for val in (1111, 2222)]
+    seen = set()
+    try:
+        while any(p.poll() is None for p in procs):
+            got = tune_db.lookup("race-program", dirpath=str(tmp_path),
+                                 backend="cpu")
+            if got is not None:
+                assert got["MXNET_PARALLEL_BUCKET_BYTES"] in (1111, 2222)
+                assert got["MXNET_PARALLEL_ZERO"] \
+                    == got["MXNET_PARALLEL_BUCKET_BYTES"] % 3
+                seen.add(got["MXNET_PARALLEL_BUCKET_BYTES"])
+    finally:
+        errs = [p.communicate()[1] for p in procs]
+    assert all(p.returncode == 0 for p in procs), errs
+    final = tune_db.lookup("race-program", dirpath=str(tmp_path),
+                           backend="cpu")
+    assert final["MXNET_PARALLEL_BUCKET_BYTES"] in (1111, 2222)
+    assert seen                        # the reader actually raced
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_sweep_counters_land_in_telemetry(tmp_path):
+    from mxnet_tpu import telemetry
+    space, ctx = default_space(), default_context()
+    before = _counter_sum("mxnet_tune_candidates_total", "pruned")
+    out = run_sweep(space, ctx, budget=16, seed=3, prune_only=True,
+                    journal=str(tmp_path / "j.jsonl"))
+    after = _counter_sum("mxnet_tune_candidates_total", "pruned")
+    assert after - before == out["pruned"]
+    rules = _counter_labels("mxnet_tune_prune_rules_total")
+    for rule in out["prune_rules"]:
+        assert ("rule", rule) in rules
+
+
+def _counter_sum(name, outcome):
+    from mxnet_tpu import telemetry
+    fam = telemetry.snapshot().get(name) or {"values": []}
+    return sum(s["value"] for s in fam["values"]
+               if s["labels"].get("outcome") == outcome)
+
+
+def _counter_labels(name):
+    from mxnet_tpu import telemetry
+    fam = telemetry.snapshot().get(name) or {"values": []}
+    return {item for s in fam["values"] for item in s["labels"].items()}
+
+
+# -- provenance blocks on the bind surfaces ----------------------------------
+
+def test_trainer_plan_spec_carries_tuned_config(monkeypatch):
+    monkeypatch.setenv("MXNET_PARALLEL_BUCKET_BYTES", "2097152")
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import ParallelTrainer
+    net = gluon.nn.Dense(4, in_units=6)
+    net.initialize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = ParallelTrainer(net, loss, "sgd", {"learning_rate": 0.1},
+                         zero=1)
+    tc = tr.plan_spec()["tuned_config"]
+    assert tc["MXNET_PARALLEL_ZERO"] == {"value": 1, "source": "arg"}
+    assert tc["MXNET_PARALLEL_BUCKET_BYTES"] \
+        == {"value": 2097152, "source": "env"}
+    assert tc["MXNET_PARALLEL_COMPRESSION"]["source"] == "default"
+
+
+def test_server_stats_carries_tuned_config():
+    from mxnet_tpu.serving.server import ModelServer
+    with ModelServer(max_batch=4) as srv:
+        block = srv.stats()["tuned_config"]
+    assert block["knobs"]["MXNET_SERVING_MAX_BATCH"] \
+        == {"value": 4, "source": "arg"}
+    assert set(block["db"]) >= {"hit", "miss", "corrupt", "store"}
+
+
+# -- tune-knob-drift (graftlint) ---------------------------------------------
+
+def _drift_fixture(tmp_path, space_body, config_body):
+    from mxnet_tpu import analysis
+    pkg = tmp_path / "mxnet_tpu"
+    (pkg / "tune").mkdir(parents=True, exist_ok=True)
+    (pkg / "config.py").write_text(textwrap.dedent(config_body))
+    (pkg / "tune" / "space.py").write_text(textwrap.dedent(space_body))
+    return analysis.run(
+        [str(pkg / "config.py"), str(pkg / "tune" / "space.py")],
+        rules=["tune-knob-drift"], root=str(tmp_path))
+
+
+def test_tune_knob_drift_flags_unregistered_space_key(tmp_path):
+    findings = _drift_fixture(tmp_path, """
+        s.register("bb", "MXNET_TYPOED_KNOB", [1, 2], default=1)
+    """, """
+        def register_env(name, typ=str, default=None, description="",
+                         tunable=False):
+            pass
+        register_env("MXNET_OTHER", int, 1, "x", tunable=True)
+    """)
+    msgs = [f.message for f in findings]
+    assert any("MXNET_TYPOED_KNOB" in m and "not register_env'd" in m
+               for m in msgs)
+
+
+def test_tune_knob_drift_flags_missing_tunable_flag(tmp_path):
+    findings = _drift_fixture(tmp_path, """
+        s.register("bb", "MXNET_REAL_KNOB", [1, 2], default=1)
+    """, """
+        def register_env(name, typ=str, default=None, description="",
+                         tunable=False):
+            pass
+        register_env("MXNET_REAL_KNOB", int, 1, "registered, unflagged")
+    """)
+    assert any("without tunable=True" in f.message for f in findings)
+
+
+def test_tune_knob_drift_flags_orphaned_flag(tmp_path):
+    findings = _drift_fixture(tmp_path, """
+        s.register("bb", "MXNET_SWEPT", [1, 2], default=1)
+    """, """
+        def register_env(name, typ=str, default=None, description="",
+                         tunable=False):
+            pass
+        register_env("MXNET_SWEPT", int, 1, "fine", tunable=True)
+        register_env("MXNET_ORPHAN", int, 1, "flag, no space entry",
+                     tunable=True)
+    """)
+    msgs = [f.message for f in findings]
+    assert any("MXNET_ORPHAN" in m and "advertises tuning" in m
+               for m in msgs)
+    assert not any("MXNET_SWEPT" in m for m in msgs)
+
+
+def test_tree_is_tune_knob_drift_clean():
+    """The real space and the real registry agree both ways, and the
+    space's keys are exactly the registry's tunable=True subset."""
+    from mxnet_tpu.analysis.checkers.tune_knobs import drift_report
+    rep = drift_report(root=ROOT)
+    assert rep["unregistered"] == []
+    assert rep["unflagged"] == []
+    assert rep["orphaned_flags"] == []
+    assert rep["space_keys"] == rep["tunable"]
+    # and the AST view matches the live space
+    assert sorted(default_space().keys) == rep["space_keys"]
+
+
+def test_tune_env_family_registered_and_documented():
+    """Satellite: every MXNET_TUNE_* knob is registered and has an
+    env_var.md row (env-knob-drift's own judgement, scoped)."""
+    from mxnet_tpu.analysis.checkers.env_knobs import drift_report
+    rep = drift_report(prefix="MXNET_TUNE", root=ROOT,
+                       extra_sources=("bench.py",))
+    assert rep["unregistered"] == []
+    assert rep["undocumented"] == []
+    for name in ("MXNET_TUNE", "MXNET_TUNE_DB_DIR", "MXNET_TUNE_BUDGET",
+                 "MXNET_TUNE_SEED", "MXNET_TUNE_PRUNE_ONLY"):
+        assert name in config._REGISTRY
+
+
+def test_changed_path_mapping_pairs_space_and_config(tmp_path):
+    """--changed treats the two drift surfaces as one contract: a
+    tune/ edit re-lints config.py and vice versa."""
+    from mxnet_tpu.analysis.cli import _changed_paths
+    repo = tmp_path / "repo"
+    (repo / "mxnet_tpu" / "tune").mkdir(parents=True)
+    (repo / "mxnet_tpu" / "config.py").write_text("x = 1\n")
+    (repo / "mxnet_tpu" / "tune" / "space.py").write_text("y = 1\n")
+    subprocess.run(["git", "init", "-q", str(repo)], check=True)
+    subprocess.run(["git", "-C", str(repo), "add", "-A"], check=True)
+    subprocess.run(["git", "-C", str(repo), "-c",
+                    "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-qm", "seed"], check=True)
+    (repo / "mxnet_tpu" / "tune" / "space.py").write_text("y = 2\n")
+    picked = _changed_paths(str(repo), None)
+    rel = sorted(os.path.relpath(p, str(repo)) for p in picked)
+    assert "mxnet_tpu/config.py" in rel
+    assert os.path.join("mxnet_tpu", "tune", "space.py") in rel
+
+
+# -- measurement harness degradations ----------------------------------------
+
+def test_measure_candidate_degrades_on_subprocess_failure(monkeypatch):
+    """rc!=0 / no JSON -> ok=False with the stderr tail, never a
+    raise: the driver journals the failure and sweeps on."""
+    import mxnet_tpu.tune.measure as measure_mod
+    calls = {}
+
+    class FakeProc:
+        returncode = 1
+        stdout = ""
+        stderr = "Traceback: boom"
+
+    def fake_run(cmd, **kw):
+        calls["env"] = kw["env"]
+        return FakeProc()
+
+    monkeypatch.setattr(measure_mod.subprocess, "run", fake_run)
+    space = default_space()
+    out = measure_candidate(space.default_candidate(), space=space)
+    assert out["ok"] is False
+    assert "rc=1" in out["error"]
+    # the candidate rode the env, with the tuning DB forced OFF so the
+    # candidate's env is the only knob source
+    assert calls["env"]["MXNET_TUNE"] == "0"
+    assert calls["env"]["MXNET_PALLAS_FUSED_OPT"] == "1"
+
+
+def test_measure_candidate_env_overrides_unset_none(monkeypatch):
+    """A None-valued knob (compression off) must be REMOVED from the
+    child env, not stringified."""
+    import mxnet_tpu.tune.measure as measure_mod
+    seen = {}
+
+    class FakeProc:
+        returncode = 0
+        stdout = json.dumps({"us_per_step": 10.0, "parity": True,
+                             "recompiles": 1})
+        stderr = ""
+
+    def fake_run(cmd, **kw):
+        seen.update(kw["env"])
+        return FakeProc()
+
+    monkeypatch.setattr(measure_mod.subprocess, "run", fake_run)
+    monkeypatch.setenv("MXNET_PARALLEL_COMPRESSION", "bf16")
+    space = default_space()
+    cand = space.default_candidate()          # compression None
+    out = measure_candidate(cand, space=space)
+    assert out["ok"] is True
+    assert "MXNET_PARALLEL_COMPRESSION" not in seen
+    assert seen["MXNET_PARALLEL_BUCKET_BYTES"] == "4194304"
